@@ -1,0 +1,38 @@
+"""rca_tpu — a TPU-native Kubernetes root-cause-analysis framework.
+
+Built from scratch in JAX/XLA/Pallas with the capability surface of the
+reference system (``vobbilis/kubernetes-rca-system``): six diagnostic signal
+agents (metrics / logs / events / topology / traces / resources), a
+coordinator that fuses findings into ranked root causes, a chat-style query
+interface with prioritized suggestions, a hypothesis → evidence → conclusion
+investigation workflow, persistent resumable investigations with full audit
+logging, and both live-cluster and hermetic mock backends.
+
+Where the reference correlates evidence with serial per-agent Python loops
+and LLM calls (reference: agents/mcp_coordinator.py:624-666), this framework
+recasts evidence fusion as a batched causal-graph inference kernel on TPU:
+vectorized feature extraction packs per-pod/per-service signals into padded
+device arrays, and a jit-compiled message-passing pass over the
+service-dependency graph ranks root causes — shardable across a device mesh
+via shard_map/ppermute for large topologies.
+
+Layering (bottom-up; see SURVEY.md §7):
+
+- :mod:`rca_tpu.cluster`      typed snapshot layer (real + mock backends)
+- :mod:`rca_tpu.features`     vectorized feature extraction → device arrays
+- :mod:`rca_tpu.graph`        topology construction → typed COO/CSR arrays
+- :mod:`rca_tpu.engine`       jit'd causal propagation + root-cause ranking
+- :mod:`rca_tpu.models`       learnable CausalGNN scorer (flax)
+- :mod:`rca_tpu.ops`          Pallas TPU kernels + XLA fallbacks
+- :mod:`rca_tpu.parallel`     mesh / sharding / collective utilities
+- :mod:`rca_tpu.agents`       deterministic + LLM agent families
+- :mod:`rca_tpu.coordinator`  orchestration, chat, suggestions, hypotheses
+- :mod:`rca_tpu.llm`          LLM backend with a real tool-execution loop
+- :mod:`rca_tpu.store`        investigation persistence (file-locked JSON)
+- :mod:`rca_tpu.obslog`       evidence / prompt audit logs
+- :mod:`rca_tpu.ui`           Streamlit UI surface (import-gated)
+"""
+
+from rca_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,22 @@
+"""Cluster data-access layer: one typed client protocol, two backends.
+
+Fixes the reference's real/mock interface skew (reference:
+utils/k8s_client.py vs utils/mock_k8s_client.py — seven methods existed only
+on the mock, and ``get_pod_logs`` argument order differed between definition
+and call sites).  Here there is exactly one :class:`ClusterClient` protocol;
+``MockClusterClient`` and ``K8sApiClient`` both implement it and a
+conformance test asserts the surfaces match.
+"""
+
+from rca_tpu.cluster.protocol import ClusterClient, CLUSTER_CLIENT_METHODS
+from rca_tpu.cluster.world import World
+from rca_tpu.cluster.mock_client import MockClusterClient
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+
+__all__ = [
+    "ClusterClient",
+    "CLUSTER_CLIENT_METHODS",
+    "World",
+    "MockClusterClient",
+    "ClusterSnapshot",
+]

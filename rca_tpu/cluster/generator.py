@@ -1,0 +1,333 @@
+"""Synthetic fault-cascade generators (the scaled benchmark configs).
+
+The reference's scale story stops at a hand-written 5-service mock and a kind
+cluster (reference: utils/mock_k8s_client.py, setup_test_cluster.py).  The
+BASELINE.json configs require 50 / 2k / 10k / 50k-service worlds with known
+ground-truth fault roots, so this module generates them:
+
+- a random service-dependency DAG (each service depends on 1..3
+  earlier services, preferential-attachment flavored so hub services emerge),
+- fault injection at ``n_roots`` services,
+- symptom propagation to transitive dependents with per-hop decay
+  (dependents of a faulty service show timeouts / elevated latency / error
+  rates; the roots themselves show crash loops),
+- two output forms: a full dict :class:`World` (drives the agent layer) and
+  raw numpy arrays (drives the TPU engine / bench directly at 10k-50k scale).
+
+Ground truth is recorded in ``World.ground_truth`` / ``CascadeArrays.roots``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from rca_tpu.cluster.world import (
+    World,
+    make_deployment,
+    make_endpoints,
+    make_event,
+    make_node,
+    make_pod,
+    make_service,
+    pod_metric,
+    waiting_status,
+)
+
+# Feature channel indices for the raw-array form (must match
+# rca_tpu.features.schema SERVICE_FEATURES ordering for the shared channels).
+F_CRASH = 0        # crash-loop / failed-pod signal            [0, 1]
+F_ERROR_RATE = 1   # request error rate                        [0, 1]
+F_LATENCY = 2      # latency degradation (normalized z-ish)    [0, 1]
+F_RESTARTS = 3     # restart pressure (saturating)             [0, 1]
+F_EVENTS = 4       # warning-event pressure                    [0, 1]
+F_LOG_ERRORS = 5   # error-log pattern pressure                [0, 1]
+F_NOT_READY = 6    # unready-endpoint fraction                 [0, 1]
+F_RESOURCE = 7     # cpu/mem saturation                        [0, 1]
+NUM_FEATURES = 8
+
+
+@dataclasses.dataclass
+class CascadeArrays:
+    """Raw-array cascade: the direct input to the TPU engine."""
+
+    n: int
+    # COO edge list, dependency direction: edge (s, d) means service s
+    # depends on service d (faults flow d -> s).
+    dep_src: np.ndarray  # int32 [E] — the dependent
+    dep_dst: np.ndarray  # int32 [E] — the dependency
+    features: np.ndarray  # float32 [n, NUM_FEATURES]
+    roots: np.ndarray  # int32 [n_roots] ground-truth fault roots
+    anomaly: np.ndarray  # float32 [n] scalar anomaly per service
+    names: Optional[List[str]] = None
+
+
+def _build_dag(n: int, rng: np.random.Generator, max_deps: int = 3):
+    """Random layered DAG with preferential attachment; returns (src, dst)."""
+    if n <= 1:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    # weight[i] grows as i acquires dependents -> hub services
+    weights = np.ones(n, dtype=np.float64)
+    src_list: List[np.ndarray] = []
+    dst_list: List[np.ndarray] = []
+    for i in range(1, n):
+        k = int(rng.integers(1, max_deps + 1))
+        k = min(k, i)
+        p = weights[:i] / weights[:i].sum()
+        deps = rng.choice(i, size=k, replace=False, p=p)
+        weights[deps] += 1.0
+        src_list.append(np.full(k, i, dtype=np.int32))
+        dst_list.append(deps.astype(np.int32))
+    return np.concatenate(src_list), np.concatenate(dst_list)
+
+
+def _dependents_adj(n: int, dep_src: np.ndarray, dep_dst: np.ndarray):
+    """dependency -> list of dependents (the direction faults travel)."""
+    adj: List[List[int]] = [[] for _ in range(n)]
+    for s, d in zip(dep_src.tolist(), dep_dst.tolist()):
+        adj[d].append(s)
+    return adj
+
+
+def _bfs_hops(n: int, adj, roots: np.ndarray) -> np.ndarray:
+    """Hop distance from the nearest fault root along dependent edges."""
+    INF = np.iinfo(np.int32).max
+    dist = np.full(n, INF, dtype=np.int64)
+    frontier = list(int(r) for r in roots)
+    for r in frontier:
+        dist[r] = 0
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in adj[u]:
+                if dist[v] > dist[u] + 1:
+                    dist[v] = dist[u] + 1
+                    nxt.append(v)
+        frontier = nxt
+    return dist
+
+
+def synthetic_cascade_arrays(
+    n_services: int,
+    n_roots: int = 1,
+    seed: int = 0,
+    decay: float = 0.75,
+    noise: float = 0.05,
+) -> CascadeArrays:
+    """Generate the raw-array cascade (any scale; used for bench + training)."""
+    rng = np.random.default_rng(seed)
+    dep_src, dep_dst = _build_dag(n_services, rng)
+    adj = _dependents_adj(n_services, dep_src, dep_dst)
+
+    # Prefer roots with real downstream impact (≥1 dependent when possible).
+    impact = np.array([len(a) for a in adj])
+    candidates = np.nonzero(impact > 0)[0]
+    if len(candidates) < n_roots:
+        candidates = np.arange(n_services)
+    roots = rng.choice(candidates, size=min(n_roots, len(candidates)), replace=False)
+    roots = roots.astype(np.int32)
+
+    hops = _bfs_hops(n_services, adj, roots)
+    feats = np.zeros((n_services, NUM_FEATURES), dtype=np.float32)
+
+    background = rng.uniform(0.0, noise, size=(n_services, NUM_FEATURES)).astype(
+        np.float32
+    )
+    feats += background
+
+    is_root = np.zeros(n_services, dtype=bool)
+    is_root[roots] = True
+    affected = (hops < np.iinfo(np.int32).max) & ~is_root
+    aff_idx = np.nonzero(affected)[0]
+    aff_decay = (decay ** hops[aff_idx]).astype(np.float32)
+
+    # Roots: hard failure symptoms.
+    feats[roots, F_CRASH] = rng.uniform(0.85, 1.0, size=len(roots))
+    feats[roots, F_RESTARTS] = rng.uniform(0.7, 1.0, size=len(roots))
+    feats[roots, F_EVENTS] = rng.uniform(0.6, 1.0, size=len(roots))
+    feats[roots, F_LOG_ERRORS] = rng.uniform(0.7, 1.0, size=len(roots))
+    feats[roots, F_NOT_READY] = 1.0
+    feats[roots, F_ERROR_RATE] = rng.uniform(0.5, 1.0, size=len(roots))
+
+    # Dependents: soft degradation decaying with hop distance — crucially, NO
+    # crash signal (they are victims, not causes).
+    jitter = rng.uniform(0.8, 1.0, size=len(aff_idx)).astype(np.float32)
+    feats[aff_idx, F_ERROR_RATE] = 0.7 * aff_decay * jitter
+    feats[aff_idx, F_LATENCY] = 0.8 * aff_decay * jitter
+    feats[aff_idx, F_LOG_ERRORS] = 0.4 * aff_decay * jitter
+    feats[aff_idx, F_EVENTS] = 0.3 * aff_decay * jitter
+
+    anomaly = feats.max(axis=1)
+    names = None
+    if n_services <= 4096:
+        names = [f"svc-{i:05d}" for i in range(n_services)]
+    return CascadeArrays(
+        n=n_services,
+        dep_src=dep_src,
+        dep_dst=dep_dst,
+        features=feats,
+        roots=np.sort(roots),
+        anomaly=anomaly.astype(np.float32),
+        names=names,
+    )
+
+
+def synthetic_cascade_world(
+    n_services: int,
+    n_roots: int = 1,
+    seed: int = 0,
+    namespace: str = "synthetic",
+    pods_per_service: int = 1,
+) -> World:
+    """Generate a full dict-world cascade (drives the agent/coordinator layer).
+
+    Suitable up to a few thousand services; the raw-array form above covers
+    10k-50k scale without dict materialization.
+    """
+    case = synthetic_cascade_arrays(n_services, n_roots, seed)
+    rng = np.random.default_rng(seed + 1)
+    names = [f"svc-{i:05d}" for i in range(n_services)]
+
+    w = World(cluster_name=f"synthetic-{n_services}")
+    n_nodes = max(2, n_services // 50)
+    w.nodes = [make_node(f"node-{i}") for i in range(n_nodes)]
+    w.node_metrics = {
+        f"node-{i}": {
+            "cpu": {"usage_percentage": int(rng.uniform(30, 70))},
+            "memory": {"usage_percentage": int(rng.uniform(30, 70))},
+        }
+        for i in range(n_nodes)
+    }
+
+    root_set = set(case.roots.tolist())
+    hops = _bfs_hops(
+        n_services, _dependents_adj(n_services, case.dep_src, case.dep_dst), case.roots
+    )
+    w.pod_metrics[namespace] = {"pods": {}}
+    w.logs[namespace] = {}
+    events: List[dict] = []
+
+    # deps per service for env-var DNS inference (topology agent input)
+    deps_of: Dict[int, List[int]] = {}
+    for s, d in zip(case.dep_src.tolist(), case.dep_dst.tolist()):
+        deps_of.setdefault(s, []).append(d)
+
+    for i, svc in enumerate(names):
+        faulty = i in root_set
+        degraded = (not faulty) and hops[i] < np.iinfo(np.int32).max
+        env = [
+            {
+                "name": f"DEP_{j}_URL",
+                "value": f"http://{names[d]}.{namespace}.svc.cluster.local:8080",
+            }
+            for j, d in enumerate(deps_of.get(i, []))
+        ]
+        pod_names = []
+        for r in range(pods_per_service):
+            pod_name = f"{svc}-{r}"
+            pod_names.append(pod_name)
+            if faulty:
+                pod = make_pod(
+                    pod_name,
+                    namespace,
+                    svc,
+                    container_statuses=[
+                        waiting_status(
+                            svc,
+                            "CrashLoopBackOff",
+                            "Back-off restarting failed container",
+                            restarts=int(rng.integers(4, 12)),
+                            last_exit_code=1,
+                        )
+                    ],
+                )
+                w.logs[namespace][pod_name] = {
+                    svc: "ERROR: fatal error during startup\n"
+                    "Exception in thread main\nERROR: exiting\n"
+                }
+                events.append(
+                    make_event(
+                        namespace, "Pod", pod_name, "BackOff",
+                        f"Back-off restarting failed container {svc}",
+                        count=int(rng.integers(5, 25)),
+                    )
+                )
+                w.pod_metrics[namespace]["pods"][pod_name] = pod_metric(
+                    5, 20, 200, 128, svc
+                )
+            else:
+                pod = make_pod(pod_name, namespace, svc)
+                if degraded:
+                    w.logs[namespace][pod_name] = {
+                        svc: "WARN: upstream timeout\n"
+                        "ERROR: connection timed out waiting for dependency\n"
+                    }
+                    events.append(
+                        make_event(
+                            namespace, "Pod", pod_name, "Unhealthy",
+                            "Readiness probe failed: upstream dependency timeout",
+                            count=int(rng.integers(1, 6)),
+                        )
+                    )
+                else:
+                    w.logs[namespace][pod_name] = {svc: "INFO: serving\n"}
+                w.pod_metrics[namespace]["pods"][pod_name] = pod_metric(
+                    int(rng.uniform(20, 120)), int(rng.uniform(30, 90)), 200, 128, svc
+                )
+            if env:
+                pod["spec"]["containers"][0].setdefault("env", env)
+            w.add("pods", namespace, pod)
+
+        ready = 0 if faulty else pods_per_service
+        w.add(
+            "deployments",
+            namespace,
+            make_deployment(svc, namespace, svc, pods_per_service, ready),
+        )
+        w.add("services", namespace, make_service(svc, namespace))
+        w.add(
+            "endpoints",
+            namespace,
+            make_endpoints(svc, namespace, [] if faulty else pod_names),
+        )
+
+    w.events[namespace] = events
+
+    # Traces derived from the same ground truth.
+    latency = {}
+    error_rates = {}
+    for i, svc in enumerate(names):
+        if i in root_set:
+            error_rates[svc] = round(float(case.features[i, F_ERROR_RATE]), 3)
+            latency[svc] = {"p50": 50, "p95": 120, "p99": 250}
+        else:
+            error_rates[svc] = round(float(case.features[i, F_ERROR_RATE]), 3)
+            scale = 1.0 + 4.0 * float(case.features[i, F_LATENCY])
+            latency[svc] = {
+                "p50": int(100 * scale),
+                "p95": int(300 * scale),
+                "p99": int(600 * scale),
+            }
+    w.traces = {
+        "trace_ids": {namespace: [f"trace-{i:05d}" for i in range(20)]},
+        "traces": {},
+        "latency": {namespace: latency},
+        "error_rates": {namespace: error_rates},
+        "dependencies": {
+            namespace: {
+                names[s]: sorted(names[d] for d in deps)
+                for s, deps in ((k, v) for k, v in deps_of.items())
+            }
+        },
+        "slow_ops": {namespace: []},
+    }
+
+    w.ground_truth = {
+        "namespace": namespace,
+        "fault_roots": [names[r] for r in case.roots.tolist()],
+        "n_services": n_services,
+        "seed": seed,
+    }
+    return w

@@ -1,0 +1,457 @@
+"""Live-cluster client: kubernetes python lib when available, kubectl fallback.
+
+Implements the same :class:`ClusterClient` protocol as the mock — including
+the trace methods (which return empty structures unless a trace backend is
+configured), so agents never hit AttributeError against a live cluster the
+way the reference's mock-only methods did (reference: utils/mock_k8s_client.py
+:1044-1303 vs utils/k8s_client.py — seven methods existed only on the mock).
+
+Metrics come from ``kubectl top`` subprocess parsing with usage percentages
+computed against container limits, matching the reference's approach
+(reference: utils/k8s_client.py:441-554), and resource-quantity parsing
+covers millicores and the full binary/decimal memory suffix ladder
+(reference: utils/k8s_client.py:886-947).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from rca_tpu.findings import utcnow_iso
+
+try:  # gated: the kubernetes lib is an optional dependency
+    from kubernetes import client as k8s_api
+    from kubernetes import config as k8s_config
+
+    HAVE_K8S_LIB = True
+except Exception:  # pragma: no cover - exercised only without the lib
+    k8s_api = None
+    k8s_config = None
+    HAVE_K8S_LIB = False
+
+
+# ---------------------------------------------------------------------------
+# Resource-quantity parsing
+# ---------------------------------------------------------------------------
+
+_MEM_SUFFIXES = {
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+    "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15, "E": 10**18,
+    "k": 10**3, "m": 1e-3,
+}
+
+
+def parse_cpu(value: Any) -> float:
+    """CPU quantity -> millicores. '100m' -> 100, '2' -> 2000, '1500000n' -> 1.5."""
+    if value is None:
+        return 0.0
+    s = str(value).strip()
+    if not s:
+        return 0.0
+    try:
+        if s.endswith("n"):
+            return float(s[:-1]) / 1e6
+        if s.endswith("u"):
+            return float(s[:-1]) / 1e3
+        if s.endswith("m"):
+            return float(s[:-1])
+        return float(s) * 1000.0
+    except ValueError:
+        return 0.0
+
+
+def parse_memory(value: Any) -> float:
+    """Memory quantity -> bytes. Handles Ki..Ei binary and K..E decimal."""
+    if value is None:
+        return 0.0
+    s = str(value).strip()
+    if not s:
+        return 0.0
+    for suffix in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"):
+        if s.endswith(suffix):
+            try:
+                return float(s[: -len(suffix)]) * _MEM_SUFFIXES[suffix]
+            except ValueError:
+                return 0.0
+    for suffix in ("K", "M", "G", "T", "P", "E", "k", "m"):
+        if s.endswith(suffix):
+            try:
+                return float(s[: -len(suffix)]) * _MEM_SUFFIXES[suffix]
+            except ValueError:
+                return 0.0
+    try:
+        return float(s)
+    except ValueError:
+        return 0.0
+
+
+class K8sApiClient:
+    """Live :class:`ClusterClient` backend."""
+
+    def __init__(
+        self,
+        kubeconfig: Optional[str] = None,
+        context: Optional[str] = None,
+        verify_ssl: bool = True,
+    ):
+        self._connected = False
+        self._core = self._apps = self._net = self._batch = self._autoscaling = None
+        self._kubectl = shutil.which("kubectl")
+        self._kubeconfig = kubeconfig or os.environ.get("KUBECONFIG")
+        if HAVE_K8S_LIB:
+            try:
+                if self._kubeconfig:
+                    k8s_config.load_kube_config(
+                        config_file=self._kubeconfig, context=context
+                    )
+                else:
+                    try:
+                        k8s_config.load_kube_config(context=context)
+                    except Exception:
+                        k8s_config.load_incluster_config()
+                if not verify_ssl:
+                    cfg = k8s_api.Configuration.get_default_copy()
+                    cfg.verify_ssl = False
+                    k8s_api.Configuration.set_default(cfg)
+                self._core = k8s_api.CoreV1Api()
+                self._apps = k8s_api.AppsV1Api()
+                self._net = k8s_api.NetworkingV1Api()
+                self._batch = k8s_api.BatchV1Api()
+                self._autoscaling = k8s_api.AutoscalingV1Api()
+                self._api_client = k8s_api.ApiClient()
+                # connection probe (reference: utils/k8s_client.py:139)
+                self._core.list_namespace(limit=1)
+                self._connected = True
+            except Exception:
+                self._connected = False
+
+    # ---- helpers ---------------------------------------------------------
+    def _sanitize(self, obj: Any) -> Any:
+        return self._api_client.sanitize_for_serialization(obj)
+
+    def _list(self, api, method: str, *args, **kwargs) -> List[dict]:
+        # api object is looked up lazily so disconnected clients (no
+        # kubernetes lib / no cluster) degrade to [] instead of raising.
+        if not self._connected or api is None:
+            return []
+        try:
+            resp = getattr(api, method)(*args, **kwargs)
+            return [self._sanitize(item) for item in resp.items]
+        except Exception:
+            return []
+
+    def _kubectl_json(self, args: List[str]) -> Any:
+        out = self.run_kubectl(args + ["-o", "json"])
+        try:
+            return json.loads(out)
+        except Exception:
+            return None
+
+    # ---- connection / identity -------------------------------------------
+    def is_connected(self) -> bool:
+        return self._connected or self._kubectl is not None
+
+    def get_current_time(self) -> str:
+        return utcnow_iso()
+
+    def get_cluster_info(self) -> Dict[str, Any]:
+        return {
+            "connected": self._connected,
+            "kubeconfig": self._kubeconfig,
+            "nodes": len(self.get_nodes()),
+            "mock": False,
+        }
+
+    def get_namespaces(self) -> List[str]:
+        items = self._list(self._core, "list_namespace") if self._connected else []
+        return [i.get("metadata", {}).get("name", "") for i in items]
+
+    # ---- pods ------------------------------------------------------------
+    def get_pods(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._core, "list_namespaced_pod", namespace)
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        if not self._connected:
+            return None
+        try:
+            return self._sanitize(self._core.read_namespaced_pod(name, namespace))
+        except Exception:
+            return None
+
+    def get_pod_logs(
+        self,
+        namespace: str,
+        pod_name: str,
+        container: Optional[str] = None,
+        previous: bool = False,
+        tail_lines: Optional[int] = None,
+    ) -> str:
+        if not self._connected:
+            return ""
+        try:
+            return self._core.read_namespaced_pod_log(
+                pod_name,
+                namespace,
+                container=container,
+                previous=previous,
+                tail_lines=tail_lines,
+            )
+        except Exception as exc:
+            return f"Error retrieving logs: {exc}"
+
+    def get_recently_terminated_pods(self, namespace: str) -> List[Dict[str, Any]]:
+        out = []
+        for pod in self.get_pods(namespace):
+            for cs in pod.get("status", {}).get("containerStatuses", []) or []:
+                state = cs.get("state") or {}
+                last = cs.get("lastState") or {}
+                if "terminated" in state or "terminated" in last:
+                    out.append(pod)
+                    break
+        return out
+
+    # ---- workloads -------------------------------------------------------
+    def get_deployments(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._apps, "list_namespaced_deployment", namespace)
+
+    def get_deployment(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        if not self._connected:
+            return None
+        try:
+            return self._sanitize(
+                self._apps.read_namespaced_deployment(name, namespace)
+            )
+        except Exception:
+            return None
+
+    def get_statefulsets(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._apps, "list_namespaced_stateful_set", namespace)
+
+    def get_daemonsets(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._apps, "list_namespaced_daemon_set", namespace)
+
+    def get_cronjobs(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._batch, "list_namespaced_cron_job", namespace)
+
+    # ---- services / networking -------------------------------------------
+    def get_services(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._core, "list_namespaced_service", namespace)
+
+    def get_service(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        if not self._connected:
+            return None
+        try:
+            return self._sanitize(self._core.read_namespaced_service(name, namespace))
+        except Exception:
+            return None
+
+    def get_endpoints(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._core, "list_namespaced_endpoints", namespace)
+
+    def get_ingresses(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._net, "list_namespaced_ingress", namespace)
+
+    def get_network_policies(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._net, "list_namespaced_network_policy", namespace)
+
+    # ---- config / storage ------------------------------------------------
+    def get_configmaps(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._core, "list_namespaced_config_map", namespace)
+
+    def get_secrets(self, namespace: str) -> List[Dict[str, Any]]:
+        secrets = self._list(self._core, "list_namespaced_secret", namespace)
+        # redact values (reference: utils/k8s_client.py:693-698)
+        for s in secrets:
+            if isinstance(s.get("data"), dict):
+                s["data"] = {k: "**REDACTED**" for k in s["data"]}
+        return secrets
+
+    def get_pvcs(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(
+            self._core, "list_namespaced_persistent_volume_claim", namespace
+        )
+
+    def get_pvc(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        for p in self.get_pvcs(namespace):
+            if p.get("metadata", {}).get("name") == name:
+                return p
+        return None
+
+    def get_resource_quotas(self, namespace: str) -> List[Dict[str, Any]]:
+        return self._list(self._core, "list_namespaced_resource_quota", namespace)
+
+    # ---- nodes / metrics / autoscaling -----------------------------------
+    def get_nodes(self) -> List[Dict[str, Any]]:
+        if not self._connected:
+            return []
+        return self._list(self._core, "list_node")
+
+    def get_node_metrics(self) -> Dict[str, Any]:
+        """Parse ``kubectl top nodes`` into per-node usage percentages."""
+        out = self.run_kubectl(["top", "nodes", "--no-headers"])
+        metrics: Dict[str, Any] = {}
+        for line in out.splitlines():
+            parts = line.split()
+            # NAME CPU(cores) CPU% MEMORY(bytes) MEMORY%
+            if len(parts) >= 5 and parts[2].endswith("%") and parts[4].endswith("%"):
+                try:
+                    metrics[parts[0]] = {
+                        "cpu": {
+                            "usage": parts[1],
+                            "usage_percentage": float(parts[2].rstrip("%")),
+                        },
+                        "memory": {
+                            "usage": parts[3],
+                            "usage_percentage": float(parts[4].rstrip("%")),
+                        },
+                    }
+                except ValueError:
+                    continue
+        return metrics
+
+    def get_pod_metrics(self, namespace: str) -> Dict[str, Any]:
+        """``kubectl top pods --containers`` joined against container limits."""
+        limits: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for pod in self.get_pods(namespace):
+            pod_name = pod.get("metadata", {}).get("name", "")
+            for c in pod.get("spec", {}).get("containers", []) or []:
+                lim = (c.get("resources") or {}).get("limits") or {}
+                limits.setdefault(pod_name, {})[c["name"]] = {
+                    "cpu_m": parse_cpu(lim.get("cpu")),
+                    "mem_b": parse_memory(lim.get("memory")),
+                }
+        out = self.run_kubectl(
+            ["top", "pods", "-n", namespace, "--containers", "--no-headers"]
+        )
+        pods: Dict[str, Any] = {}
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 4:
+                continue
+            pod_name, container, cpu_s, mem_s = parts[0], parts[1], parts[2], parts[3]
+            cpu_m = parse_cpu(cpu_s)
+            mem_b = parse_memory(mem_s)
+            rec = pods.setdefault(
+                pod_name,
+                {"cpu": {"usage_m": 0.0}, "memory": {"usage_b": 0.0}, "containers": {}},
+            )
+            rec["cpu"]["usage_m"] += cpu_m
+            rec["memory"]["usage_b"] += mem_b
+            entry: Dict[str, Any] = {
+                "cpu": {"usage": cpu_s},
+                "memory": {"usage": mem_s},
+            }
+            lim = limits.get(pod_name, {}).get(container)
+            if lim:
+                if lim["cpu_m"]:
+                    entry["cpu"]["usage_percentage"] = round(
+                        100.0 * cpu_m / lim["cpu_m"], 2
+                    )
+                if lim["mem_b"]:
+                    entry["memory"]["usage_percentage"] = round(
+                        100.0 * mem_b / lim["mem_b"], 2
+                    )
+            rec["containers"][container] = entry
+        # pod-level percentages: max over containers (worst container governs)
+        for rec in pods.values():
+            cpu_pcts = [
+                c["cpu"].get("usage_percentage")
+                for c in rec["containers"].values()
+                if c["cpu"].get("usage_percentage") is not None
+            ]
+            mem_pcts = [
+                c["memory"].get("usage_percentage")
+                for c in rec["containers"].values()
+                if c["memory"].get("usage_percentage") is not None
+            ]
+            if cpu_pcts:
+                rec["cpu"]["usage_percentage"] = max(cpu_pcts)
+            if mem_pcts:
+                rec["memory"]["usage_percentage"] = max(mem_pcts)
+        return {"pods": pods}
+
+    def get_hpas(self, namespace: str) -> List[Dict[str, Any]]:
+        if self._connected:
+            return self._list(
+                self._autoscaling,
+                "list_namespaced_horizontal_pod_autoscaler",
+                namespace,
+            )
+        data = self._kubectl_json(["get", "hpa", "-n", namespace])
+        return (data or {}).get("items", [])
+
+    # ---- events ----------------------------------------------------------
+    def get_events(
+        self, namespace: str, field_selector: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        if not self._connected:
+            return []
+        try:
+            resp = self._core.list_namespaced_event(
+                namespace, field_selector=field_selector
+            )
+            return [self._sanitize(i) for i in resp.items]
+        except Exception:
+            return []
+
+    # ---- traces (no live trace backend wired by default) ------------------
+    def get_trace_ids(self, namespace: str, limit: int = 20) -> List[str]:
+        return []
+
+    def get_trace_details(self, trace_id: str) -> Dict[str, Any]:
+        return {}
+
+    def get_service_latency_stats(self, namespace: str) -> Dict[str, Any]:
+        return {}
+
+    def get_error_rate_by_service(self, namespace: str) -> Dict[str, Any]:
+        return {}
+
+    def get_service_dependencies(self, namespace: str) -> Dict[str, Any]:
+        return {}
+
+    def find_slow_operations(
+        self, namespace: str, threshold_ms: float = 500.0
+    ) -> List[Dict[str, Any]]:
+        return []
+
+    # ---- generic ---------------------------------------------------------
+    _KIND_ALIASES = {
+        "pod": "pod", "deployment": "deployment", "statefulset": "statefulset",
+        "daemonset": "daemonset", "cronjob": "cronjob", "service": "service",
+        "endpoints": "endpoints", "ingress": "ingress",
+        "networkpolicy": "networkpolicy", "configmap": "configmap",
+        "secret": "secret", "persistentvolumeclaim": "pvc", "pvc": "pvc",
+        "resourcequota": "resourcequota", "horizontalpodautoscaler": "hpa",
+        "hpa": "hpa", "node": "node",
+    }
+
+    def get_resource_details(
+        self, namespace: str, kind: str, name: str
+    ) -> Dict[str, Any]:
+        k = self._KIND_ALIASES.get(kind.lower())
+        if k is None:
+            return {"error": f"unsupported resource kind: {kind}"}
+        data = self._kubectl_json(["get", k, name, "-n", namespace])
+        if data is None:
+            return {"error": f"{kind}/{name} not found in namespace {namespace}"}
+        return data
+
+    def run_kubectl(self, args: List[str]) -> str:
+        if not self._kubectl:
+            return "kubectl not available"
+        cmd = [self._kubectl]
+        if self._kubeconfig:
+            cmd += ["--kubeconfig", self._kubeconfig]
+        cmd += args
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=30, check=False
+            )
+            return proc.stdout if proc.returncode == 0 else proc.stderr
+        except Exception as exc:
+            return f"kubectl error: {exc}"

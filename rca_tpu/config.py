@@ -1,0 +1,60 @@
+"""Typed configuration for the framework.
+
+Replaces the reference's ad-hoc env-var reads scattered across modules
+(reference: app.py:45, utils/llm_client_improved.py:41-53) with one frozen
+dataclass resolved once.  The ``RCA_BACKEND`` flag selects the correlation
+engine per the north star: ``jax`` (TPU graph inference, default here),
+``deterministic`` (CPU rule-based oracle), or ``llm`` (provider fusion).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+VALID_BACKENDS = ("jax", "deterministic", "llm")
+
+
+@dataclasses.dataclass(frozen=True)
+class RCAConfig:
+    # Correlation backend: jax | deterministic | llm
+    backend: str = "jax"
+    # LLM provider for the optional LLM paths: anthropic | openai | offline
+    llm_provider: str = "offline"
+    # Where investigations / evidence / prompt logs are persisted
+    log_dir: str = "logs"
+    # Kubeconfig path for the live-cluster client
+    kubeconfig: Optional[str] = None
+    # Default namespace when the caller does not pass one
+    namespace: str = "default"
+    # Engine knobs
+    propagation_steps: int = 8
+    top_k_root_causes: int = 5
+    # Shape-bucket tiers for jit recompilation control (padded node counts)
+    shape_buckets: tuple = (64, 256, 1024, 4096, 16384, 65536)
+
+    def __post_init__(self):
+        if self.backend not in VALID_BACKENDS:
+            raise ValueError(
+                f"backend must be one of {VALID_BACKENDS}, got {self.backend!r}"
+            )
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RCAConfig":
+        env = {
+            "backend": os.environ.get("RCA_BACKEND", "jax"),
+            "llm_provider": os.environ.get("LLM_PROVIDER", "offline"),
+            "log_dir": os.environ.get("RCA_LOG_DIR", "logs"),
+            "kubeconfig": os.environ.get("KUBECONFIG"),
+        }
+        env.update(overrides)
+        return cls(**env)
+
+
+def bucket_for(n: int, buckets) -> int:
+    """Smallest shape bucket ≥ n (controls jit recompilation)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(n)

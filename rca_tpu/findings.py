@@ -1,0 +1,102 @@
+"""Finding / reasoning-step data model shared by every agent and the engine.
+
+Schema parity with the reference's finding dicts
+(reference: agents/base_agent.py:33-52 — ``{component, issue, severity,
+evidence, recommendation, timestamp}``) and its severity ladder
+(reference: agents/coordinator.py:148 — info < low < medium < high < critical).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional
+
+SEVERITY_ORDER: List[str] = ["info", "low", "medium", "high", "critical"]
+SEVERITY_RANK: Dict[str, int] = {s: i for i, s in enumerate(SEVERITY_ORDER)}
+
+
+def severity_rank(severity: str) -> int:
+    """Rank of a severity string; unknown severities rank below ``info``."""
+    return SEVERITY_RANK.get(str(severity).lower(), -1)
+
+
+def max_severity(severities) -> str:
+    """Highest severity in an iterable (defaults to ``info`` when empty)."""
+    best = "info"
+    for s in severities:
+        if severity_rank(s) > severity_rank(best):
+            best = s
+    return best
+
+
+def utcnow_iso() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def make_finding(
+    component: str,
+    issue: str,
+    severity: str,
+    evidence: Any,
+    recommendation: str,
+    timestamp: Optional[str] = None,
+    **extra: Any,
+) -> Dict[str, Any]:
+    finding = {
+        "component": component,
+        "issue": issue,
+        "severity": severity,
+        "evidence": evidence,
+        "recommendation": recommendation,
+        "timestamp": timestamp or utcnow_iso(),
+    }
+    finding.update(extra)
+    return finding
+
+
+def make_reasoning_step(
+    observation: str, conclusion: str, timestamp: Optional[str] = None
+) -> Dict[str, str]:
+    return {
+        "observation": observation,
+        "conclusion": conclusion,
+        "timestamp": timestamp or utcnow_iso(),
+    }
+
+
+class FindingsMixin:
+    """Accumulates findings + reasoning steps (the agent result contract)."""
+
+    def __init__(self) -> None:
+        self.findings: List[Dict[str, Any]] = []
+        self.reasoning_steps: List[Dict[str, str]] = []
+
+    def add_finding(
+        self,
+        component: str,
+        issue: str,
+        severity: str,
+        evidence: Any,
+        recommendation: str,
+        **extra: Any,
+    ) -> Dict[str, Any]:
+        finding = make_finding(
+            component, issue, severity, evidence, recommendation, **extra
+        )
+        self.findings.append(finding)
+        return finding
+
+    def add_reasoning_step(self, observation: str, conclusion: str) -> None:
+        self.reasoning_steps.append(make_reasoning_step(observation, conclusion))
+
+    def get_results(self) -> Dict[str, Any]:
+        return {
+            "findings": self.findings,
+            "reasoning_steps": self.reasoning_steps,
+        }
+
+    def reset(self) -> None:
+        self.findings = []
+        self.reasoning_steps = []

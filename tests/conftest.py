@@ -1,0 +1,30 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+imports anywhere, so sharding/collective tests run hermetically without TPU
+hardware (the driver separately dry-run-compiles the multi-chip path)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from rca_tpu.cluster.fixtures import five_service_world  # noqa: E402
+from rca_tpu.cluster.generator import synthetic_cascade_world  # noqa: E402
+from rca_tpu.cluster.mock_client import MockClusterClient  # noqa: E402
+
+
+@pytest.fixture()
+def five_svc_client() -> MockClusterClient:
+    return MockClusterClient(five_service_world())
+
+
+@pytest.fixture(scope="session")
+def fifty_svc_client() -> MockClusterClient:
+    return MockClusterClient(
+        synthetic_cascade_world(50, n_roots=1, seed=7, namespace="synthetic")
+    )

@@ -1,0 +1,113 @@
+"""Cluster-layer tests: protocol conformance (kills the reference's real/mock
+interface skew, SURVEY.md §2.6), fixture content, generator ground truth."""
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster import CLUSTER_CLIENT_METHODS, ClusterClient, MockClusterClient
+from rca_tpu.cluster.fixtures import NS, five_service_world
+from rca_tpu.cluster.generator import synthetic_cascade_arrays, synthetic_cascade_world
+from rca_tpu.cluster.k8s_client import K8sApiClient, parse_cpu, parse_memory
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+
+
+def test_protocol_has_full_surface():
+    # the union surface incl. the methods that were mock-only in the reference
+    for m in [
+        "get_pods", "get_pod_logs", "get_events", "get_statefulsets",
+        "get_endpoints", "get_service", "get_deployment", "get_resource_quotas",
+        "get_trace_ids", "get_pvc", "get_hpas", "get_node_metrics",
+    ]:
+        assert m in CLUSTER_CLIENT_METHODS
+
+
+@pytest.mark.parametrize("cls", [MockClusterClient, K8sApiClient])
+def test_backends_conform_to_protocol(cls):
+    for m in CLUSTER_CLIENT_METHODS:
+        assert callable(getattr(cls, m, None)), f"{cls.__name__} missing {m}"
+
+
+def test_mock_isinstance_protocol(five_svc_client):
+    assert isinstance(five_svc_client, ClusterClient)
+
+
+def test_five_service_fixture_faults(five_svc_client):
+    c = five_svc_client
+    pods = c.get_pods(NS)
+    assert len(pods) == 6
+    phases = {p["metadata"]["name"]: p["status"]["phase"] for p in pods}
+    assert phases["api-gateway-6b7c8d9e5f-4q3zx"] == "Failed"
+    db = c.get_pod(NS, "database-7c9f8b6d5e-3x5qp")
+    cs = db["status"]["containerStatuses"][0]
+    assert cs["state"]["waiting"]["reason"] == "CrashLoopBackOff"
+    assert cs["restartCount"] == 5
+    # broken services expose no endpoints
+    eps = {e["metadata"]["name"]: e["subsets"] for e in c.get_endpoints(NS)}
+    assert eps["database"] == [] and eps["api-gateway"] == []
+    assert eps["frontend"]
+    # events filtered by field selector
+    warn = c.get_events(NS, field_selector="type!=Normal")
+    assert all(e["type"] == "Warning" for e in warn)
+    pod_events = c.get_events(
+        NS,
+        field_selector="involvedObject.kind=Pod,"
+        "involvedObject.name=database-7c9f8b6d5e-3x5qp",
+    )
+    assert len(pod_events) == 1 and pod_events[0]["reason"] == "BackOff"
+    # logs (namespace-first canonical arg order) + tail
+    logs = c.get_pod_logs(NS, "database-7c9f8b6d5e-3x5qp", tail_lines=2)
+    assert len(logs.splitlines()) == 2
+    # metrics carry usage percentages computed against limits
+    pm = c.get_pod_metrics(NS)["pods"]
+    assert pm["backend-5b6d8f9c7d-2zf8g"]["cpu"]["usage_percentage"] == 95.0
+    assert pm["resource-service-9d8e7f6c5b-1r5wq"]["memory"]["usage_percentage"] > 85
+
+
+def test_snapshot_capture(five_svc_client):
+    snap = ClusterSnapshot.capture(five_svc_client, NS)
+    assert len(snap.pods) == 6
+    assert len(snap.services) == 5
+    assert snap.traces["error_rates"]["api-gateway"] == 0.25
+    # logs captured for every pod (unhealthy prioritized)
+    assert "database-7c9f8b6d5e-3x5qp" in snap.logs
+
+
+def test_generator_arrays_ground_truth():
+    case = synthetic_cascade_arrays(200, n_roots=3, seed=1)
+    assert case.features.shape == (200, 8)
+    assert len(case.roots) == 3
+    # roots carry a crash signal, non-roots essentially none
+    crash = case.features[:, 0]
+    root_mask = np.zeros(200, bool)
+    root_mask[case.roots] = True
+    assert crash[root_mask].min() > 0.8
+    assert crash[~root_mask].max() < 0.2
+    # DAG property: every dependency edge points to an earlier service
+    assert (case.dep_dst < case.dep_src).all()
+
+
+def test_generator_world_consistency():
+    w = synthetic_cascade_world(50, n_roots=1, seed=7)
+    client = MockClusterClient(w)
+    ns = w.ground_truth["namespace"]
+    root = w.ground_truth["fault_roots"][0]
+    pods = client.get_pods(ns)
+    assert len(pods) == 50
+    root_pod = client.get_pod(ns, f"{root}-0")
+    state = root_pod["status"]["containerStatuses"][0]["state"]
+    assert state["waiting"]["reason"] == "CrashLoopBackOff"
+    # faulty service has no endpoints; an event was recorded for its pod
+    eps = {e["metadata"]["name"]: e["subsets"] for e in client.get_endpoints(ns)}
+    assert eps[root] == []
+    reasons = {e["reason"] for e in client.get_events(ns)}
+    assert "BackOff" in reasons
+
+
+def test_quantity_parsers():
+    assert parse_cpu("100m") == 100.0
+    assert parse_cpu("2") == 2000.0
+    assert parse_cpu("1500000n") == 1.5
+    assert parse_memory("128Mi") == 128 * 2**20
+    assert parse_memory("1Gi") == 2**30
+    assert parse_memory("1G") == 10**9
+    assert parse_memory("500K") == 500_000.0
