@@ -36,17 +36,19 @@ from rca_tpu.cluster.world import (
     waiting_status,
 )
 
-# Feature channel indices for the raw-array form (must match
-# rca_tpu.features.schema SERVICE_FEATURES ordering for the shared channels).
-F_CRASH = 0        # crash-loop / failed-pod signal            [0, 1]
-F_ERROR_RATE = 1   # request error rate                        [0, 1]
-F_LATENCY = 2      # latency degradation (normalized z-ish)    [0, 1]
-F_RESTARTS = 3     # restart pressure (saturating)             [0, 1]
-F_EVENTS = 4       # warning-event pressure                    [0, 1]
-F_LOG_ERRORS = 5   # error-log pattern pressure                [0, 1]
-F_NOT_READY = 6    # unready-endpoint fraction                 [0, 1]
-F_RESOURCE = 7     # cpu/mem saturation                        [0, 1]
-NUM_FEATURES = 8
+# Feature channels shared with the extractor (rca_tpu.features.schema.SvcF);
+# generated cascades and extracted worlds feed the same engine arrays.
+from rca_tpu.features.schema import NUM_SERVICE_FEATURES as NUM_FEATURES  # noqa: E402
+from rca_tpu.features.schema import SvcF  # noqa: E402
+
+F_CRASH = int(SvcF.CRASH)
+F_ERROR_RATE = int(SvcF.ERROR_RATE)
+F_LATENCY = int(SvcF.LATENCY)
+F_RESTARTS = int(SvcF.RESTARTS)
+F_EVENTS = int(SvcF.EVENTS)
+F_LOG_ERRORS = int(SvcF.LOG_ERRORS)
+F_NOT_READY = int(SvcF.NOT_READY)
+F_RESOURCE = int(SvcF.RESOURCE)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,35 @@
+"""The TPU causal-inference core.
+
+Replaces the reference's evidence-fusion step — a serial flatten-and-prompt
+LLM call (reference: agents/mcp_coordinator.py:666-760) and the legacy
+group-by-component heuristic (reference: agents/coordinator.py:118-184) —
+with a jit-compiled explain-away propagation over the service-dependency
+graph:
+
+1. per-service anomaly from fused features (noisy-OR over channels),
+2. upstream hard-failure signal propagated dependency→dependent
+   (``lax.scan`` of segment-max steps) — a service whose dependency is
+   crashed has its own anomaly *explained away*,
+3. downstream impact accumulated dependent→dependency (segment-sum steps) —
+   a faulty service with many symptomatic dependents ranks higher,
+4. root score = (anomaly + impact bonus) × (1 − explained-away), top-k ranked.
+
+Everything is static-shaped (bucketed padding) and compiles once per bucket.
+"""
+
+from rca_tpu.engine.propagate import (
+    PropagationParams,
+    default_params,
+    propagate,
+    propagate_jit,
+)
+from rca_tpu.engine.runner import EngineResult, GraphEngine
+
+__all__ = [
+    "PropagationParams",
+    "default_params",
+    "propagate",
+    "propagate_jit",
+    "EngineResult",
+    "GraphEngine",
+]

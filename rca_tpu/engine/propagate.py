@@ -1,0 +1,136 @@
+"""jit-compiled explain-away propagation over the service graph.
+
+Pure functional core: fixed shapes, ``lax.scan`` for the propagation steps
+(no data-dependent Python control flow), segment scatter ops that XLA lowers
+to efficient TPU scatters.  Padded slots carry zero features and self-edges
+on the dummy node, so no masking is needed anywhere.
+
+Math (S services, E dependency edges (s → d) meaning "s depends on d"):
+
+    a  = 1 - ∏_c (1 - w_c f_c)            anomaly evidence (noisy-OR)
+    h  = 1 - ∏_c (1 - v_c f_c)            hard "I am broken" evidence
+    u_s = max_{(s,d)} max(h_d, γ·u_d)     upstream explanation (K steps)
+    m_d = Σ_{(s,d)} (a_s + γ·m_s)         downstream impact     (K steps)
+    score = (a + β·tanh(m/4)) · (1 - μ·u)
+
+A root cause is a service with strong hard evidence, no broken upstream
+dependency, and many symptomatic dependents — exactly the ranking the
+reference asked its LLM for ("identify causal relationships, rank root
+causes", reference: mcp_coordinator.py:698-733), computed in microseconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rca_tpu.features.schema import NUM_SERVICE_FEATURES, SvcF
+
+
+@dataclasses.dataclass(frozen=True)
+class PropagationParams:
+    anomaly_weights: tuple       # per-channel weights for a
+    hard_weights: tuple          # per-channel weights for h
+    steps: int = 8               # propagation iterations (graph diameter cap)
+    decay: float = 0.7           # γ per-hop decay
+    explain_strength: float = 0.85  # μ suppression by an anomalous upstream
+    impact_bonus: float = 0.5    # β downstream-impact bonus
+
+    def weight_arrays(self):
+        return (
+            jnp.asarray(self.anomaly_weights, dtype=jnp.float32),
+            jnp.asarray(self.hard_weights, dtype=jnp.float32),
+        )
+
+
+def default_params(steps: int = 8) -> PropagationParams:
+    aw = np.zeros(NUM_SERVICE_FEATURES, dtype=np.float32)
+    aw[SvcF.CRASH] = 1.0
+    aw[SvcF.ERROR_RATE] = 0.7
+    aw[SvcF.LATENCY] = 0.5
+    aw[SvcF.RESTARTS] = 0.6
+    aw[SvcF.EVENTS] = 0.4
+    aw[SvcF.LOG_ERRORS] = 0.5
+    aw[SvcF.NOT_READY] = 0.6
+    aw[SvcF.RESOURCE] = 0.5
+    aw[SvcF.IMAGE] = 0.9
+    aw[SvcF.CONFIG] = 0.9
+    aw[SvcF.PENDING] = 0.7
+    aw[SvcF.OOM] = 0.95
+    hw = np.zeros(NUM_SERVICE_FEATURES, dtype=np.float32)
+    hw[SvcF.CRASH] = 1.0
+    hw[SvcF.IMAGE] = 0.9
+    hw[SvcF.CONFIG] = 0.9
+    hw[SvcF.PENDING] = 0.6
+    hw[SvcF.OOM] = 0.95
+    hw[SvcF.RESTARTS] = 0.4
+    return PropagationParams(
+        anomaly_weights=tuple(float(x) for x in aw),
+        hard_weights=tuple(float(x) for x in hw),
+        steps=steps,
+    )
+
+
+def _noisy_or(features: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    clipped = jnp.clip(features, 0.0, 1.0)
+    return 1.0 - jnp.prod(1.0 - clipped * weights[None, :], axis=1)
+
+
+def propagate(
+    features: jnp.ndarray,  # [S, C] float32
+    dep_src: jnp.ndarray,   # [E] int32 — the dependent
+    dep_dst: jnp.ndarray,   # [E] int32 — the dependency
+    anomaly_w: jnp.ndarray,  # [C]
+    hard_w: jnp.ndarray,     # [C]
+    steps: int,
+    decay: float,
+    explain_strength: float,
+    impact_bonus: float,
+):
+    """Returns (anomaly, hard, upstream, impact, score), all [S]."""
+    a = _noisy_or(features, anomaly_w)
+    h = _noisy_or(features, hard_w)
+
+    def up_step(u, _):
+        vals = jnp.maximum(h[dep_dst], decay * u[dep_dst])
+        u_new = jnp.zeros_like(u).at[dep_src].max(vals)
+        return jnp.maximum(u, u_new), None
+
+    u, _ = jax.lax.scan(up_step, jnp.zeros_like(a), None, length=steps)
+
+    def imp_step(m, _):
+        vals = a[dep_src] + decay * m[dep_src]
+        return jnp.zeros_like(m).at[dep_dst].add(vals), None
+
+    m, _ = jax.lax.scan(imp_step, jnp.zeros_like(a), None, length=steps)
+
+    # Explain-away suppresses *soft* symptoms (latency, error rates) that an
+    # anomalous upstream accounts for, damped by the node's own hard
+    # evidence: a crashed service is a cause in its own right even when a
+    # dependency is also broken (concurrent-root cascades).
+    score = (a + impact_bonus * jnp.tanh(m / 4.0)) * (
+        1.0 - explain_strength * u * (1.0 - h)
+    )
+    return a, h, u, m, score
+
+
+@functools.partial(
+    jax.jit, static_argnames=("steps", "decay", "explain_strength", "impact_bonus")
+)
+def propagate_jit(
+    features, dep_src, dep_dst, anomaly_w, hard_w,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+):
+    return propagate(
+        features, dep_src, dep_dst, anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k_scores(score: jnp.ndarray, k: int):
+    return jax.lax.top_k(score, k)

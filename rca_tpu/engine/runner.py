@@ -1,0 +1,160 @@
+"""GraphEngine: bucketing, device transfer, compile caching, ranking.
+
+The host-side wrapper around :mod:`rca_tpu.engine.propagate`: pads node/edge
+arrays to shape buckets (so jit compiles once per tier, not per graph —
+recompilation control per SURVEY.md §7 "hard parts"), keeps arrays on device,
+and renders ranked root causes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rca_tpu.config import RCAConfig, bucket_for
+from rca_tpu.engine.propagate import (
+    PropagationParams,
+    default_params,
+    propagate_jit,
+    top_k_scores,
+)
+from rca_tpu.features.extract import FeatureSet, extract_features
+from rca_tpu.graph.build import service_dependency_edges
+
+
+@dataclasses.dataclass
+class EngineResult:
+    service_names: List[str]
+    ranked: List[dict]            # [{component, score, anomaly, ...}] desc
+    anomaly: np.ndarray           # [S]
+    upstream: np.ndarray          # [S]
+    impact: np.ndarray            # [S]
+    score: np.ndarray             # [S]
+    latency_ms: float             # device compute wall time (post-compile)
+    n_services: int
+    n_edges: int
+
+    def top_components(self, k: Optional[int] = None) -> List[str]:
+        items = self.ranked if k is None else self.ranked[:k]
+        return [r["component"] for r in items]
+
+
+class GraphEngine:
+    """Bucketed, compile-cached causal propagation."""
+
+    def __init__(
+        self,
+        config: Optional[RCAConfig] = None,
+        params: Optional[PropagationParams] = None,
+    ):
+        self.config = config or RCAConfig()
+        self.params = params or default_params(self.config.propagation_steps)
+        self._aw, self._hw = self.params.weight_arrays()
+
+    # -- shaping -----------------------------------------------------------
+    def _pad(self, features: np.ndarray, src: np.ndarray, dst: np.ndarray):
+        n = features.shape[0]
+        # reserve one dummy slot so padded edges can self-loop harmlessly
+        n_pad = bucket_for(n + 1, self.config.shape_buckets)
+        e_pad = bucket_for(max(len(src), 1), self.config.shape_buckets)
+        dummy = n_pad - 1
+        f = np.zeros((n_pad, features.shape[1]), dtype=np.float32)
+        f[:n] = features
+        s = np.full(e_pad, dummy, dtype=np.int32)
+        d = np.full(e_pad, dummy, dtype=np.int32)
+        s[: len(src)] = src
+        d[: len(dst)] = dst
+        return f, s, d
+
+    # -- core --------------------------------------------------------------
+    def analyze_arrays(
+        self,
+        features: np.ndarray,
+        dep_src: np.ndarray,
+        dep_dst: np.ndarray,
+        names: Optional[Sequence[str]] = None,
+        k: Optional[int] = None,
+        timed: bool = False,
+    ) -> EngineResult:
+        n = features.shape[0]
+        k = k or min(self.config.top_k_root_causes, n)
+        f, s, d = self._pad(features, dep_src, dep_dst)
+        fj, sj, dj = jnp.asarray(f), jnp.asarray(s), jnp.asarray(d)
+        p = self.params
+
+        def run():
+            a, h, u, m, score = propagate_jit(
+                fj, sj, dj, self._aw, self._hw,
+                p.steps, p.decay, p.explain_strength, p.impact_bonus,
+            )
+            vals, idx = top_k_scores(score, min(k + 8, f.shape[0]))
+            return a, u, m, score, vals, idx
+
+        if timed:
+            run()[3].block_until_ready()  # warm the compile cache
+            reps = []
+            for _ in range(10):
+                t0 = time.perf_counter()
+                a, u, m, score, vals, idx = run()
+                idx.block_until_ready()
+                reps.append((time.perf_counter() - t0) * 1e3)
+            latency_ms = float(np.median(reps))
+        else:
+            t0 = time.perf_counter()
+            a, u, m, score, vals, idx = run()
+            idx.block_until_ready()
+            latency_ms = (time.perf_counter() - t0) * 1e3
+
+        a, u, m, score = (np.asarray(x)[:n] for x in (a, u, m, score))
+        idx = np.asarray(idx)
+        vals = np.asarray(vals)
+        names = list(names) if names is not None else [f"svc-{i}" for i in range(n)]
+        ranked = []
+        for j, i in enumerate(idx.tolist()):
+            if i >= n or len(ranked) >= k:
+                continue
+            ranked.append(
+                {
+                    "component": names[i],
+                    "score": float(vals[j]),
+                    "anomaly": float(a[i]),
+                    "explained_by_upstream": float(u[i]),
+                    "downstream_impact": float(m[i]),
+                }
+            )
+        return EngineResult(
+            service_names=names,
+            ranked=ranked,
+            anomaly=a,
+            upstream=u,
+            impact=m,
+            score=score,
+            latency_ms=latency_ms,
+            n_services=n,
+            n_edges=int(len(dep_src)),
+        )
+
+    # -- convenience entry points ------------------------------------------
+    def analyze_case(self, case, k: Optional[int] = None, timed: bool = False):
+        """Analyze a :class:`rca_tpu.cluster.generator.CascadeArrays`."""
+        return self.analyze_arrays(
+            case.features, case.dep_src, case.dep_dst, case.names, k=k, timed=timed
+        )
+
+    def analyze_snapshot(self, snapshot, k: Optional[int] = None) -> EngineResult:
+        fs = extract_features(snapshot)
+        src, dst = service_dependency_edges(snapshot, fs)
+        return self.analyze_features(fs, src, dst, k=k)
+
+    def analyze_features(
+        self, fs: FeatureSet, src: np.ndarray, dst: np.ndarray,
+        k: Optional[int] = None,
+    ) -> EngineResult:
+        return self.analyze_arrays(
+            fs.service_features, src, dst, fs.service_names, k=k
+        )

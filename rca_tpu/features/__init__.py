@@ -1,0 +1,32 @@
+"""Vectorized feature extraction: snapshot → padded device-ready arrays.
+
+Replaces the reference's per-pod Python dict crunching (reference:
+agents/resource_analyzer.py:275-351 pod bucketing, agents/metrics_agent.py
+threshold loops, agents/logs_agent.py per-container regex scans, and the
+chat-path hot loop at agents/mcp_coordinator.py:1205-1241) with one pass
+that packs every signal into numpy arrays ready for ``jnp`` transfer.
+"""
+
+from rca_tpu.features.logscan import (
+    LOG_PATTERNS,
+    LOG_PATTERN_NAMES,
+    pattern_recommendation,
+    pattern_severity,
+    scan_text,
+)
+from rca_tpu.features.schema import PodF, SvcF, POD_FEATURE_NAMES, SERVICE_FEATURE_NAMES
+from rca_tpu.features.extract import FeatureSet, extract_features
+
+__all__ = [
+    "LOG_PATTERNS",
+    "LOG_PATTERN_NAMES",
+    "pattern_recommendation",
+    "pattern_severity",
+    "scan_text",
+    "PodF",
+    "SvcF",
+    "POD_FEATURE_NAMES",
+    "SERVICE_FEATURE_NAMES",
+    "FeatureSet",
+    "extract_features",
+]

@@ -1,0 +1,72 @@
+"""Feature-channel schemas for the packed pod and service arrays.
+
+Pod channels encode the signals the reference's rule agents read one dict at
+a time (reference: agents/resource_analyzer.py:275-351 status buckets,
+agents/metrics_agent.py:88-151 utilization thresholds, agents/events_agent.py
+:292-328 event counts).  Service channels are the fused per-service signal
+vector the causal engine propagates; the first 8 match
+:mod:`rca_tpu.cluster.generator`'s synthetic channels so generated cascades
+and extracted worlds feed the same engine.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from rca_tpu.features.logscan import LOG_PATTERN_NAMES
+
+
+class PodF(enum.IntEnum):
+    """Pod-level feature channels (float32)."""
+
+    PHASE_PENDING = 0
+    PHASE_RUNNING = 1
+    PHASE_SUCCEEDED = 2
+    PHASE_FAILED = 3
+    PHASE_UNKNOWN = 4
+    NOT_READY = 5          # any container not ready
+    RESTARTS = 6           # raw restart count
+    RESTARTS_SAT = 7       # 1 - exp(-restarts/5), saturating
+    WAIT_CRASHLOOP = 8
+    WAIT_IMAGEPULL = 9
+    WAIT_CONFIG = 10       # CreateContainerConfigError family
+    WAIT_OTHER = 11
+    TERM_NONZERO = 12      # terminated (current or last) with exit code != 0
+    TERM_OOM = 13          # terminated with reason OOMKilled
+    INIT_FAILED = 14       # failing init container
+    CPU_PCT = 15           # cpu usage / limit, 0..1+
+    MEM_PCT = 16           # mem usage / limit, 0..1+
+    WARN_EVENTS = 17       # warning-event count for this pod
+    WARN_EVENTS_SAT = 18   # min(1, count/10)
+    NO_LOGS = 19           # running but produced no logs
+    LOG0 = 20              # first of the 13 log-pattern count channels
+
+
+NUM_POD_FEATURES = int(PodF.LOG0) + len(LOG_PATTERN_NAMES)
+
+POD_FEATURE_NAMES = [f.name.lower() for f in PodF if f != PodF.LOG0] + [
+    f"log_{n}" for n in LOG_PATTERN_NAMES
+]
+
+
+class SvcF(enum.IntEnum):
+    """Service-level feature channels (float32). First 8 mirror
+    rca_tpu.cluster.generator channel order."""
+
+    CRASH = 0        # crash/failed-pod fraction
+    ERROR_RATE = 1   # trace error rate 0..1
+    LATENCY = 2      # latency degradation score 0..1
+    RESTARTS = 3     # saturating restart pressure
+    EVENTS = 4       # saturating warning-event pressure
+    LOG_ERRORS = 5   # saturating error-log pressure
+    NOT_READY = 6    # unready pod / missing endpoint fraction
+    RESOURCE = 7     # cpu/mem saturation 0..1
+    IMAGE = 8        # image-pull failure fraction
+    CONFIG = 9       # config/secret reference failure signal
+    PENDING = 10     # unschedulable/pending fraction
+    OOM = 11         # OOM-kill signal
+
+
+NUM_SERVICE_FEATURES = len(SvcF)
+
+SERVICE_FEATURE_NAMES = [f.name.lower() for f in SvcF]

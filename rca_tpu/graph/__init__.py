@@ -1,0 +1,35 @@
+"""Topology construction: snapshot → typed COO/CSR arrays.
+
+Array-native replacement for the reference's networkx DiGraph topology
+(reference: agents/topology_agent.py:94-260) — same edge semantics
+(selects / routes / mounts / env_from / env_var / depends_on), emitted as
+index arrays so the engine can propagate on device, plus deterministic
+analyses (cycles, longest chain, SPOF, isolated nodes) reimplemented on the
+array form with better asymptotics.
+"""
+
+from rca_tpu.graph.build import (
+    EdgeType,
+    NodeType,
+    TypedGraph,
+    build_typed_graph,
+    service_dependency_edges,
+)
+from rca_tpu.graph.analysis import (
+    betweenness_centrality,
+    find_cycles,
+    isolated_nodes,
+    longest_dependency_chain,
+)
+
+__all__ = [
+    "EdgeType",
+    "NodeType",
+    "TypedGraph",
+    "build_typed_graph",
+    "service_dependency_edges",
+    "betweenness_centrality",
+    "find_cycles",
+    "isolated_nodes",
+    "longest_dependency_chain",
+]

@@ -1,0 +1,14 @@
+"""Device-mesh parallelism: sharded propagation, mesh helpers.
+
+The reference has no distributed backend at all (SURVEY.md §2.9); this
+package is the TPU-native scaling layer: node-sharded sparse propagation via
+``shard_map`` with XLA collectives (all_gather / psum_scatter) riding ICI,
+data-parallel hypothesis batching over the 'dp' axis, and mesh construction
+helpers shared by the engine, the trainer, and the driver's multi-chip dry
+run.
+"""
+
+from rca_tpu.parallel.mesh import make_mesh
+from rca_tpu.parallel.sharded import ShardedGraph, shard_graph, sharded_propagate
+
+__all__ = ["make_mesh", "ShardedGraph", "shard_graph", "sharded_propagate"]

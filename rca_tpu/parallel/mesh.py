@@ -1,0 +1,32 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axis_sizes: Sequence[Tuple[str, int]],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh from (axis, size) pairs, e.g. [("dp", 2), ("sp", 4)].
+
+    Sizes must multiply to the device count used.  Axis order follows the
+    argument order; lay fast-communicating axes (sp) innermost so their
+    collectives ride ICI neighbors.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = tuple(a for a, _ in axis_sizes)
+    sizes = tuple(s for _, s in axis_sizes)
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh needs {total} devices for axes {axis_sizes}, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(grid, names)

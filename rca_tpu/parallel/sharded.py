@@ -1,0 +1,155 @@
+"""Node-sharded sparse propagation via shard_map + XLA collectives.
+
+The scaling analog of ring attention for this workload (SURVEY.md §5): the
+service graph's node arrays are sharded across the 'sp' mesh axis, each
+device owns a contiguous node block plus the edge partition whose *sources*
+live in its block, and every propagation step exchanges cross-shard state
+with collectives riding ICI:
+
+- upstream explain-away (segment-max):  ``all_gather`` the per-block signal,
+  gather per-edge values locally, scatter-max into the local block;
+- downstream impact (segment-sum): compute full-length contributions
+  locally, ``psum_scatter`` so each device receives exactly its reduced
+  block (reduce-scatter, no full materialization on any hop).
+
+Hypothesis batches shard over 'dp' (the BASELINE.json "pmap over fault
+candidates" config) — 2-axis mesh, one jit.
+
+Padded edges carry mask 0 and contribute exactly 0 to both max and sum (all
+signals are nonnegative), so no special dummy nodes are needed per shard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rca_tpu.engine.propagate import PropagationParams, _noisy_or
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedGraph:
+    """Edge partition for an sp-way node sharding."""
+
+    n_pad: int                 # padded node count (multiple of sp)
+    block: int                 # nodes per shard = n_pad // sp
+    sp: int
+    src_local: np.ndarray      # int32 [sp, e_pad] — src index within block
+    src_global: np.ndarray     # int32 [sp, e_pad]
+    dst_global: np.ndarray     # int32 [sp, e_pad]
+    mask: np.ndarray           # float32 [sp, e_pad] — 1 real, 0 padding
+
+
+def shard_graph(
+    n: int, src: np.ndarray, dst: np.ndarray, sp: int
+) -> ShardedGraph:
+    """Partition edges by source-node shard; pad shards to equal length."""
+    block = -(-max(n, 1) // sp)  # ceil
+    n_pad = block * sp
+    shard_of = (src // block).astype(np.int64) if len(src) else np.zeros(0, np.int64)
+    per_shard = [np.nonzero(shard_of == k)[0] for k in range(sp)]
+    e_pad = max(1, max((len(ix) for ix in per_shard), default=1))
+    src_local = np.zeros((sp, e_pad), dtype=np.int32)
+    src_global = np.zeros((sp, e_pad), dtype=np.int32)
+    dst_global = np.zeros((sp, e_pad), dtype=np.int32)
+    mask = np.zeros((sp, e_pad), dtype=np.float32)
+    for k, ix in enumerate(per_shard):
+        m = len(ix)
+        if m:
+            src_global[k, :m] = src[ix]
+            src_local[k, :m] = src[ix] - k * block
+            dst_global[k, :m] = dst[ix]
+            mask[k, :m] = 1.0
+    return ShardedGraph(
+        n_pad=n_pad, block=block, sp=sp,
+        src_local=src_local, src_global=src_global,
+        dst_global=dst_global, mask=mask,
+    )
+
+
+def _propagate_block(
+    f_blk, src_local, src_global, dst_global, mask,
+    aw, hw, steps: int, decay: float, mu: float, beta: float,
+):
+    """Per-device kernel for ONE graph: f_blk is this shard's node block."""
+    a_blk = _noisy_or(f_blk, aw)
+    h_blk = _noisy_or(f_blk, hw)
+    h_full = jax.lax.all_gather(h_blk, "sp", tiled=True)
+    a_full = jax.lax.all_gather(a_blk, "sp", tiled=True)
+
+    def up_step(u_blk, _):
+        u_full = jax.lax.all_gather(u_blk, "sp", tiled=True)
+        vals = mask * jnp.maximum(h_full[dst_global], decay * u_full[dst_global])
+        scattered = jnp.zeros_like(u_blk).at[src_local].max(vals)
+        return jnp.maximum(u_blk, scattered), None
+
+    u_blk, _ = jax.lax.scan(up_step, jnp.zeros_like(a_blk), None, length=steps)
+
+    def imp_step(m_blk, _):
+        m_full = jax.lax.all_gather(m_blk, "sp", tiled=True)
+        vals = mask * (a_full[src_global] + decay * m_full[src_global])
+        contrib_full = jnp.zeros_like(m_full).at[dst_global].add(vals)
+        # reduce-scatter: every shard receives its reduced block only
+        return jax.lax.psum_scatter(
+            contrib_full, "sp", scatter_dimension=0, tiled=True
+        ), None
+
+    m_blk, _ = jax.lax.scan(imp_step, jnp.zeros_like(a_blk), None, length=steps)
+    # same hard-evidence-damped suppression as engine.propagate
+    return (a_blk + beta * jnp.tanh(m_blk / 4.0)) * (
+        1.0 - mu * u_blk * (1.0 - h_blk)
+    )
+
+
+def sharded_propagate(
+    mesh: Mesh,
+    features_batch: np.ndarray,  # [B, n_pad, C] hypothesis batch, same graph
+    graph: ShardedGraph,
+    params: PropagationParams,
+) -> jax.Array:
+    """Scores [B, n_pad]: batch sharded over 'dp', nodes sharded over 'sp'."""
+    aw, hw = params.weight_arrays()
+    steps, decay = params.steps, params.decay
+    mu, beta = params.explain_strength, params.impact_bonus
+
+    def per_device(f_loc, src_l, src_g, dst_g, mask):
+        # f_loc: [B/dp, block, C]; edge arrays arrive [1, e_pad] — drop the
+        # collapsed shard axis, then vmap the block kernel over the local batch
+        src_l, src_g = src_l[0], src_g[0]
+        dst_g, mask = dst_g[0], mask[0]
+        kernel = functools.partial(
+            _propagate_block,
+            aw=aw, hw=hw, steps=steps, decay=decay, mu=mu, beta=beta,
+        )
+        return jax.vmap(
+            lambda f: kernel(f, src_l, src_g, dst_g, mask)
+        )(f_loc)
+
+    shard_fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(
+            P("dp", "sp", None),
+            P("sp", None), P("sp", None), P("sp", None), P("sp", None),
+        ),
+        out_specs=P("dp", "sp"),
+        check_vma=False,
+    )
+
+    fb = jax.device_put(
+        jnp.asarray(features_batch),
+        NamedSharding(mesh, P("dp", "sp", None)),
+    )
+    edge_sharding = NamedSharding(mesh, P("sp", None))
+    args = tuple(
+        jax.device_put(jnp.asarray(x), edge_sharding)
+        for x in (graph.src_local, graph.src_global, graph.dst_global, graph.mask)
+    )
+    with mesh:
+        return jax.jit(shard_fn)(fb, *args)

@@ -11,6 +11,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# A site hook may have force-registered an accelerator plugin before this
+# conftest ran; config.update wins over it where the env var does not.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 from rca_tpu.cluster.fixtures import five_service_world  # noqa: E402

@@ -1,0 +1,82 @@
+"""Engine tests: explain-away semantics, hit@1 on synthetic cascades,
+snapshot path on the 5-service fixture, bucket padding invariance."""
+
+import numpy as np
+
+from rca_tpu.cluster.fixtures import NS
+from rca_tpu.cluster.generator import synthetic_cascade_arrays
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+from rca_tpu.engine import GraphEngine
+from rca_tpu.features.schema import NUM_SERVICE_FEATURES, SvcF
+
+
+def _chain_case():
+    """0 depends on 1 depends on 2; 2 is crashed, 0/1 degraded."""
+    f = np.zeros((3, NUM_SERVICE_FEATURES), np.float32)
+    f[2, SvcF.CRASH] = 1.0
+    f[2, SvcF.NOT_READY] = 1.0
+    f[1, SvcF.ERROR_RATE] = 0.6
+    f[1, SvcF.LATENCY] = 0.7
+    f[0, SvcF.ERROR_RATE] = 0.4
+    f[0, SvcF.LATENCY] = 0.5
+    src = np.array([0, 1], np.int32)
+    dst = np.array([1, 2], np.int32)
+    return f, src, dst
+
+
+def test_explain_away_chain():
+    f, src, dst = _chain_case()
+    res = GraphEngine().analyze_arrays(f, src, dst, ["a", "b", "c"])
+    assert res.ranked[0]["component"] == "c"
+    # the middle service is anomalous but explained by its broken dependency
+    assert res.upstream[1] > 0.8
+    assert res.score[1] < res.score[2]
+    # impact flows downstream: the root accumulated its dependents' anomaly
+    assert res.impact[2] > res.impact[1] > 0
+
+
+def test_hit_at_1_single_root():
+    hits = 0
+    for seed in range(10):
+        case = synthetic_cascade_arrays(200, n_roots=1, seed=seed)
+        res = GraphEngine().analyze_case(case)
+        hits += res.ranked[0]["component"] == case.names[case.roots[0]]
+    assert hits == 10
+
+
+def test_hit_at_k_multi_root():
+    case = synthetic_cascade_arrays(500, n_roots=3, seed=42)
+    res = GraphEngine().analyze_case(case, k=5)
+    top5 = set(res.top_components(5))
+    truth = {case.names[r] for r in case.roots.tolist()}
+    assert truth <= top5
+
+
+def test_snapshot_path_five_service(five_svc_client):
+    snap = ClusterSnapshot.capture(five_svc_client, NS)
+    res = GraphEngine().analyze_snapshot(snap)
+    top2 = set(res.top_components(2))
+    # both injected roots outrank the symptomatic mid-tier services
+    assert top2 == {"database", "api-gateway"}
+
+
+def test_bucket_padding_invariance():
+    case = synthetic_cascade_arrays(60, n_roots=1, seed=9)
+    engine = GraphEngine()
+    res = engine.analyze_case(case)
+    # same result when the graph is analyzed under a larger bucket
+    from rca_tpu.config import RCAConfig
+
+    big = GraphEngine(RCAConfig(shape_buckets=(1024,)))
+    res2 = big.analyze_case(case)
+    np.testing.assert_allclose(res.score, res2.score, atol=1e-6)
+    assert res.top_components() == res2.top_components()
+
+
+def test_empty_graph():
+    f = np.zeros((4, NUM_SERVICE_FEATURES), np.float32)
+    res = GraphEngine().analyze_arrays(
+        f, np.zeros(0, np.int32), np.zeros(0, np.int32)
+    )
+    assert res.score.max() == 0.0
+    assert len(res.ranked) <= 4

@@ -1,0 +1,73 @@
+"""Feature-extraction tests over the 5-service fixture and synthetic worlds."""
+
+import numpy as np
+
+from rca_tpu.cluster.fixtures import NS
+from rca_tpu.cluster.generator import synthetic_cascade_world
+from rca_tpu.cluster.mock_client import MockClusterClient
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+from rca_tpu.features import PodF, SvcF, extract_features, scan_text
+from rca_tpu.features.logscan import LOG_PATTERN_NAMES
+
+
+def _features(client, ns):
+    return extract_features(ClusterSnapshot.capture(client, ns))
+
+
+def test_log_scanner_classes():
+    counts = scan_text(
+        "ERROR: connection refused\nOOMKilled by kernel\n"
+        "request timed out\nTraceback (most recent call last):\n"
+    )
+    by_name = dict(zip(LOG_PATTERN_NAMES, counts.tolist()))
+    assert by_name["connection_refused"] == 1
+    assert by_name["oom_kill"] >= 1
+    assert by_name["timeout"] == 1
+    assert by_name["exception"] >= 2  # ERROR + Traceback
+    assert scan_text("").sum() == 0
+
+
+def test_pod_features_five_service(five_svc_client):
+    fs = _features(five_svc_client, NS)
+    assert fs.num_pods == 6 and fs.num_services == 5
+    idx = {n: i for i, n in enumerate(fs.pod_names)}
+    db = fs.pod_features[idx["database-7c9f8b6d5e-3x5qp"]]
+    assert db[PodF.WAIT_CRASHLOOP] == 1.0
+    assert db[PodF.RESTARTS] == 5.0
+    assert db[PodF.TERM_NONZERO] == 1.0
+    gw = fs.pod_features[idx["api-gateway-6b7c8d9e5f-4q3zx"]]
+    assert gw[PodF.PHASE_FAILED] == 1.0
+    be = fs.pod_features[idx["backend-5b6d8f9c7d-2zf8g"]]
+    assert be[PodF.CPU_PCT] > 0.9
+    # every pod maps to a service
+    assert (fs.pod_service >= 0).all()
+
+
+def test_service_features_five_service(five_svc_client):
+    fs = _features(five_svc_client, NS)
+    sidx = {n: i for i, n in enumerate(fs.service_names)}
+    svc = fs.service_features
+    assert svc[sidx["database"], SvcF.CRASH] == 1.0
+    assert svc[sidx["api-gateway"], SvcF.CRASH] == 1.0
+    assert svc[sidx["frontend"], SvcF.CRASH] == 0.0
+    # empty endpoints mark NOT_READY even without pod evidence
+    assert svc[sidx["database"], SvcF.NOT_READY] == 1.0
+    assert svc[sidx["api-gateway"], SvcF.ERROR_RATE] == 0.25
+    assert svc[sidx["backend"], SvcF.RESOURCE] > 0.9
+    # backend p99=2000 vs median 600 → elevated latency score
+    assert svc[sidx["backend"], SvcF.LATENCY] > 0.3
+
+
+def test_synthetic_world_features_separate_roots():
+    w = synthetic_cascade_world(50, n_roots=2, seed=3)
+    client = MockClusterClient(w)
+    fs = _features(client, w.ground_truth["namespace"])
+    sidx = {n: i for i, n in enumerate(fs.service_names)}
+    roots = w.ground_truth["fault_roots"]
+    crash = fs.service_features[:, SvcF.CRASH]
+    for r in roots:
+        assert crash[sidx[r]] == 1.0
+    non_root = np.ones(len(fs.service_names), bool)
+    for r in roots:
+        non_root[sidx[r]] = False
+    assert crash[non_root].max() == 0.0
