@@ -1,0 +1,17 @@
+"""Label-selector semantics shared by feature extraction, graph building,
+and the agents (reference: agents/topology_agent.py:133 selector ⊆ labels)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+def selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    """True when every selector key/value pair appears in ``labels``.
+
+    Empty selectors match nothing (a service without a selector is
+    headless/external and backs no pods directly).
+    """
+    if not selector:
+        return False
+    return all(labels.get(k) == v for k, v in selector.items())

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from rca_tpu.cluster.labels import selector_matches
 from rca_tpu.cluster.snapshot import ClusterSnapshot
 from rca_tpu.features.logscan import LOG_PATTERN_NAMES, scan_pod_logs
 from rca_tpu.features.schema import (
@@ -46,10 +47,18 @@ class FeatureSet:
     pod_features: np.ndarray        # [P, NUM_POD_FEATURES] float32
     service_names: List[str]
     service_features: np.ndarray    # [S, NUM_SERVICE_FEATURES] float32
-    pod_service: np.ndarray         # [P] int32, -1 when unmatched
+    pod_service: np.ndarray         # [P] int32 primary owner, -1 unmatched
+    # full pod↔service membership as COO pairs — one pod can back several
+    # services (e.g. a ClusterIP and a headless service sharing a selector)
+    memb_pod: np.ndarray            # [M] int32 pod indices
+    memb_svc: np.ndarray            # [M] int32 service indices
     node_names: List[str]
     pod_node: np.ndarray            # [P] int32, -1 when unknown
     node_features: np.ndarray       # [N, 2] float32 (cpu_pct, mem_pct)
+
+    def service_members(self, j: int) -> np.ndarray:
+        """Pod indices backing service ``j`` (all matches, not just primary)."""
+        return self.memb_pod[self.memb_svc == j]
 
     @property
     def num_pods(self) -> int:
@@ -60,11 +69,8 @@ class FeatureSet:
         return len(self.service_names)
 
 
-def _selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
-    """selector ⊆ labels (reference: agents/topology_agent.py:133)."""
-    if not selector:
-        return False
-    return all(labels.get(k) == v for k, v in selector.items())
+# back-compat alias; canonical definition lives in rca_tpu.cluster.labels
+_selector_matches = selector_matches
 
 
 def _container_status_flags(pod: dict, feat: np.ndarray) -> None:
@@ -172,32 +178,38 @@ def extract_features(snapshot: ClusterSnapshot) -> FeatureSet:
     pod_service = np.full(P, -1, dtype=np.int32)
     # index selectors by their (k,v) items for O(P·avg_labels) matching of the
     # overwhelmingly-common single-label selector; fall back to subset check.
-    single_label: Dict[tuple, int] = {}
+    # Every matching service is recorded (one pod may back several services,
+    # e.g. ClusterIP + headless with the same selector); pod_service keeps the
+    # first match as the primary owner.
+    single_label: Dict[tuple, List[int]] = {}
     multi: List[int] = []
     for j, sel in enumerate(selectors):
         if len(sel) == 1:
-            single_label.setdefault(next(iter(sel.items())), j)
+            single_label.setdefault(next(iter(sel.items())), []).append(j)
         elif sel:
             multi.append(j)
+    memb_pod: List[int] = []
+    memb_svc: List[int] = []
     for i, labels in enumerate(pod_labels):
-        hit = -1
+        hits: List[int] = []
         for item in labels.items():
-            if item in single_label:
-                hit = single_label[item]
-                break
-        if hit < 0:
-            for j in multi:
-                if _selector_matches(selectors[j], labels):
-                    hit = j
-                    break
-        pod_service[i] = hit
+            hits.extend(single_label.get(item, ()))
+        for j in multi:
+            if selector_matches(selectors[j], labels):
+                hits.append(j)
+        if hits:
+            pod_service[i] = min(hits)
+            memb_pod.extend([i] * len(hits))
+            memb_svc.extend(hits)
 
-    # -- service-level aggregation (numpy segment ops) ---------------------
+    memb_pod_arr = np.asarray(memb_pod, dtype=np.int32)
+    memb_svc_arr = np.asarray(memb_svc, dtype=np.int32)
+
+    # -- service-level aggregation (numpy segment ops over memberships) ----
     S = len(service_names)
     svc = np.zeros((S, NUM_SERVICE_FEATURES), dtype=np.float32)
-    matched = pod_service >= 0
-    seg = pod_service[matched]
-    pf = pod_features[matched]
+    seg = memb_svc_arr
+    pf = pod_features[memb_pod_arr]
     pods_per_svc = np.zeros(S, dtype=np.float32)
     np.add.at(pods_per_svc, seg, 1.0)
     denom = np.maximum(pods_per_svc, 1.0)
@@ -285,6 +297,8 @@ def extract_features(snapshot: ClusterSnapshot) -> FeatureSet:
         service_names=service_names,
         service_features=svc,
         pod_service=pod_service,
+        memb_pod=memb_pod_arr,
+        memb_svc=memb_svc_arr,
         node_names=node_names,
         pod_node=pod_node,
         node_features=node_feat,

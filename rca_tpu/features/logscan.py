@@ -16,7 +16,9 @@ import numpy as np
 
 # class name -> compiled pattern (verbose, case-handled per class)
 LOG_PATTERNS: Dict[str, re.Pattern] = {
-    "oom_kill": re.compile(r"out of memory|oomkilled|signal:\s*killed|\bkilled\b", re.I),
+    "oom_kill": re.compile(
+        r"out of memory|oomkilled|signal:\s*killed|oom[-_]?kill", re.I
+    ),
     "connection_refused": re.compile(r"connection refused|ECONNREFUSED", re.I),
     "permission_denied": re.compile(r"permission denied|access denied|\bforbidden\b", re.I),
     "timeout": re.compile(r"timed?\s?-?out|ETIMEDOUT|deadline exceeded", re.I),

@@ -25,8 +25,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from rca_tpu.cluster.labels import selector_matches
 from rca_tpu.cluster.snapshot import ClusterSnapshot
-from rca_tpu.features.extract import FeatureSet, _selector_matches
+from rca_tpu.features.extract import FeatureSet
 
 
 class NodeType(enum.IntEnum):
@@ -144,17 +145,17 @@ def _workloads(snapshot: ClusterSnapshot) -> List[Tuple[str, dict]]:
 
 def _dns_service_names(value: str, service_names: List[str], namespace: str):
     """Service DNS inference from env values (reference:
-    agents/topology_agent.py:228-260): match '<svc>.<ns>.svc', '<svc>.<ns>',
-    or a bare '<svc>' host in a URL."""
+    agents/topology_agent.py:228-260): match a bare '<svc>' host or a
+    qualified '<svc>.<ns>[.svc...]' host.  The namespace component must be
+    THIS namespace — '<svc>.<other-ns>.svc' points at a different cluster
+    tenant and must not create a local dependency edge."""
     hits = set()
     hosts = re.findall(r"[a-z0-9][a-z0-9.-]*", value.lower())
     svc_set = set(service_names)
     for host in hosts:
         parts = host.split(".")
         if parts[0] in svc_set:
-            if len(parts) == 1 or (len(parts) >= 2 and parts[1] == namespace) or (
-                len(parts) >= 3 and parts[2] == "svc"
-            ):
+            if len(parts) == 1 or parts[1] == namespace:
                 hits.add(parts[0])
     return hits
 
@@ -182,7 +183,7 @@ def build_typed_graph(snapshot: ClusterSnapshot) -> TypedGraph:
         # SELECTS: service selector ⊆ template labels
         for svc in snapshot.services:
             sel = (svc.get("spec") or {}).get("selector") or {}
-            if sel and _selector_matches(sel, tlabels):
+            if sel and selector_matches(sel, tlabels):
                 b.edge(
                     b.node(NodeType.SERVICE, svc["metadata"]["name"]),
                     widx,

@@ -107,28 +107,25 @@ def _propagate_block(
     )
 
 
-def sharded_propagate(
-    mesh: Mesh,
-    features_batch: np.ndarray,  # [B, n_pad, C] hypothesis batch, same graph
-    graph: ShardedGraph,
-    params: PropagationParams,
-) -> jax.Array:
-    """Scores [B, n_pad]: batch sharded over 'dp', nodes sharded over 'sp'."""
-    aw, hw = params.weight_arrays()
-    steps, decay = params.steps, params.decay
-    mu, beta = params.explain_strength, params.impact_bonus
+@functools.lru_cache(maxsize=32)
+def _jitted_shard_fn(
+    mesh: Mesh, steps: int, decay: float, mu: float, beta: float
+):
+    """One traced+compiled shard_map per (mesh, scalar-params); weight
+    vectors are runtime args so repeated calls hit jit's shape cache
+    instead of re-tracing (jit is keyed on function identity)."""
 
-    def per_device(f_loc, src_l, src_g, dst_g, mask):
+    def per_device(f_loc, src_l, src_g, dst_g, mask, aw, hw):
         # f_loc: [B/dp, block, C]; edge arrays arrive [1, e_pad] — drop the
         # collapsed shard axis, then vmap the block kernel over the local batch
         src_l, src_g = src_l[0], src_g[0]
         dst_g, mask = dst_g[0], mask[0]
         kernel = functools.partial(
             _propagate_block,
-            aw=aw, hw=hw, steps=steps, decay=decay, mu=mu, beta=beta,
+            steps=steps, decay=decay, mu=mu, beta=beta,
         )
         return jax.vmap(
-            lambda f: kernel(f, src_l, src_g, dst_g, mask)
+            lambda f: kernel(f, src_l, src_g, dst_g, mask, aw=aw, hw=hw)
         )(f_loc)
 
     shard_fn = jax.shard_map(
@@ -137,11 +134,26 @@ def sharded_propagate(
         in_specs=(
             P("dp", "sp", None),
             P("sp", None), P("sp", None), P("sp", None), P("sp", None),
+            P(), P(),
         ),
         out_specs=P("dp", "sp"),
         check_vma=False,
     )
+    return jax.jit(shard_fn)
 
+
+def sharded_propagate(
+    mesh: Mesh,
+    features_batch: np.ndarray,  # [B, n_pad, C] hypothesis batch, same graph
+    graph: ShardedGraph,
+    params: PropagationParams,
+) -> jax.Array:
+    """Scores [B, n_pad]: batch sharded over 'dp', nodes sharded over 'sp'."""
+    aw, hw = params.weight_arrays()
+    fn = _jitted_shard_fn(
+        mesh, params.steps, params.decay,
+        params.explain_strength, params.impact_bonus,
+    )
     fb = jax.device_put(
         jnp.asarray(features_batch),
         NamedSharding(mesh, P("dp", "sp", None)),
@@ -152,4 +164,4 @@ def sharded_propagate(
         for x in (graph.src_local, graph.src_global, graph.dst_global, graph.mask)
     )
     with mesh:
-        return jax.jit(shard_fn)(fb, *args)
+        return fn(fb, *args, jnp.asarray(aw), jnp.asarray(hw))

@@ -1,0 +1,159 @@
+"""Signal agents against the faulted 5-service fixture.
+
+Each test asserts the agent surfaces the fixture's injected fault the same
+way the reference's rule agents would (reference rule tables: SURVEY.md §2.4).
+"""
+
+import numpy as np
+import pytest
+
+from rca_tpu.agents import (
+    ALL_AGENT_TYPES,
+    AnalysisContext,
+    make_agents,
+)
+from rca_tpu.cluster.fixtures import NS, five_service_world
+from rca_tpu.cluster.mock_client import MockClusterClient
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    client = MockClusterClient(five_service_world())
+    return AnalysisContext(ClusterSnapshot.capture(client, NS))
+
+
+@pytest.fixture(scope="module")
+def results(ctx):
+    return {name: agent.analyze(ctx) for name, agent in make_agents().items()}
+
+
+def _components(result, severity=None):
+    return [
+        f["component"]
+        for f in result.findings
+        if severity is None or f["severity"] == severity
+    ]
+
+
+def test_all_agents_return_contract(results):
+    assert set(results) == set(ALL_AGENT_TYPES)
+    for name, res in results.items():
+        d = res.to_dict()
+        assert d["agent_type"] == name
+        assert isinstance(d["findings"], list)
+        assert res.reasoning_steps, name
+        assert res.summary
+        for f in d["findings"]:
+            assert set(f) >= {
+                "component", "issue", "severity", "evidence",
+                "recommendation", "timestamp",
+            }
+
+
+def test_metrics_agent_flags_hot_pods(results):
+    comps = _components(results["metrics"])
+    assert any("backend" in c for c in comps)          # 95% CPU
+    assert any("resource-service" in c for c in comps)  # ~90% memory
+    # api-gateway HPA wants 2 replicas but has 1
+    assert any(c == "HPA/api-gateway-hpa" for c in comps)
+
+
+def test_logs_agent_finds_database_errors(results):
+    res = results["logs"]
+    db = [f for f in res.findings if "database" in f["component"]]
+    assert db
+    patterns = {f["evidence"].get("pattern") for f in db if isinstance(f["evidence"], dict)}
+    assert "exception" in patterns
+    # crashloop container-state classification
+    assert any("CrashLoopBackOff" in f["issue"] for f in db)
+    # example lines extracted from the raw text
+    ex = [
+        f for f in db
+        if isinstance(f["evidence"], dict) and f["evidence"].get("examples")
+    ]
+    assert ex
+
+
+def test_events_agent_groups_and_flags_frequency(results):
+    res = results["events"]
+    # database BackOff event recurs 5 times -> not above the >5 threshold;
+    # backend CPUThrottling recurs 10 times -> medium frequency finding
+    comps = _components(res)
+    assert any("backend" in c for c in comps)
+
+
+def test_topology_agent_structure(results):
+    res = results["topology"]
+    comps = _components(res)
+    # api-gateway envFrom references a secret that does not exist
+    assert any(
+        "api-gateway" in f["component"] and "secret" in f["issue"]
+        for f in res.findings
+    )
+    # network policy 'from' selector matches no pods
+    assert any("NetworkPolicy/backend-network-policy" in c for c in comps)
+    # services whose pods are all unready
+    assert any(
+        c in ("Service/database", "Service/api-gateway") for c in comps
+    )
+    assert "graph" in res.data and res.data["graph"]["nodes"]
+    mapping = res.data["service_pod_mapping"]
+    assert mapping["frontend"]["ready"] == 2
+    assert mapping["database"]["ready"] == 0
+
+
+def test_traces_agent_error_rates_and_latency(results):
+    res = results["traces"]
+    highs = [
+        f for f in res.findings
+        if f["severity"] == "high" and "error rate" in f["issue"]
+    ]
+    assert any("api-gateway" in f["component"] for f in highs)   # 25%
+    assert any("database" in f["component"] for f in highs)      # 15%
+    # backend p99 2000ms vs median -> degraded
+    assert any(
+        "backend" in f["component"] and "latency" in f["issue"]
+        for f in res.findings
+    )
+
+
+def test_resource_agent_buckets(results):
+    res = results["resources"]
+    buckets = res.data["pod_buckets"]
+    assert buckets["crashloop"] == 1      # database
+    assert buckets["failed"] == 1         # api-gateway
+    crash = [
+        f for f in res.findings
+        if f.get("bucket") == "crashloop"
+    ]
+    assert crash and "database" in crash[0]["component"]
+    # deployment ready shortfalls for database and api-gateway
+    dep = [
+        f["component"] for f in res.findings
+        if f["component"].startswith("Deployment/")
+    ]
+    assert "Deployment/database" in dep
+    assert "Deployment/api-gateway" in dep
+
+
+def test_event_correlation_attaches_related_events(results):
+    res = results["resources"]
+    db = [
+        f for f in res.findings
+        if f["component"] == "Pod/database-7c9f8b6d5e-3x5qp"
+        and isinstance(f["evidence"], dict)
+        and f["evidence"].get("related_events")
+    ]
+    assert db
+    assert any(
+        e["reason"] == "BackOff" for e in db[0]["evidence"]["related_events"]
+    )
+
+
+def test_agents_are_stateless(ctx):
+    agent = make_agents()["resources"]
+    r1 = agent.analyze(ctx)
+    r2 = agent.analyze(ctx)
+    assert len(r1.findings) == len(r2.findings)
+    assert r1.findings is not r2.findings

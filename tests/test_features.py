@@ -71,3 +71,35 @@ def test_synthetic_world_features_separate_roots():
     for r in roots:
         non_root[sidx[r]] = False
     assert crash[non_root].max() == 0.0
+
+
+def test_shared_selector_services_both_get_members():
+    """One pod backing two services (ClusterIP + headless with the same
+    selector) must appear in both memberships — no false 'selector matches
+    no pods' findings."""
+    from rca_tpu.cluster.world import World, make_pod, make_service
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+    from rca_tpu.features.extract import extract_features
+
+    w = World(cluster_name="t")
+    ns = "ns"
+    w.add("pods", ns, make_pod("db-0", ns, "db"))
+    w.add("services", ns, make_service("db", ns))
+    headless = make_service("db-headless", ns)
+    headless["spec"]["selector"] = {"app": "db"}
+    w.add("services", ns, headless)
+    snap = ClusterSnapshot.capture(MockClusterClient(w), ns)
+    fs = extract_features(snap)
+    for j, name in enumerate(fs.service_names):
+        assert len(fs.service_members(j)) == 1, name
+    # both services aggregate the pod's features identically
+    assert (fs.service_features[0] == fs.service_features[1]).all()
+
+
+def test_dns_inference_rejects_foreign_namespace():
+    from rca_tpu.graph.build import _dns_service_names
+
+    assert _dns_service_names("http://db.prod2.svc:5432", ["db"], "prod1") == set()
+    assert _dns_service_names("http://db.prod1.svc:5432", ["db"], "prod1") == {"db"}
+    assert _dns_service_names("http://db:5432", ["db"], "prod1") == {"db"}
