@@ -1,0 +1,179 @@
+"""LLM agent family: tool-driven evidence gathering behind the Agent API.
+
+The reference's MCP agents (reference: agents/mcp_agent.py:33-69) sent one
+context blob to the LLM, declared tools that were never invoked, and parsed
+findings out of ``Issue:/Component:/Severity:`` markdown headers
+(reference: agents/mcp_agent.py:170-251).  This family:
+
+- runs a REAL tool loop (rca_tpu.llm.toolloop) against the typed cluster
+  client, so evidence in the answer is evidence that was actually fetched;
+- requests findings as structured JSON instead of header-parsing;
+- degrades deterministically: with the offline provider (or on any LLM
+  failure) it falls back to the deterministic rule agent of the same signal,
+  so `analyze` always returns findings (reference behavior on failure was an
+  empty findings list swallowed by try/except, mcp_agent.py:60-69).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from rca_tpu.agents.base import Agent, AgentResult, AnalysisContext, summarize
+from rca_tpu.findings import SEVERITY_ORDER
+from rca_tpu.llm.client import LLMClient
+from rca_tpu.llm.tools import ToolSpec, cluster_toolsets
+
+_SYSTEM_TEMPLATE = (
+    "You are the {signal} analysis agent in a Kubernetes root-cause-analysis "
+    "system. Use the provided tools to gather evidence about the namespace, "
+    "then report concrete findings. Severity scale: info, low, medium, high, "
+    "critical. Be specific: name components, cite the evidence you fetched."
+)
+
+_FINDINGS_PROMPT = (
+    "Convert this {signal} analysis into JSON: "
+    '{{"findings": [{{"component": "Kind/name", "issue": "...", '
+    '"severity": "info|low|medium|high|critical", "evidence": "...", '
+    '"recommendation": "..."}}], "summary": "one line"}}.\n'
+    "Analysis:\n{analysis}"
+)
+
+
+class LLMAgent(Agent):
+    """One LLM-driven signal agent with a deterministic fallback twin."""
+
+    def __init__(
+        self,
+        agent_type: str,
+        client: LLMClient,
+        tools: Optional[List[ToolSpec]] = None,
+        fallback: Optional[Agent] = None,
+    ):
+        self.agent_type = agent_type
+        self.client = client
+        self.tools = tools or []
+        self.fallback = fallback
+
+    # tools are bound per-namespace at analyze time when not preset
+    def _tools_for(self, ctx: AnalysisContext, client) -> List[ToolSpec]:
+        if self.tools:
+            return self.tools
+        if client is None:
+            return []
+        return cluster_toolsets(client, ctx.snapshot.namespace).get(
+            self.agent_type, []
+        )
+
+    def analyze(
+        self, ctx: AnalysisContext, cluster_client=None
+    ) -> AgentResult:
+        r = AgentResult(self.agent_type)
+        tools = self._tools_for(ctx, cluster_client)
+        context = self._context_blob(ctx)
+        try:
+            out = self.client.analyze(
+                context,
+                tools=tools,
+                system_prompt=_SYSTEM_TEMPLATE.format(signal=self.agent_type),
+            )
+        except Exception as e:
+            return self._fall_back(ctx, r, f"LLM analyze failed: {e}")
+        r.reasoning_steps.extend(out.get("reasoning_steps", []))
+        analysis = out.get("final_analysis", "")
+
+        structured = self.client.generate_structured_output(
+            _FINDINGS_PROMPT.format(
+                signal=self.agent_type, analysis=analysis[:6000]
+            )
+        )
+        findings = (structured or {}).get("findings")
+        if isinstance(findings, list) and findings:
+            for f in findings:
+                if not isinstance(f, dict):
+                    continue
+                sev = str(f.get("severity", "info")).lower()
+                r.add_finding(
+                    str(f.get("component", "unknown")),
+                    str(f.get("issue", "")),
+                    sev if sev in SEVERITY_ORDER else "info",
+                    f.get("evidence", ""),
+                    str(f.get("recommendation", "")),
+                    source="llm",
+                )
+            r.summary = str((structured or {}).get("summary", "")) or analysis[:200]
+            r.data["final_analysis"] = analysis
+            return r
+        # no structured findings (offline provider or parse failure):
+        # deterministic twin provides findings, LLM text kept as narrative
+        return self._fall_back(
+            ctx, r, "no structured findings from provider",
+            narrative=analysis,
+        )
+
+    # ------------------------------------------------------------------
+    def _fall_back(
+        self,
+        ctx: AnalysisContext,
+        r: AgentResult,
+        reason: str,
+        narrative: str = "",
+    ) -> AgentResult:
+        r.add_step(
+            f"LLM path degraded ({reason}); using deterministic "
+            f"{self.agent_type} rules.",
+            "Findings below come from the rule agent.",
+        )
+        if narrative:
+            r.data["final_analysis"] = narrative
+        if self.fallback is not None:
+            det = self.fallback.analyze(ctx)
+            r.findings.extend(det.findings)
+            r.reasoning_steps.extend(det.reasoning_steps)
+            r.data.update(det.data)
+        summarize(r, self.agent_type)
+        return r
+
+    def _context_blob(self, ctx: AnalysisContext) -> str:
+        """Compact cluster context for the first LLM turn (counts, not dumps —
+        the tools exist to fetch detail)."""
+        snap = ctx.snapshot
+        fs = ctx.features
+        phases: Dict[str, int] = {}
+        for p in snap.pods:
+            ph = p.get("status", {}).get("phase", "Unknown")
+            phases[ph] = phases.get(ph, 0) + 1
+        blob: Dict[str, Any] = {
+            "namespace": snap.namespace,
+            "captured_at": snap.captured_at,
+            "pods_by_phase": phases,
+            "services": fs.service_names,
+            "warning_events": sum(
+                1 for e in snap.events if e.get("type") != "Normal"
+            ),
+            "task": (
+                f"Analyze the {self.agent_type} signal for this namespace "
+                "and identify problems with evidence."
+            ),
+        }
+        return json.dumps(blob)
+
+
+def make_llm_agents(
+    client: LLMClient, cluster_client=None, namespace: str = ""
+) -> Dict[str, LLMAgent]:
+    """LLM agent per signal, each with its deterministic twin as fallback."""
+    from rca_tpu.agents import make_agents
+
+    det = make_agents()
+    toolsets = (
+        cluster_toolsets(cluster_client, namespace) if cluster_client else {}
+    )
+    return {
+        name: LLMAgent(
+            name, client,
+            tools=toolsets.get(name),
+            fallback=det[name],
+        )
+        for name in det
+    }

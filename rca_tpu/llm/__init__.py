@@ -1,0 +1,31 @@
+"""LLM backend: providers, real tool loop, per-signal tool registries."""
+
+from rca_tpu.llm.client import LLMClient, parse_json_response
+from rca_tpu.llm.providers import (
+    AnthropicProvider,
+    LLMQuotaExceeded,
+    LLMUnavailable,
+    OfflineProvider,
+    OpenAIProvider,
+    Provider,
+    ProviderReply,
+    ToolCall,
+    make_provider,
+)
+from rca_tpu.llm.tools import ToolSpec, cluster_toolsets
+
+__all__ = [
+    "AnthropicProvider",
+    "LLMClient",
+    "LLMQuotaExceeded",
+    "LLMUnavailable",
+    "OfflineProvider",
+    "OpenAIProvider",
+    "Provider",
+    "ProviderReply",
+    "ToolCall",
+    "ToolSpec",
+    "cluster_toolsets",
+    "make_provider",
+    "parse_json_response",
+]

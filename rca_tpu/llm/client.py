@@ -1,0 +1,179 @@
+"""LLMClient: analyze / structured output / completion, with a REAL tool loop.
+
+Surface parity with the reference (reference: utils/llm_client_improved.py —
+``analyze(context, tools, system_prompt)`` :68, ``generate_structured_output``
+:163 with fenced-block rescue :257-262, ``generate_completion`` :384 with
+max_tokens=2000 / temperature=0.2 defaults) plus the tool-execution loop the
+reference declared but never ran (its ``tools`` argument was ignored,
+reference: llm_client_improved.py:68; SURVEY.md §2.3 "the loop is
+vestigial").  Every LLM interaction is reported to an optional ``log_fn``
+hook (wired to the PromptLogger, reference format:
+utils/prompt_logger.py:76-89).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from rca_tpu.llm.providers import Provider, ProviderReply, make_provider
+from rca_tpu.llm.tools import ToolSpec
+
+MAX_TOOL_ROUNDS = 6
+
+LogFn = Callable[[Dict[str, Any]], None]
+
+
+class LLMClient:
+    def __init__(
+        self,
+        provider: Optional[Provider] = None,
+        provider_name: Optional[str] = None,
+        log_fn: Optional[LogFn] = None,
+    ):
+        self.provider = provider or make_provider(provider_name)
+        self.log_fn = log_fn
+
+    # -- logging -----------------------------------------------------------
+    def _log(self, prompt: str, response: str, **context: Any) -> None:
+        if self.log_fn is None:
+            return
+        try:
+            self.log_fn(
+                {
+                    "prompt": prompt,
+                    "response": response,
+                    "additional_context": {
+                        "provider": self.provider.name,
+                        "model": self.provider.model,
+                        **context,
+                    },
+                }
+            )
+        except Exception:
+            pass  # observability must never break analysis
+
+    # -- tool loop ----------------------------------------------------------
+    def analyze(
+        self,
+        context: str,
+        tools: Optional[Sequence[ToolSpec]] = None,
+        system_prompt: str = "",
+        max_rounds: int = MAX_TOOL_ROUNDS,
+    ) -> Dict[str, Any]:
+        """Multi-round tool-calling analysis.
+
+        Returns ``{final_analysis, reasoning_steps}`` where each reasoning
+        step records a real executed tool call (name, arguments, result
+        excerpt) — the audit trail the reference's vestigial loop never
+        produced.
+        """
+        tool_map = {t.name: t for t in tools or []}
+        schemas = [t.schema() for t in tools or []]
+        messages: List[dict] = []
+        if system_prompt:
+            messages.append({"role": "system", "content": system_prompt})
+        messages.append({"role": "user", "content": context})
+        steps: List[dict] = []
+
+        reply: ProviderReply = self.provider.complete(messages, schemas or None)
+        rounds = 0
+        while reply.tool_calls and rounds < max_rounds:
+            rounds += 1
+            messages.append(
+                {
+                    "role": "assistant",
+                    "content": reply.text,
+                    "tool_calls": [
+                        {"id": tc.id, "name": tc.name,
+                         "arguments": tc.arguments}
+                        for tc in reply.tool_calls
+                    ],
+                }
+            )
+            for tc in reply.tool_calls:
+                spec = tool_map.get(tc.name)
+                if spec is None:
+                    result = json.dumps({"error": f"unknown tool {tc.name}"})
+                else:
+                    result = spec.execute(tc.arguments)
+                steps.append(
+                    {
+                        "observation": (
+                            f"tool {tc.name}({json.dumps(tc.arguments)}) -> "
+                            f"{result[:400]}"
+                        ),
+                        "conclusion": "evidence gathered",
+                        "tool": tc.name,
+                        "arguments": tc.arguments,
+                    }
+                )
+                messages.append(
+                    {"role": "tool", "tool_call_id": tc.id, "content": result}
+                )
+            reply = self.provider.complete(messages, schemas or None)
+
+        self._log(context, reply.text, kind="analyze", tool_rounds=rounds)
+        return {"final_analysis": reply.text, "reasoning_steps": steps}
+
+    # -- structured output ---------------------------------------------------
+    def generate_structured_output(
+        self,
+        prompt: str,
+        system_prompt: str = "",
+        **log_context: Any,
+    ) -> Optional[Dict[str, Any]]:
+        messages: List[dict] = []
+        if system_prompt:
+            messages.append({"role": "system", "content": system_prompt})
+        messages.append({"role": "user", "content": prompt})
+        reply = self.provider.complete(messages, json_mode=True)
+        self._log(prompt, reply.text, kind="structured", **log_context)
+        return parse_json_response(reply.text)
+
+    # -- plain completion ----------------------------------------------------
+    def generate_completion(
+        self,
+        prompt: str,
+        system_prompt: str = "",
+        temperature: float = 0.2,
+        max_tokens: int = 2000,
+        **log_context: Any,
+    ) -> str:
+        messages: List[dict] = []
+        if system_prompt:
+            messages.append({"role": "system", "content": system_prompt})
+        messages.append({"role": "user", "content": prompt})
+        reply = self.provider.complete(
+            messages, temperature=temperature, max_tokens=max_tokens
+        )
+        self._log(prompt, reply.text, kind="completion", **log_context)
+        return reply.text
+
+
+_FENCED = re.compile(r"```(?:json)?\s*(\{.*?\}|\[.*?\])\s*```", re.S)
+
+
+def parse_json_response(text: str) -> Optional[Dict[str, Any]]:
+    """Parse a JSON object from model output, rescuing fenced blocks and
+    leading/trailing prose (reference: llm_client_improved.py:257-262)."""
+    if not text:
+        return None
+    for candidate in (text, *(m for m in _FENCED.findall(text))):
+        try:
+            out = json.loads(candidate)
+            if isinstance(out, dict):
+                return out
+        except json.JSONDecodeError:
+            continue
+    # last resort: widest braces span
+    start, end = text.find("{"), text.rfind("}")
+    if 0 <= start < end:
+        try:
+            out = json.loads(text[start : end + 1])
+            if isinstance(out, dict):
+                return out
+        except json.JSONDecodeError:
+            pass
+    return None

@@ -1,0 +1,183 @@
+"""Per-signal tool registries bound to the ClusterClient protocol.
+
+The reference declared per-agent OpenAI function schemas
+(reference: agents/mcp_metrics_agent.py:35-114, mcp_logs_agent.py:35-139,
+mcp_events_agent.py:35-120, mcp_topology_agent.py:35-128,
+mcp_traces_agent.py:36-136) but its LLM client never invoked them
+(reference: utils/llm_client_improved.py:68 ignores ``tools``).  Here every
+schema is paired with an executable bound to the one typed
+:class:`~rca_tpu.cluster.protocol.ClusterClient`, so the loop in
+:mod:`rca_tpu.llm.toolloop` really runs them — and since both the real and
+mock backends implement the same protocol, every tool works against both
+(the reference's mock-only tool breakage, SURVEY.md §2.6, cannot recur).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+MAX_TOOL_RESULT_CHARS = 6000
+
+
+@dataclasses.dataclass
+class ToolSpec:
+    name: str
+    description: str
+    parameters: Dict[str, Any]
+    fn: Callable[..., Any]
+
+    def schema(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "description": self.description,
+            "parameters": self.parameters,
+        }
+
+    def execute(self, arguments: Dict[str, Any]) -> str:
+        props = self.parameters.get("properties", {})
+        kwargs = {k: v for k, v in (arguments or {}).items() if k in props}
+        try:
+            out = self.fn(**kwargs)
+        except Exception as e:
+            return json.dumps({"error": f"{type(e).__name__}: {e}"})
+        try:
+            text = json.dumps(out, default=str)
+        except (TypeError, ValueError):
+            text = str(out)
+        if len(text) > MAX_TOOL_RESULT_CHARS:
+            text = text[:MAX_TOOL_RESULT_CHARS] + "...[truncated]"
+        return text
+
+
+def _obj(props: Dict[str, dict], required: Optional[List[str]] = None) -> dict:
+    return {
+        "type": "object",
+        "properties": props,
+        "required": required or [],
+    }
+
+
+_STR = {"type": "string"}
+_INT = {"type": "integer"}
+
+
+def cluster_toolsets(client, namespace: str) -> Dict[str, List[ToolSpec]]:
+    """Tool registry per signal agent, all bound to ``client``/``namespace``."""
+    ns = namespace
+
+    def pod_logs(pod_name: str, container: str = "", previous: bool = False,
+                 tail_lines: int = 100):
+        return client.get_pod_logs(
+            ns, pod_name, container=container or None,
+            previous=bool(previous), tail_lines=int(tail_lines),
+        )
+
+    def search_logs(pattern: str, tail_lines: int = 200):
+        """Cross-pod substring search (reference: mcp_logs_agent.py:256-292)."""
+        hits = []
+        for pod in client.get_pods(ns):
+            name = pod.get("metadata", {}).get("name", "")
+            try:
+                text = client.get_pod_logs(ns, name, tail_lines=int(tail_lines))
+            except Exception:
+                continue
+            for line in (text or "").splitlines():
+                if pattern.lower() in line.lower():
+                    hits.append({"pod": name, "line": line.strip()[:300]})
+                    if len(hits) >= 50:
+                        return hits
+        return hits
+
+    def resource_events(kind: str, name: str):
+        return client.get_events(
+            ns,
+            field_selector=(
+                f"involvedObject.kind={kind},involvedObject.name={name}"
+            ),
+        )
+
+    metrics = [
+        ToolSpec("get_pod_metrics", "CPU/memory usage per pod in the namespace",
+                 _obj({}), lambda: client.get_pod_metrics(ns)),
+        ToolSpec("get_node_metrics", "CPU/memory usage per cluster node",
+                 _obj({}), client.get_node_metrics),
+        ToolSpec("get_hpas", "HorizontalPodAutoscaler specs and status",
+                 _obj({}), lambda: client.get_hpas(ns)),
+        ToolSpec("get_resource_quotas", "ResourceQuota objects in the namespace",
+                 _obj({}), lambda: client.get_resource_quotas(ns)),
+        ToolSpec("get_deployments",
+                 "Deployment specs (includes per-container resource requests/limits)",
+                 _obj({}), lambda: client.get_deployments(ns)),
+    ]
+    logs = [
+        ToolSpec("get_pod_logs", "Logs of one pod (optionally one container)",
+                 _obj({"pod_name": _STR, "container": _STR,
+                       "previous": {"type": "boolean"}, "tail_lines": _INT},
+                      ["pod_name"]),
+                 pod_logs),
+        ToolSpec("search_logs_for_pattern",
+                 "Search all pods' recent logs for a substring",
+                 _obj({"pattern": _STR, "tail_lines": _INT}, ["pattern"]),
+                 search_logs),
+        ToolSpec("get_pods", "Pod list with status/containerStatuses",
+                 _obj({}), lambda: client.get_pods(ns)),
+    ]
+    events = [
+        ToolSpec("get_namespace_events", "All events in the namespace",
+                 _obj({}), lambda: client.get_events(ns)),
+        ToolSpec("get_resource_events", "Events for one object (kind + name)",
+                 _obj({"kind": _STR, "name": _STR}, ["kind", "name"]),
+                 resource_events),
+    ]
+    topology = [
+        ToolSpec("get_services", "Service list with selectors",
+                 _obj({}), lambda: client.get_services(ns)),
+        ToolSpec("get_endpoints", "Endpoints (ready addresses) per service",
+                 _obj({}), lambda: client.get_endpoints(ns)),
+        ToolSpec("get_deployments", "Deployment list",
+                 _obj({}), lambda: client.get_deployments(ns)),
+        ToolSpec("get_ingresses", "Ingress routes",
+                 _obj({}), lambda: client.get_ingresses(ns)),
+        ToolSpec("get_network_policies", "NetworkPolicy objects",
+                 _obj({}), lambda: client.get_network_policies(ns)),
+    ]
+    traces = [
+        ToolSpec("get_trace_ids", "Recent trace ids",
+                 _obj({"limit": _INT}),
+                 lambda limit=20: client.get_trace_ids(ns, limit=int(limit))),
+        ToolSpec("get_trace_details", "Spans of one trace",
+                 _obj({"trace_id": _STR}, ["trace_id"]),
+                 client.get_trace_details),
+        ToolSpec("get_service_latency_stats", "p50/p95/p99 latency per service",
+                 _obj({}), lambda: client.get_service_latency_stats(ns)),
+        ToolSpec("get_error_rate_by_service", "Error rate per service",
+                 _obj({}), lambda: client.get_error_rate_by_service(ns)),
+        ToolSpec("get_service_dependencies", "Service dependency map",
+                 _obj({}), lambda: client.get_service_dependencies(ns)),
+        ToolSpec("find_slow_operations", "Operations slower than threshold_ms",
+                 _obj({"threshold_ms": {"type": "number"}}),
+                 lambda threshold_ms=500.0: client.find_slow_operations(
+                     ns, threshold_ms=float(threshold_ms))),
+    ]
+    resources = [
+        ToolSpec("get_pods", "Pod list with status", _obj({}),
+                 lambda: client.get_pods(ns)),
+        ToolSpec("get_deployments", "Deployment list", _obj({}),
+                 lambda: client.get_deployments(ns)),
+        ToolSpec("get_resource_details",
+                 "Full manifest of one resource (kind + name)",
+                 _obj({"kind": _STR, "name": _STR}, ["kind", "name"]),
+                 lambda kind, name: client.get_resource_details(ns, kind, name)),
+        ToolSpec("get_namespace_events", "All namespace events", _obj({}),
+                 lambda: client.get_events(ns)),
+    ]
+    return {
+        "metrics": metrics,
+        "logs": logs,
+        "events": events,
+        "topology": topology,
+        "traces": traces,
+        "resources": resources,
+    }
