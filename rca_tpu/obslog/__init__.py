@@ -1,0 +1,6 @@
+"""Observability: evidence audit files + LLM prompt JSONL log."""
+
+from rca_tpu.obslog.evidence import EvidenceLogger
+from rca_tpu.obslog.prompts import PromptLogger, get_logger
+
+__all__ = ["EvidenceLogger", "PromptLogger", "get_logger"]

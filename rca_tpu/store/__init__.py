@@ -1,0 +1,8 @@
+"""Investigation persistence (file-locked JSON store)."""
+
+from rca_tpu.store.investigations import (
+    ACCUMULATED_FINDINGS_CAP,
+    InvestigationStore,
+)
+
+__all__ = ["ACCUMULATED_FINDINGS_CAP", "InvestigationStore"]

@@ -1,0 +1,143 @@
+"""Investigation store, evidence logger, prompt logger."""
+
+import json
+import multiprocessing
+
+import pytest
+
+from rca_tpu.obslog import EvidenceLogger, PromptLogger
+from rca_tpu.store import ACCUMULATED_FINDINGS_CAP, InvestigationStore
+
+
+@pytest.fixture
+def store(tmp_path):
+    return InvestigationStore(root=str(tmp_path / "logs"))
+
+
+def test_investigation_lifecycle(store):
+    inv = store.create_investigation("DB down", namespace="prod")
+    iid = inv["id"]
+    assert inv["status"] == "active"
+    assert set(inv) >= {
+        "id", "title", "namespace", "context", "created_at", "updated_at",
+        "summary", "status", "conversation", "evidence", "agent_findings",
+        "next_actions", "accumulated_findings",
+    }
+
+    store.add_message(iid, "user", "why is the db down?")
+    store.add_message(iid, "assistant", {"summary": "crash loop"})
+    store.set_next_actions(iid, [{"text": "check logs", "priority": "high"}])
+    store.add_evidence(iid, "pod_status", {"phase": "Running"})
+    store.add_agent_findings(iid, "logs", [{"issue": "oom"}])
+    store.update_summary(iid, "database crash looping")
+    store.save_hypothesis(iid, {"description": "bad init script"})
+
+    got = store.get_investigation(iid)
+    assert len(got["conversation"]) == 2
+    assert got["conversation"][0]["role"] == "user"
+    assert got["next_actions"][0]["priority"] == "high"
+    assert got["evidence"]["pod_status"]["phase"] == "Running"
+    assert got["agent_findings"]["logs"][0]["issue"] == "oom"
+    assert got["summary"] == "database crash looping"
+    assert got["hypotheses"][0]["description"] == "bad init script"
+
+
+def test_accumulated_findings_cap_and_dedup(store):
+    inv = store.create_investigation("t")
+    iid = inv["id"]
+    store.add_accumulated_findings(iid, ["a", "b", "a"])
+    got = store.get_investigation(iid)
+    assert got["accumulated_findings"] == ["a", "b"]
+    store.add_accumulated_findings(
+        iid, [f"f{i}" for i in range(ACCUMULATED_FINDINGS_CAP + 5)]
+    )
+    got = store.get_investigation(iid)
+    assert len(got["accumulated_findings"]) == ACCUMULATED_FINDINGS_CAP
+    assert got["accumulated_findings"][-1] == f"f{ACCUMULATED_FINDINGS_CAP + 4}"
+
+
+def test_list_sorted_newest_first(store):
+    a = store.create_investigation("first")
+    b = store.create_investigation("second")
+    store.add_message(a["id"], "user", "bump")  # a updated most recently
+    lst = store.list_investigations()
+    assert [r["title"] for r in lst] == ["first", "second"]
+    assert lst[0]["messages"] == 1
+
+
+def test_missing_investigation_returns_none(store):
+    assert store.get_investigation("nope") is None
+    assert store.add_message("nope", "user", "x") is None
+
+
+def _writer(args):
+    root, iid, start = args
+    store = InvestigationStore(root=root)
+    for i in range(start, start + 20):
+        store.add_message(iid, "user", f"m{i}")
+    return True
+
+
+def test_concurrent_writers_do_not_lose_messages(store):
+    """The reference had no locking (SURVEY.md §5); here 3 processes
+    appending concurrently must lose nothing."""
+    inv = store.create_investigation("race")
+    iid = inv["id"]
+    with multiprocessing.Pool(3) as pool:
+        pool.map(_writer, [(str(store.root), iid, k * 100) for k in range(3)])
+    got = store.get_investigation(iid)
+    assert len(got["conversation"]) == 60
+
+
+def test_evidence_logger_roundtrip(tmp_path):
+    ev = EvidenceLogger(root=str(tmp_path / "ev"))
+    p1 = ev.log_hypothesis(
+        "inv1", "Pod/db", {"description": "liveness probe failing"},
+        evidence={"restarts": 5},
+    )
+    ev.log_investigation_step(
+        "inv1", "Pod/db", {"description": "check logs"}, result="logs ok",
+        verdict={"verdict": "refuted", "confidence": 0.8},
+    )
+    ev.log_conclusion("inv1", "Pod/db", {"root_cause": "bad probe"})
+    assert p1.name.endswith("_hypothesis.json")
+    rec = json.loads(p1.read_text())
+    assert rec["investigation_id"] == "inv1"
+    hits = ev.get_evidence_for_hypothesis("liveness probe")
+    assert len(hits) == 1
+    assert ev.get_evidence_for_hypothesis("unrelated") == []
+
+
+def test_prompt_logger_jsonl_format(tmp_path):
+    pl = PromptLogger(root=str(tmp_path / "prompts"))
+    pl.log_interaction(
+        "the prompt", "the response",
+        investigation_id="inv9", user_query="why?", namespace="prod",
+        accumulated_findings=["f1"],
+        additional_context={"provider": "offline", "model": "m",
+                            "temperature": 0.2},
+    )
+    pl.log_system_event("provider_failover", {"from": "openai"})
+    records = pl.read_all()
+    assert len(records) == 2
+    r = records[0]
+    assert set(r) == {
+        "timestamp", "investigation_id", "user_query", "prompt", "response",
+        "namespace", "accumulated_findings", "additional_context",
+    }
+    assert r["additional_context"]["provider"] == "offline"
+    assert records[1]["additional_context"]["system_event"] == "provider_failover"
+
+
+def test_prompt_logger_llm_adapter(tmp_path):
+    from rca_tpu.llm import LLMClient, OfflineProvider
+
+    pl = PromptLogger(root=str(tmp_path / "prompts"))
+    llm = LLMClient(
+        provider=OfflineProvider(),
+        log_fn=pl.as_log_fn(investigation_id="inv1", namespace="ns"),
+    )
+    llm.generate_completion("hello")
+    records = pl.read_all()
+    assert records[0]["investigation_id"] == "inv1"
+    assert records[0]["additional_context"]["provider"] == "offline"
