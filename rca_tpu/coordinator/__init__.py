@@ -1,0 +1,31 @@
+"""Coordinator: orchestration, fusion, chat turns, hypothesis workflow."""
+
+from rca_tpu.coordinator.core import RCACoordinator
+from rca_tpu.coordinator.correlate import (
+    correlate_deterministic,
+    correlate_findings,
+    correlate_jax,
+    correlate_llm,
+    default_backend,
+    group_findings,
+)
+from rca_tpu.coordinator.structured import (
+    build_suggestions,
+    cluster_state_counts,
+    format_structured_response,
+    merge_llm_structured,
+)
+
+__all__ = [
+    "RCACoordinator",
+    "build_suggestions",
+    "cluster_state_counts",
+    "correlate_deterministic",
+    "correlate_findings",
+    "correlate_jax",
+    "correlate_llm",
+    "default_backend",
+    "format_structured_response",
+    "group_findings",
+    "merge_llm_structured",
+]

@@ -1,0 +1,256 @@
+"""Evidence fusion: agent findings → ranked root causes.
+
+Three backends behind one function (north star: ``RCA_BACKEND`` flag,
+BASELINE.json):
+
+- ``deterministic`` — group by component, rank by max-severity ×
+  related-finding count (parity with the reference's legacy coordinator,
+  reference: agents/coordinator.py:118-184);
+- ``jax`` — the TPU engine: explain-away propagation over the service
+  dependency graph (rca_tpu.engine), agent findings attached as supporting
+  evidence per ranked service.  Scores differ from the deterministic rank
+  but the grouped findings JSON is identical (parity gate: same groups,
+  same members);
+- ``llm`` — one LLM call over the flattened findings, as the reference's
+  live path did (reference: agents/mcp_coordinator.py:666-760), with the
+  deterministic result as fallback and as the structured skeleton.
+
+All backends return the same schema:
+``{root_causes: [{component, severity, score, finding_count, findings[]}],
+groups: {component: [finding,...]}, backend, summary}``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+from rca_tpu.agents.base import AnalysisContext
+from rca_tpu.findings import max_severity, severity_rank
+
+_SERVICE_SUFFIX = re.compile(r"-[a-z0-9]{8,10}-[a-z0-9]{5}$")
+
+
+def default_backend() -> str:
+    return os.environ.get("RCA_BACKEND", "jax").lower()
+
+
+def _component_service(component: str, service_names: List[str]) -> Optional[str]:
+    """Map 'Pod/frontend-7d8f675c7b-jk2x5' / 'Deployment/frontend' /
+    'Service/frontend' onto a service name."""
+    if "/" not in component:
+        return component if component in service_names else None
+    kind, name = component.split("/", 1)
+    if name in service_names:
+        return name
+    if kind == "Pod":
+        base = _SERVICE_SUFFIX.sub("", name)
+        if base in service_names:
+            return base
+        # single-suffix forms (statefulset ordinals, bare replicaset hash)
+        while "-" in base:
+            base = base.rsplit("-", 1)[0]
+            if base in service_names:
+                return base
+    return None
+
+
+def group_findings(
+    agent_results: Dict[str, Any]
+) -> Dict[str, List[dict]]:
+    """Flatten every agent's findings, tag source, group by component
+    (reference: mcp_coordinator.py:666-698 flatten+tag; coordinator.py:118
+    group-by-component)."""
+    groups: Dict[str, List[dict]] = {}
+    for agent_type, result in agent_results.items():
+        findings = (
+            result.get("findings", []) if isinstance(result, dict)
+            else getattr(result, "findings", [])
+        )
+        for f in findings:
+            tagged = {**f, "source": f.get("source", agent_type)}
+            groups.setdefault(str(f.get("component", "unknown")), []).append(
+                tagged
+            )
+    return groups
+
+
+def _rank_entry(component: str, findings: List[dict], score: float) -> dict:
+    return {
+        "component": component,
+        "severity": max_severity(f.get("severity", "info") for f in findings),
+        "score": round(float(score), 4),
+        "finding_count": len(findings),
+        "findings": findings,
+    }
+
+
+def correlate_deterministic(
+    agent_results: Dict[str, Any], top_k: int = 10
+) -> Dict[str, Any]:
+    groups = group_findings(agent_results)
+    ranked = []
+    for component, findings in groups.items():
+        sev = max(severity_rank(f.get("severity", "info")) for f in findings)
+        score = (sev + 1) * 10 + len(findings)
+        ranked.append(_rank_entry(component, findings, score))
+    ranked.sort(key=lambda r: (-r["score"], r["component"]))
+    top = ranked[:top_k]
+    summary = (
+        f"{len(groups)} component(s) with findings; top root cause: "
+        f"{top[0]['component']} ({top[0]['severity']})"
+        if top else "No findings to correlate."
+    )
+    return {
+        "root_causes": top,
+        "groups": groups,
+        "backend": "deterministic",
+        "summary": summary,
+    }
+
+
+def correlate_jax(
+    agent_results: Dict[str, Any],
+    ctx: AnalysisContext,
+    top_k: int = 10,
+    engine=None,
+) -> Dict[str, Any]:
+    """TPU propagation ranking with agent findings as supporting evidence.
+
+    Components that do not map onto a graph service (nodes, namespaces,
+    HPAs…) are appended after the engine-ranked services, ordered by the
+    deterministic severity rank.
+    """
+    from rca_tpu.engine import GraphEngine
+
+    engine = engine or GraphEngine()
+    fs = ctx.features
+    src, dst = ctx.dep_edges
+    result = engine.analyze_features(fs, src, dst, k=max(top_k, 5))
+
+    groups = group_findings(agent_results)
+    by_service: Dict[str, List[dict]] = {}
+    unmapped: Dict[str, List[dict]] = {}
+    for component, findings in groups.items():
+        svc = _component_service(component, fs.service_names)
+        if svc is None:
+            unmapped[component] = findings
+        else:
+            by_service.setdefault(svc, []).extend(findings)
+
+    ranked: List[dict] = []
+    for entry in result.ranked:
+        svc = entry["component"]
+        findings = by_service.pop(svc, [])
+        if entry["score"] <= 0 and not findings:
+            continue
+        e = _rank_entry(svc, findings, entry["score"])
+        e["anomaly"] = entry["anomaly"]
+        e["explained_by_upstream"] = entry["explained_by_upstream"]
+        e["downstream_impact"] = entry["downstream_impact"]
+        ranked.append(e)
+    # services the engine didn't surface but agents flagged
+    leftovers = [
+        _rank_entry(svc, findings, 0.0)
+        for svc, findings in by_service.items()
+    ] + [
+        _rank_entry(comp, findings, 0.0)
+        for comp, findings in unmapped.items()
+    ]
+    leftovers.sort(
+        key=lambda r: (-severity_rank(r["severity"]), r["component"])
+    )
+    ranked.extend(leftovers)
+    top = ranked[:top_k]
+    summary = (
+        f"TPU propagation over {result.n_services} services / "
+        f"{result.n_edges} edges in {result.latency_ms:.1f} ms; top root "
+        f"cause: {top[0]['component']}"
+        if top else "No findings to correlate."
+    )
+    return {
+        "root_causes": top,
+        "groups": groups,
+        "backend": "jax",
+        "summary": summary,
+        "engine_latency_ms": result.latency_ms,
+    }
+
+
+def correlate_llm(
+    agent_results: Dict[str, Any],
+    llm_client,
+    top_k: int = 10,
+) -> Dict[str, Any]:
+    """LLM fusion over the deterministic skeleton (reference:
+    mcp_coordinator.py:698-733 prompt: group related findings, identify
+    causal relationships, rank root causes)."""
+    import json
+
+    det = correlate_deterministic(agent_results, top_k=top_k)
+    flat = [
+        {k: f[k] for k in ("component", "issue", "severity", "source")
+         if k in f}
+        for findings in det["groups"].values()
+        for f in findings
+    ]
+    prompt = (
+        "Findings from Kubernetes analysis agents:\n"
+        + json.dumps(flat[:80])
+        + '\n\nGroup related findings, identify causal relationships, and '
+        'rank root causes. Respond as JSON: {"root_causes": [{"component": '
+        '"...", "reasoning": "...", "confidence": 0.0}], "summary": "..."}'
+    )
+    out = llm_client.generate_structured_output(prompt)
+    if not isinstance(out, dict) or not out.get("root_causes"):
+        return det
+    order = {
+        str(rc.get("component", "")): i
+        for i, rc in enumerate(out["root_causes"])
+        if isinstance(rc, dict)
+    }
+    reasons = {
+        str(rc.get("component", "")): rc
+        for rc in out["root_causes"]
+        if isinstance(rc, dict)
+    }
+    ranked = sorted(
+        det["root_causes"],
+        key=lambda r: (order.get(r["component"], len(order)), -r["score"]),
+    )
+    for r in ranked:
+        rc = reasons.get(r["component"])
+        if rc:
+            r["reasoning"] = str(rc.get("reasoning", ""))
+            if isinstance(rc.get("confidence"), (int, float)):
+                r["confidence"] = float(rc["confidence"])
+    return {
+        **det,
+        "root_causes": ranked[:top_k],
+        "backend": "llm",
+        "summary": str(out.get("summary", det["summary"])),
+    }
+
+
+def correlate_findings(
+    agent_results: Dict[str, Any],
+    ctx: Optional[AnalysisContext] = None,
+    backend: Optional[str] = None,
+    llm_client=None,
+    top_k: int = 10,
+    engine=None,
+) -> Dict[str, Any]:
+    """Dispatch on backend; unusable backends degrade to deterministic."""
+    backend = (backend or default_backend()).lower()
+    if backend == "jax" and ctx is not None:
+        try:
+            return correlate_jax(agent_results, ctx, top_k=top_k, engine=engine)
+        except Exception:
+            backend = "deterministic"
+    if backend == "llm" and llm_client is not None:
+        try:
+            return correlate_llm(agent_results, llm_client, top_k=top_k)
+        except Exception:
+            backend = "deterministic"
+    return correlate_deterministic(agent_results, top_k=top_k)

@@ -129,7 +129,7 @@ class LLMClient:
             messages.append({"role": "system", "content": system_prompt})
         messages.append({"role": "user", "content": prompt})
         reply = self.provider.complete(messages, json_mode=True)
-        self._log(prompt, reply.text, kind="structured", **log_context)
+        self._log(prompt, reply.text, **{"kind": "structured", **log_context})
         return parse_json_response(reply.text)
 
     # -- plain completion ----------------------------------------------------
@@ -148,7 +148,7 @@ class LLMClient:
         reply = self.provider.complete(
             messages, temperature=temperature, max_tokens=max_tokens
         )
-        self._log(prompt, reply.text, kind="completion", **log_context)
+        self._log(prompt, reply.text, **{"kind": "completion", **log_context})
         return reply.text
 
 
