@@ -1,0 +1,227 @@
+"""Command-line interface: the reference's Streamlit-only surface, scriptable.
+
+``python -m rca_tpu <command>``:
+
+- ``analyze``   one agent or the comprehensive pipeline → findings JSON
+- ``chat``      one chat turn (structured response + suggestions)
+- ``suggest``   execute one suggestion action
+- ``bench``     engine latency on a synthetic cascade
+- ``investigations``  list / show persisted investigations
+- ``ui``        launch the Streamlit app (when streamlit is installed)
+
+Fixtures: ``--fixture 5svc`` (the faulted hermetic world,
+reference: utils/mock_k8s_client.py) or ``--fixture <N>svc`` (synthetic
+cascade, e.g. ``50svc``, ``2000svc``); omit ``--fixture`` for a live
+cluster via kubeconfig.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Optional
+
+
+def _make_client(fixture: Optional[str], seed: int = 0):
+    from rca_tpu.cluster.mock_client import MockClusterClient
+
+    if fixture in (None, "", "live"):
+        from rca_tpu.cluster.k8s_client import K8sApiClient
+
+        return K8sApiClient(), None
+    if fixture == "5svc":
+        from rca_tpu.cluster.fixtures import NS, five_service_world
+
+        return MockClusterClient(five_service_world()), NS
+    m = re.fullmatch(r"(\d+)svc", fixture)
+    if m:
+        from rca_tpu.cluster.generator import synthetic_cascade_world
+
+        world = synthetic_cascade_world(int(m.group(1)), n_roots=1, seed=seed)
+        return MockClusterClient(world), "synthetic"
+    raise SystemExit(f"unknown fixture: {fixture!r} (want 5svc, <N>svc, live)")
+
+
+def _coordinator(args):
+    from rca_tpu.coordinator import RCACoordinator
+    from rca_tpu.llm import LLMClient, make_provider
+    from rca_tpu.obslog import get_logger
+
+    client, ns = _make_client(getattr(args, "fixture", None),
+                              getattr(args, "seed", 0))
+    namespace = getattr(args, "namespace", None) or ns or "default"
+    prompt_logger = get_logger(getattr(args, "log_dir", "logs") + "/prompts")
+    llm = LLMClient(
+        provider=make_provider(getattr(args, "provider", None)),
+        log_fn=prompt_logger.as_log_fn(namespace=namespace),
+    )
+    coord = RCACoordinator(
+        client, llm_client=llm,
+        backend=getattr(args, "backend", None),
+        use_llm_agents=getattr(args, "llm_agents", False),
+    )
+    return coord, namespace
+
+
+def cmd_analyze(args) -> int:
+    coord, namespace = _coordinator(args)
+    record = coord.run_analysis(args.type, namespace)
+    out = record if args.full else {
+        "status": record["status"],
+        "summary": record.get("summary", ""),
+        "root_causes": record.get("results", {})
+        .get("correlated", {})
+        .get("root_causes", [])
+        if args.type == "comprehensive"
+        else record.get("results", {}).get(args.type, {}).get("findings", []),
+        **({"error": record["error"]} if "error" in record else {}),
+    }
+    print(json.dumps(out, indent=None if args.compact else 2, default=str))
+    return 0 if record["status"] == "completed" else 1
+
+
+def cmd_chat(args) -> int:
+    coord, namespace = _coordinator(args)
+    out = coord.process_user_query(args.query, namespace)
+    if not args.full:
+        out.pop("cluster_state", None)
+    print(json.dumps(out, indent=None if args.compact else 2, default=str))
+    return 0
+
+
+def cmd_suggest(args) -> int:
+    coord, namespace = _coordinator(args)
+    action = json.loads(args.action)
+    out = coord.process_suggestion(action, namespace)
+    print(json.dumps(out, indent=None if args.compact else 2, default=str))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine import GraphEngine
+
+    case = synthetic_cascade_arrays(
+        args.services, n_roots=args.roots, seed=args.seed
+    )
+    result = GraphEngine().analyze_case(case, k=5, timed=True)
+    truth = {case.names[r] for r in case.roots.tolist()}
+    print(
+        json.dumps(
+            {
+                "n_services": args.services,
+                "n_edges": result.n_edges,
+                "latency_ms": round(result.latency_ms, 3),
+                "top1_hit": result.ranked[0]["component"] in truth,
+                "ranked": result.ranked[:5],
+            },
+            default=str,
+        )
+    )
+    return 0
+
+
+def cmd_investigations(args) -> int:
+    from rca_tpu.store import InvestigationStore
+
+    store = InvestigationStore(root=args.log_dir)
+    if args.id:
+        inv = store.get_investigation(args.id)
+        if inv is None:
+            print(json.dumps({"error": f"no investigation {args.id}"}))
+            return 1
+        print(json.dumps(inv, indent=2, default=str))
+    else:
+        print(json.dumps(store.list_investigations(), indent=2, default=str))
+    return 0
+
+
+def cmd_ui(args) -> int:
+    try:
+        import streamlit  # noqa: F401
+    except ImportError:
+        print(
+            "streamlit is not installed; the coordinator API and CLI expose "
+            "the same capabilities (try: python -m rca_tpu analyze "
+            "--fixture 5svc).",
+            file=sys.stderr,
+        )
+        return 1
+    import subprocess
+
+    from rca_tpu.ui import app as ui_app
+
+    return subprocess.call(
+        [sys.executable, "-m", "streamlit", "run", ui_app.__file__,
+         "--server.port", str(args.port)]
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rca_tpu", description="TPU-native Kubernetes RCA framework"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp):
+        sp.add_argument("--fixture", default=None,
+                        help="5svc | <N>svc | live (default: live)")
+        sp.add_argument("--namespace", default=None)
+        sp.add_argument("--backend", default=None,
+                        help="jax | deterministic | llm (default: $RCA_BACKEND or jax)")
+        sp.add_argument("--provider", default=None,
+                        help="openai | anthropic | offline")
+        sp.add_argument("--llm-agents", action="store_true",
+                        help="use LLM agents instead of deterministic rules")
+        sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument("--log-dir", default="logs")
+        sp.add_argument("--full", action="store_true",
+                        help="print the full record")
+        sp.add_argument("--compact", action="store_true",
+                        help="single-line JSON")
+
+    sp = sub.add_parser("analyze", help="run an analysis")
+    common(sp)
+    sp.add_argument("--type", default="comprehensive",
+                    help="comprehensive | resources | metrics | logs | "
+                    "events | topology | traces")
+    sp.set_defaults(fn=cmd_analyze)
+
+    sp = sub.add_parser("chat", help="one chat turn")
+    common(sp)
+    sp.add_argument("query")
+    sp.set_defaults(fn=cmd_chat)
+
+    sp = sub.add_parser("suggest", help="execute one suggestion action")
+    common(sp)
+    sp.add_argument("action", help='JSON, e.g. {"type": "check_logs", '
+                    '"pod_name": "x"}')
+    sp.set_defaults(fn=cmd_suggest)
+
+    sp = sub.add_parser("bench", help="engine latency benchmark")
+    sp.add_argument("--services", type=int, default=2000)
+    sp.add_argument("--roots", type=int, default=3)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser("investigations", help="list/show investigations")
+    sp.add_argument("--id", default=None)
+    sp.add_argument("--log-dir", default="logs")
+    sp.set_defaults(fn=cmd_investigations)
+
+    sp = sub.add_parser("ui", help="launch the Streamlit app")
+    sp.add_argument("--port", type=int, default=5000)
+    sp.set_defaults(fn=cmd_ui)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""UI surface: Streamlit app (thin) + pure render helpers."""
+
+from rca_tpu.ui.render import (
+    finding_markdown,
+    initial_suggestions,
+    report_markdown,
+    response_markdown,
+    root_causes_markdown,
+    topology_plot_data,
+)
+
+__all__ = [
+    "finding_markdown",
+    "initial_suggestions",
+    "report_markdown",
+    "response_markdown",
+    "root_causes_markdown",
+    "topology_plot_data",
+]

@@ -1,0 +1,201 @@
+"""Streamlit chat UI over the coordinator (thin — all logic lives below).
+
+Surface parity with the reference's UI (reference: app.py:85-210 main flow,
+components/chatbot_interface.py chat loop + suggestion buttons,
+components/sidebar.py investigation list/create + connection status,
+components/interactive_session.py 4-stage wizard, components/report.py,
+components/visualization.py).  Run via ``python -m rca_tpu ui`` or
+``streamlit run rca_tpu/ui/app.py``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from rca_tpu.ui.render import (
+    initial_suggestions,
+    report_markdown,
+    response_markdown,
+    root_causes_markdown,
+    topology_plot_data,
+)
+
+
+def _build_services():
+    """Construct client/coordinator/store once per session."""
+    from rca_tpu.coordinator import RCACoordinator
+    from rca_tpu.llm import LLMClient, make_provider
+    from rca_tpu.obslog import EvidenceLogger, get_logger
+    from rca_tpu.store import InvestigationStore
+
+    fixture = os.environ.get("RCA_FIXTURE", "")
+    if fixture:
+        from rca_tpu.cluster.fixtures import five_service_world
+        from rca_tpu.cluster.mock_client import MockClusterClient
+
+        client = MockClusterClient(five_service_world())
+    else:
+        from rca_tpu.cluster.k8s_client import K8sApiClient
+
+        client = K8sApiClient()
+    store = InvestigationStore(root="logs")
+    prompt_logger = get_logger()
+    llm = LLMClient(
+        provider=make_provider(), log_fn=prompt_logger.as_log_fn()
+    )
+    coord = RCACoordinator(
+        client, llm_client=llm,
+        evidence_logger=EvidenceLogger(root="logs/evidence"),
+    )
+    return client, coord, store
+
+
+def main() -> None:  # pragma: no cover - needs streamlit runtime
+    import streamlit as st
+
+    st.set_page_config(page_title="K8s RCA (TPU)", layout="wide")
+
+    if "services" not in st.session_state:
+        st.session_state.services = _build_services()
+    client, coord, store = st.session_state.services
+
+    # ---- sidebar: investigations + connection (reference: sidebar.py) ----
+    with st.sidebar:
+        st.title("Investigations")
+        connected = client.is_connected()
+        st.caption(
+            ("🟢 connected: " + client.get_cluster_info().get("name", ""))
+            if connected else "🔴 no cluster — mock/offline mode"
+        )
+        namespaces = client.get_namespaces() or ["default"]
+        namespace = st.selectbox("Namespace", namespaces)
+        if st.button("New investigation"):
+            inv = store.create_investigation(
+                "New investigation", namespace=namespace
+            )
+            st.session_state.investigation_id = inv["id"]
+            st.session_state.pop("suggestions", None)
+            st.rerun()
+        for row in store.list_investigations()[:15]:
+            if st.button(
+                f"{row['title'][:40]} · {row['messages']} msgs",
+                key=f"inv-{row['id']}",
+            ):
+                st.session_state.investigation_id = row["id"]
+                st.rerun()
+
+    inv_id = st.session_state.get("investigation_id")
+    if not inv_id:
+        inv = store.create_investigation("New investigation",
+                                         namespace=namespace)
+        inv_id = st.session_state.investigation_id = inv["id"]
+    investigation = store.get_investigation(inv_id) or {}
+
+    st.title("Kubernetes Root Cause Analysis")
+    tab_chat, tab_report, tab_topology = st.tabs(
+        ["Chat", "Report", "Topology"]
+    )
+
+    # ---- chat tab (reference: chatbot_interface.py) ----------------------
+    with tab_chat:
+        for msg in investigation.get("conversation", []):
+            with st.chat_message(msg["role"]):
+                content = msg["content"]
+                if isinstance(content, dict):
+                    st.markdown(
+                        response_markdown(content.get("response_data", {}))
+                    )
+                else:
+                    st.markdown(str(content))
+
+        suggestions = (
+            investigation.get("next_actions")
+            or initial_suggestions(namespace)
+        )
+        cols = st.columns(min(len(suggestions), 5) or 1)
+        clicked = None
+        for col, sugg in zip(cols, suggestions):
+            with col:
+                if st.button(sugg["text"], key=f"sugg-{sugg['text'][:30]}"):
+                    clicked = sugg
+
+        query = st.chat_input("Ask about the cluster…")
+        if clicked is not None:
+            store.add_message(inv_id, "user", clicked["text"])
+            out = coord.process_suggestion(
+                clicked.get("action", {}), namespace,
+                investigation.get("accumulated_findings"),
+            )
+            store.add_message(
+                inv_id, "assistant", {"response_data": out["response"]}
+            )
+            store.set_next_actions(inv_id, out["suggestions"])
+            store.add_accumulated_findings(inv_id, out["key_findings"])
+            st.rerun()
+        elif query:
+            store.add_message(inv_id, "user", query)
+            out = coord.process_user_query(
+                query, namespace, investigation.get("accumulated_findings")
+            )
+            store.add_message(
+                inv_id, "assistant",
+                {"response_data": out["response_data"],
+                 "summary": out["summary"]},
+            )
+            store.set_next_actions(inv_id, out["suggestions"])
+            store.add_accumulated_findings(inv_id, out["key_findings"])
+            if len(investigation.get("conversation", [])) == 0:
+                title = coord.generate_summary_from_query(query, out)
+                store._update(
+                    inv_id, lambda inv: inv.__setitem__("title", title)
+                )
+            st.rerun()
+
+    # ---- report tab (reference: report.py) -------------------------------
+    with tab_report:
+        if st.button("Run comprehensive analysis"):
+            with st.spinner("Analyzing (TPU fusion)…"):
+                record = coord.run_analysis("comprehensive", namespace)
+            st.session_state.last_results = record.get("results", {})
+            store.add_agent_findings(inv_id, "comprehensive", record)
+        results = st.session_state.get("last_results")
+        if results:
+            st.markdown(root_causes_markdown(results.get("correlated", {})))
+            with st.expander("Full report"):
+                st.markdown(report_markdown(results))
+
+    # ---- topology tab (reference: visualization.py) ----------------------
+    with tab_topology:
+        if st.button("Build topology graph"):
+            ctx = coord.capture(namespace)
+            st.session_state.topology = ctx.graph.to_dict()
+        graph = st.session_state.get("topology")
+        if graph:
+            data = topology_plot_data(graph)
+            try:
+                import plotly.graph_objects as go
+
+                fig = go.Figure()
+                for e in data["edges"]:
+                    fig.add_trace(
+                        go.Scatter(
+                            x=[e["x0"], e["x1"]], y=[e["y0"], e["y1"]],
+                            mode="lines", line={"width": 1},
+                            hoverinfo="none", showlegend=False,
+                        )
+                    )
+                fig.add_trace(
+                    go.Scatter(
+                        x=[n["x"] for n in data["nodes"]],
+                        y=[n["y"] for n in data["nodes"]],
+                        text=[n["id"] for n in data["nodes"]],
+                        mode="markers+text", textposition="top center",
+                    )
+                )
+                st.plotly_chart(fig, use_container_width=True)
+            except ImportError:
+                st.json(data)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,141 @@
+"""Pure render helpers (no streamlit import) — testable without the UI.
+
+These build the markdown/plot payloads the Streamlit layer displays, parity
+with the reference's render logic (reference: components/report.py:57-196
+tabbed report, components/visualization.py:647-764 topology scatter data,
+components/chatbot_interface.py:90-143 starter suggestions).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+SEVERITY_ICONS = {
+    "critical": "🔴", "high": "🟠", "medium": "🟡", "low": "🔵", "info": "⚪",
+}
+
+
+def initial_suggestions(namespace: str) -> List[Dict[str, Any]]:
+    """Canned starter actions (reference: chatbot_interface.py:90-143)."""
+    return [
+        {"text": "Run a comprehensive analysis", "priority": "high",
+         "reasoning": "correlates all signals into ranked root causes",
+         "action": {"type": "run_agent", "agent_type": "comprehensive"}},
+        {"text": "Check for problem pods", "priority": "medium",
+         "reasoning": "fast pod-level health overview",
+         "action": {"type": "query",
+                    "query": f"Which pods in {namespace} have problems?"}},
+        {"text": "Review warning events", "priority": "medium",
+         "reasoning": "events often name the failure directly",
+         "action": {"type": "run_agent", "agent_type": "events"}},
+        {"text": "Inspect service topology", "priority": "low",
+         "reasoning": "dependency structure shows blast radius",
+         "action": {"type": "run_agent", "agent_type": "topology"}},
+        {"text": "Check resource utilization", "priority": "low",
+         "reasoning": "CPU/memory pressure causes cascading symptoms",
+         "action": {"type": "run_agent", "agent_type": "metrics"}},
+    ]
+
+
+def finding_markdown(f: Dict[str, Any]) -> str:
+    icon = SEVERITY_ICONS.get(str(f.get("severity", "info")).lower(), "⚪")
+    return (
+        f"{icon} **{f.get('component', '?')}** — {f.get('issue', '')}\n\n"
+        f"- severity: `{f.get('severity', '')}`  · source: "
+        f"`{f.get('source', 'rule')}`\n"
+        f"- recommendation: {f.get('recommendation', '')}"
+    )
+
+
+def root_causes_markdown(correlated: Dict[str, Any]) -> str:
+    lines = [f"### Ranked root causes ({correlated.get('backend', '?')} backend)"]
+    for i, rc in enumerate(correlated.get("root_causes", [])[:10]):
+        icon = SEVERITY_ICONS.get(str(rc.get("severity", "info")), "⚪")
+        lines.append(
+            f"{i + 1}. {icon} **{rc['component']}** — score "
+            f"{rc.get('score', 0):.3f}, {rc.get('finding_count', 0)} "
+            f"finding(s), max severity {rc.get('severity', '')}"
+        )
+    if correlated.get("engine_latency_ms"):
+        lines.append(
+            f"\n*TPU propagation latency: "
+            f"{correlated['engine_latency_ms']:.1f} ms*"
+        )
+    return "\n".join(lines)
+
+
+def response_markdown(response_data: Dict[str, Any]) -> str:
+    lines = [f"- {p}" for p in response_data.get("points", [])]
+    for sec in response_data.get("sections", []):
+        lines.append(f"\n**{sec.get('title', '')}**")
+        content = sec.get("content", [])
+        if isinstance(content, list):
+            lines += [f"  - {c}" for c in content]
+        else:
+            lines.append(f"  {content}")
+    return "\n".join(lines)
+
+
+def topology_plot_data(graph_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Deterministic circular layout for the typed graph — node/edge coords
+    ready for any scatter backend (reference used networkx spring_layout,
+    components/visualization.py:647-764; a fixed layout keeps the UI stable
+    across reruns)."""
+    nodes = graph_dict.get("nodes", [])
+    edges = graph_dict.get("edges", [])
+    n = max(len(nodes), 1)
+    pos = {}
+    by_type: Dict[str, List[int]] = {}
+    for i, node in enumerate(nodes):
+        by_type.setdefault(node.get("type", "service"), []).append(i)
+    # concentric rings per node type
+    ring_radius = {"service": 1.0, "workload": 1.6, "ingress": 0.5,
+                   "configmap": 2.1, "secret": 2.1}
+    for ntype, members in by_type.items():
+        r = ring_radius.get(ntype, 1.3)
+        for k, i in enumerate(members):
+            theta = 2 * math.pi * k / max(len(members), 1)
+            pos[nodes[i]["id"]] = (r * math.cos(theta), r * math.sin(theta))
+    return {
+        "nodes": [
+            {"id": node["id"], "type": node.get("type", ""),
+             "x": pos[node["id"]][0], "y": pos[node["id"]][1]}
+            for node in nodes
+        ],
+        "edges": [
+            {
+                "source": e["source"], "target": e["target"],
+                "relation": e.get("relation", ""),
+                "x0": pos.get(e["source"], (0, 0))[0],
+                "y0": pos.get(e["source"], (0, 0))[1],
+                "x1": pos.get(e["target"], (0, 0))[0],
+                "y1": pos.get(e["target"], (0, 0))[1],
+            }
+            for e in edges
+            if e["source"] in pos and e["target"] in pos
+        ],
+    }
+
+
+def report_markdown(results: Dict[str, Any]) -> str:
+    """Full comprehensive-analysis report (reference: components/report.py)."""
+    correlated = results.get("correlated", {})
+    parts = [
+        "# Root Cause Analysis Report",
+        "",
+        results.get("summary", ""),
+        "",
+        root_causes_markdown(correlated),
+        "",
+        "## Per-agent findings",
+    ]
+    for agent, res in results.items():
+        if not isinstance(res, dict) or "findings" not in res:
+            continue
+        parts.append(f"\n### {agent} ({len(res['findings'])} findings)")
+        parts.append(res.get("summary", ""))
+        for f in res["findings"][:15]:
+            parts.append("")
+            parts.append(finding_markdown(f))
+    return "\n".join(parts)

@@ -1,0 +1,163 @@
+"""CLI commands (subprocess-free, via main(argv)) and pure UI renderers."""
+
+import json
+
+import pytest
+
+from rca_tpu.cli import main
+from rca_tpu.ui.render import (
+    finding_markdown,
+    initial_suggestions,
+    report_markdown,
+    response_markdown,
+    root_causes_markdown,
+    topology_plot_data,
+)
+
+
+def run_cli(capsys, *argv):
+    code = main(list(argv))
+    out = capsys.readouterr().out
+    return code, out
+
+
+def test_cli_analyze_comprehensive(capsys, tmp_path):
+    code, out = run_cli(
+        capsys, "analyze", "--fixture", "5svc", "--compact",
+        "--log-dir", str(tmp_path),
+    )
+    assert code == 0
+    data = json.loads(out)
+    assert data["status"] == "completed"
+    comps = [r["component"] for r in data["root_causes"][:2]]
+    assert set(comps) == {"database", "api-gateway"}
+
+
+def test_cli_analyze_single_agent(capsys, tmp_path):
+    code, out = run_cli(
+        capsys, "analyze", "--fixture", "5svc", "--type", "logs",
+        "--compact", "--log-dir", str(tmp_path),
+    )
+    assert code == 0
+    data = json.loads(out)
+    assert any("database" in f["component"] for f in data["root_causes"])
+
+
+def test_cli_chat(capsys, tmp_path):
+    code, out = run_cli(
+        capsys, "chat", "--fixture", "5svc", "--compact",
+        "--log-dir", str(tmp_path), "what is broken?",
+    )
+    assert code == 0
+    data = json.loads(out)
+    assert data["response_data"]["points"]
+    assert data["suggestions"]
+
+
+def test_cli_suggest(capsys, tmp_path):
+    code, out = run_cli(
+        capsys, "suggest", "--fixture", "5svc", "--compact",
+        "--log-dir", str(tmp_path),
+        json.dumps({"type": "check_logs",
+                    "pod_name": "database-7c9f8b6d5e-3x5qp"}),
+    )
+    assert code == 0
+    data = json.loads(out)
+    assert data["key_findings"]
+
+
+def test_cli_synthetic_fixture(capsys, tmp_path):
+    code, out = run_cli(
+        capsys, "analyze", "--fixture", "50svc", "--compact",
+        "--log-dir", str(tmp_path),
+    )
+    assert code == 0
+    data = json.loads(out)
+    assert data["status"] == "completed"
+    assert data["root_causes"]
+
+
+def test_cli_investigations(capsys, tmp_path):
+    from rca_tpu.store import InvestigationStore
+
+    store = InvestigationStore(root=str(tmp_path))
+    inv = store.create_investigation("t1")
+    code, out = run_cli(capsys, "investigations", "--log-dir", str(tmp_path))
+    assert code == 0
+    assert json.loads(out)[0]["id"] == inv["id"]
+    code, out = run_cli(
+        capsys, "investigations", "--log-dir", str(tmp_path),
+        "--id", inv["id"],
+    )
+    assert code == 0
+    assert json.loads(out)["title"] == "t1"
+    code, _ = run_cli(
+        capsys, "investigations", "--log-dir", str(tmp_path), "--id", "nope",
+    )
+    assert code == 1
+
+
+def test_cli_unknown_fixture(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["analyze", "--fixture", "banana"])
+
+
+def test_render_helpers():
+    sugg = initial_suggestions("prod")
+    assert len(sugg) == 5
+    assert sugg[0]["action"]["type"] == "run_agent"
+
+    f = {"component": "Pod/x", "issue": "boom", "severity": "critical",
+         "recommendation": "fix it", "source": "logs"}
+    md = finding_markdown(f)
+    assert "Pod/x" in md and "critical" in md
+
+    correlated = {
+        "backend": "jax",
+        "root_causes": [
+            {"component": "database", "score": 1.5, "finding_count": 3,
+             "severity": "critical"},
+        ],
+        "engine_latency_ms": 12.5,
+    }
+    md = root_causes_markdown(correlated)
+    assert "database" in md and "12.5 ms" in md
+
+    md = response_markdown(
+        {"points": ["p1"], "sections": [{"title": "T", "content": ["c1"]}]}
+    )
+    assert "- p1" in md and "**T**" in md
+
+    rep = report_markdown(
+        {"correlated": correlated, "summary": "all broken",
+         "logs": {"findings": [f], "summary": "1 log finding"}}
+    )
+    assert "Root Cause Analysis Report" in rep
+    assert "all broken" in rep and "Pod/x" in rep
+
+
+def test_topology_plot_data_layout():
+    graph = {
+        "nodes": [
+            {"id": "service/a", "type": "service"},
+            {"id": "service/b", "type": "service"},
+            {"id": "workload/w", "type": "workload"},
+        ],
+        "edges": [
+            {"source": "service/a", "target": "workload/w",
+             "relation": "selects"},
+            {"source": "service/a", "target": "ghost", "relation": "routes"},
+        ],
+    }
+    data = topology_plot_data(graph)
+    assert len(data["nodes"]) == 3
+    # edges to unknown nodes are dropped, coords attached
+    assert len(data["edges"]) == 1
+    e = data["edges"][0]
+    assert {"x0", "y0", "x1", "y1"} <= set(e)
+    # deterministic: same input, same layout
+    assert topology_plot_data(graph) == data
+
+
+def test_ui_app_importable_without_streamlit():
+    import rca_tpu.ui.app  # noqa: F401
