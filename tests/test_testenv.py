@@ -1,0 +1,101 @@
+"""Test-environment parity: signature-level client conformance and the
+kind-cluster manifest generator (dry-run, hermetic)."""
+
+import inspect
+import json
+import subprocess
+import sys
+
+from rca_tpu.cluster import CLUSTER_CLIENT_METHODS, MockClusterClient
+from rca_tpu.cluster.k8s_client import K8sApiClient
+
+
+def test_signature_conformance_mock_vs_real():
+    """Same parameter names in the same order for every protocol method —
+    the reference's get_pod_logs skew (SURVEY.md §2.6) is structurally
+    impossible."""
+    for m in CLUSTER_CLIENT_METHODS:
+        mock_params = list(
+            inspect.signature(getattr(MockClusterClient, m)).parameters
+        )
+        real_params = list(
+            inspect.signature(getattr(K8sApiClient, m)).parameters
+        )
+        assert mock_params == real_params, (
+            f"{m}: mock{mock_params} != real{real_params}"
+        )
+
+
+def test_setup_cluster_dry_run_manifests():
+    sys.path.insert(0, "tools")
+    try:
+        import setup_test_cluster as stc
+    finally:
+        sys.path.pop(0)
+
+    manifests = stc.build_manifests()
+    by_kind = {}
+    for m in manifests:
+        by_kind.setdefault(m["kind"], []).append(m)
+    assert len(by_kind["Deployment"]) == 5
+    assert len(by_kind["Service"]) == 5
+    assert len(by_kind["NetworkPolicy"]) == 1
+
+    deployments = {
+        d["metadata"]["name"]: d for d in by_kind["Deployment"]
+    }
+    # injected faults match the hermetic fixture's world
+    db_cmd = " ".join(
+        deployments["database"]["spec"]["template"]["spec"]["containers"][0]
+        ["command"]
+    )
+    assert "exit 1" in db_cmd
+    gw_cmd = " ".join(
+        deployments["api-gateway"]["spec"]["template"]["spec"]["containers"]
+        [0]["command"]
+    )
+    assert "REQUIRED_API_KEY" in gw_cmd
+    rs = deployments["resource-service"]["spec"]["template"]["spec"]
+    assert rs["volumes"][0]["emptyDir"] == {"medium": "Memory"}
+    assert (
+        rs["containers"][0]["resources"]["limits"]["memory"] == "128Mi"
+    )
+    np_from = by_kind["NetworkPolicy"][0]["spec"]["ingress"][0]["from"][0]
+    assert np_from["podSelector"]["matchLabels"]["app"] == (
+        "non-existent-service"
+    )
+
+    # expected-findings oracle covers every injected fault component
+    comps = {e["component"] for e in stc.expected_findings()}
+    assert comps >= {
+        "database", "api-gateway", "backend", "resource-service",
+    }
+
+
+def test_setup_cluster_dry_run_cli():
+    out = subprocess.run(
+        [sys.executable, "tools/setup_test_cluster.py", "--dry-run"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0
+    assert "api-gateway" in out.stdout
+    assert "expected findings" in out.stderr
+
+
+def test_mock_and_manifests_agree_on_fault_roots():
+    """The hermetic fixture and the live-cluster manifests model the same
+    faulted world — analyzers can be validated against either."""
+    sys.path.insert(0, "tools")
+    try:
+        import setup_test_cluster as stc
+    finally:
+        sys.path.pop(0)
+    from rca_tpu.cluster.fixtures import five_service_world
+
+    world = five_service_world()
+    fixture_faults = set(world.ground_truth["faults"])
+    manifest_comps = {
+        e["component"] for e in stc.expected_findings()
+        if e["component"] != "backend-network-policy"
+    }
+    assert fixture_faults == manifest_comps

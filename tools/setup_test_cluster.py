@@ -1,0 +1,272 @@
+#!/usr/bin/env python3
+"""Create a kind cluster with 5 intentionally-faulted microservices.
+
+Behavioral parity with the reference's live test environment (reference:
+setup_test_cluster.py — backend busybox CPU spin-loop :160-162, database
+``sleep 30; exit 1`` restart loop :209, api-gateway exiting on a missing
+required env var :256, resource-service writing ~90MiB into a memory-backed
+emptyDir against a 128Mi limit :303-310, a NetworkPolicy admitting traffic
+only from a nonexistent app :329-346; kind-config.yaml:1-12) — with the
+manifests generated programmatically and a ``--dry-run`` mode that prints
+them without needing Docker, so the generator itself is testable hermetically.
+
+Usage:
+    python tools/setup_test_cluster.py                 # create + deploy
+    python tools/setup_test_cluster.py --dry-run       # print manifests
+    python tools/setup_test_cluster.py --delete        # tear down
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List
+
+CLUSTER_NAME = "rca-tpu-test"
+NAMESPACE = "test-microservices"
+
+KIND_CONFIG: Dict[str, Any] = {
+    "kind": "Cluster",
+    "apiVersion": "kind.x-k8s.io/v1alpha4",
+    "name": CLUSTER_NAME,
+    "nodes": [
+        {
+            "role": "control-plane",
+            "extraPortMappings": [
+                {"containerPort": 30080, "hostPort": 30080,
+                 "protocol": "TCP"},
+            ],
+        }
+    ],
+}
+
+
+def _workload(
+    name: str,
+    command: List[str],
+    replicas: int = 1,
+    env: List[dict] | None = None,
+    env_from: List[dict] | None = None,
+    requests: Dict[str, str] | None = None,
+    limits: Dict[str, str] | None = None,
+    volumes: List[dict] | None = None,
+    volume_mounts: List[dict] | None = None,
+) -> Dict[str, Any]:
+    container: Dict[str, Any] = {
+        "name": name,
+        "image": "busybox:1.36",
+        "command": command,
+        "resources": {
+            "requests": requests or {"cpu": "50m", "memory": "64Mi"},
+            "limits": limits or {"cpu": "200m", "memory": "128Mi"},
+        },
+    }
+    if env:
+        container["env"] = env
+    if env_from:
+        container["envFrom"] = env_from
+    if volume_mounts:
+        container["volumeMounts"] = volume_mounts
+    spec: Dict[str, Any] = {"containers": [container]}
+    if volumes:
+        spec["volumes"] = volumes
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": NAMESPACE,
+                     "labels": {"app": name}},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": spec,
+            },
+        },
+    }
+
+
+def _service(name: str, port: int = 80) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": NAMESPACE},
+        "spec": {
+            "selector": {"app": name},
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+def build_manifests() -> List[Dict[str, Any]]:
+    """The 5-service faulted world as Kubernetes manifests."""
+    manifests: List[Dict[str, Any]] = [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": NAMESPACE}},
+    ]
+
+    # frontend: healthy, 2 replicas, talks to api-gateway
+    manifests.append(
+        _workload(
+            "frontend",
+            ["sh", "-c", "while true; do sleep 30; done"],
+            replicas=2,
+            env=[{"name": "API_URL",
+                  "value": f"http://api-gateway.{NAMESPACE}.svc"
+                  ":80"}],
+        )
+    )
+    # backend: CPU spin-loop (high CPU fault), depends on database
+    manifests.append(
+        _workload(
+            "backend",
+            ["sh", "-c",
+             "while true; do echo spin | md5sum > /dev/null; done"],
+            env=[{"name": "DATABASE_URL",
+                  "value": f"http://database.{NAMESPACE}.svc:5432"}],
+            limits={"cpu": "200m", "memory": "128Mi"},
+        )
+    )
+    # database: restart loop (exits 1 after 30s)
+    manifests.append(
+        _workload(
+            "database",
+            ["sh", "-c",
+             "echo 'INFO: Starting database...'; sleep 30; "
+             "echo 'ERROR: Database initialization failed'; exit 1"],
+        )
+    )
+    # api-gateway: requires an env var that is never provided
+    manifests.append(
+        _workload(
+            "api-gateway",
+            ["sh", "-c",
+             'if [ -z "$REQUIRED_API_KEY" ]; then '
+             "echo 'ERROR: Missing required environment variable'; exit 1; "
+             "fi; while true; do sleep 30; done"],
+            env=[{"name": "BACKEND_URL",
+                  "value": f"http://backend.{NAMESPACE}.svc:8080"}],
+        )
+    )
+    # resource-service: fills a memory-backed emptyDir near its limit
+    manifests.append(
+        _workload(
+            "resource-service",
+            ["sh", "-c",
+             "dd if=/dev/zero of=/scratch/fill bs=1M count=90; "
+             "while true; do sleep 30; done"],
+            limits={"cpu": "100m", "memory": "128Mi"},
+            volumes=[{"name": "scratch",
+                      "emptyDir": {"medium": "Memory"}}],
+            volume_mounts=[{"name": "scratch", "mountPath": "/scratch"}],
+        )
+    )
+    for svc in ("frontend", "backend", "database", "api-gateway",
+                "resource-service"):
+        manifests.append(_service(svc))
+
+    # NetworkPolicy admitting backend ingress only from a nonexistent app
+    manifests.append(
+        {
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "NetworkPolicy",
+            "metadata": {"name": "backend-network-policy",
+                         "namespace": NAMESPACE},
+            "spec": {
+                "podSelector": {"matchLabels": {"app": "backend"}},
+                "policyTypes": ["Ingress"],
+                "ingress": [
+                    {"from": [{"podSelector": {
+                        "matchLabels": {"app": "non-existent-service"}
+                    }}]}
+                ],
+            },
+        }
+    )
+    return manifests
+
+
+def expected_findings() -> List[Dict[str, str]]:
+    """What an analyzer must surface on this environment (the regression
+    oracle; reference: setup_test_cluster.py:382-398)."""
+    return [
+        {"component": "database",
+         "expect": "CrashLoopBackOff restart loop, exit code 1"},
+        {"component": "api-gateway",
+         "expect": "container exits on missing REQUIRED_API_KEY env var"},
+        {"component": "backend",
+         "expect": "CPU saturation near its 200m limit (spin loop)"},
+        {"component": "resource-service",
+         "expect": "memory-backed volume filled to ~90Mi of a 128Mi limit"},
+        {"component": "backend-network-policy",
+         "expect": "ingress 'from' selector matches no existing app"},
+    ]
+
+
+def _to_yaml(docs: List[Dict[str, Any]]) -> str:
+    try:
+        import yaml
+
+        return "---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs)
+    except ImportError:
+        return "\n".join(json.dumps(d) for d in docs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print manifests and expected findings; no cluster")
+    ap.add_argument("--delete", action="store_true",
+                    help="delete the kind cluster")
+    args = ap.parse_args(argv)
+
+    if args.delete:
+        return subprocess.call(
+            ["kind", "delete", "cluster", "--name", CLUSTER_NAME]
+        )
+
+    manifests = build_manifests()
+    if args.dry_run:
+        print(_to_yaml([KIND_CONFIG]))
+        print("---")
+        print(_to_yaml(manifests))
+        print("--- expected findings ---", file=sys.stderr)
+        print(json.dumps(expected_findings(), indent=2), file=sys.stderr)
+        return 0
+
+    if shutil.which("kind") is None or shutil.which("kubectl") is None:
+        print("kind/kubectl not found — run with --dry-run to inspect "
+              "manifests", file=sys.stderr)
+        return 1
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        f.write(_to_yaml([KIND_CONFIG]))
+        kind_cfg = f.name
+    existing = subprocess.run(
+        ["kind", "get", "clusters"], capture_output=True, text=True
+    ).stdout.split()
+    if CLUSTER_NAME not in existing:
+        rc = subprocess.call(
+            ["kind", "create", "cluster", "--config", kind_cfg]
+        )
+        if rc:
+            return rc
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        f.write(_to_yaml(manifests))
+        manifest_path = f.name
+    rc = subprocess.call(["kubectl", "apply", "-f", manifest_path])
+    if rc == 0:
+        print(json.dumps(
+            {"cluster": CLUSTER_NAME, "namespace": NAMESPACE,
+             "expected_findings": expected_findings()}, indent=2,
+        ))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
