@@ -151,30 +151,36 @@ class TopologyAgent(Agent):
     # ------------------------------------------------------------------
     @staticmethod
     def _service_replicas(snap, names: List[str]) -> Dict[str, int]:
-        """Ready-replica count of each service's backing workload(s)."""
-        out: Dict[str, int] = {}
+        """Ready-replica count of each service's backing workload(s).
+        One pass over workloads via the inverted selector index."""
+        from rca_tpu.cluster.labels import SelectorIndex
+
+        svc_names = [
+            s.get("metadata", {}).get("name", "") for s in snap.services
+        ]
+        index = SelectorIndex(
+            [(s.get("spec") or {}).get("selector") or {}
+             for s in snap.services]
+        )
+        out: Dict[str, int] = {
+            name: 0
+            for s, name in zip(snap.services, svc_names)
+            if (s.get("spec") or {}).get("selector")
+        }
         workloads = (
             list(snap.deployments) + list(snap.statefulsets) + list(snap.daemonsets)
         )
-        for svc in snap.services:
-            sname = svc.get("metadata", {}).get("name", "")
-            sel = (svc.get("spec") or {}).get("selector") or {}
-            if not sel:
-                continue
-            total = 0
-            for w in workloads:
-                tlabels = (
-                    ((w.get("spec") or {}).get("template") or {})
-                    .get("metadata", {})
-                    .get("labels", {})
-                    or {}
-                )
-                if selector_matches(sel, tlabels):
-                    st = w.get("status", {}) or {}
-                    total += int(
-                        st.get("readyReplicas", st.get("numberReady", 0)) or 0
-                    )
-            out[sname] = total
+        for w in workloads:
+            tlabels = (
+                ((w.get("spec") or {}).get("template") or {})
+                .get("metadata", {})
+                .get("labels", {})
+                or {}
+            )
+            st = w.get("status", {}) or {}
+            ready = int(st.get("readyReplicas", st.get("numberReady", 0)) or 0)
+            for j in index.matches(tlabels):
+                out[svc_names[j]] = out.get(svc_names[j], 0) + ready
         return out
 
     @staticmethod

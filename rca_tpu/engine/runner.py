@@ -12,6 +12,8 @@ import dataclasses
 import time
 from typing import List, Optional, Sequence
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,9 +22,28 @@ from rca_tpu.config import RCAConfig, bucket_for
 from rca_tpu.engine.propagate import (
     PropagationParams,
     default_params,
-    propagate_jit,
-    top_k_scores,
+    propagate,
 )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("steps", "decay", "explain_strength", "impact_bonus", "k"),
+)
+def _propagate_ranked(
+    features, edges, anomaly_w, hard_w,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    k: int,
+):
+    """One dispatch, minimal transfers: edges arrive as one [2, E] buffer;
+    diagnostics leave as one stacked [4, S] buffer plus the top-k pair.
+    Matters on tunneled TPUs where every host<->device hop pays an RTT."""
+    a, h, u, m, score = propagate(
+        features, edges[0], edges[1], anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus,
+    )
+    vals, idx = jax.lax.top_k(score, k)
+    return jnp.stack([a, u, m, score]), vals, idx
 from rca_tpu.features.extract import FeatureSet, extract_features
 from rca_tpu.graph.build import service_dependency_edges
 
@@ -84,35 +105,35 @@ class GraphEngine:
         n = features.shape[0]
         k = k or min(self.config.top_k_root_causes, n)
         f, s, d = self._pad(features, dep_src, dep_dst)
-        fj, sj, dj = jnp.asarray(f), jnp.asarray(s), jnp.asarray(d)
+        fj = jnp.asarray(f)
+        ej = jnp.asarray(np.stack([s, d]))  # one [2, E] upload
         p = self.params
+        kk = min(k + 8, f.shape[0])
 
         def run():
-            a, h, u, m, score = propagate_jit(
-                fj, sj, dj, self._aw, self._hw,
-                p.steps, p.decay, p.explain_strength, p.impact_bonus,
+            return _propagate_ranked(
+                fj, ej, self._aw, self._hw,
+                p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
             )
-            vals, idx = top_k_scores(score, min(k + 8, f.shape[0]))
-            return a, u, m, score, vals, idx
 
         if timed:
-            run()[3].block_until_ready()  # warm the compile cache
+            run()[2].block_until_ready()  # warm the compile cache
             reps = []
             for _ in range(10):
                 t0 = time.perf_counter()
-                a, u, m, score, vals, idx = run()
+                stacked, vals, idx = run()
                 idx.block_until_ready()
                 reps.append((time.perf_counter() - t0) * 1e3)
             latency_ms = float(np.median(reps))
         else:
             t0 = time.perf_counter()
-            a, u, m, score, vals, idx = run()
+            stacked, vals, idx = run()
             idx.block_until_ready()
             latency_ms = (time.perf_counter() - t0) * 1e3
 
-        a, u, m, score = (np.asarray(x)[:n] for x in (a, u, m, score))
-        idx = np.asarray(idx)
-        vals = np.asarray(vals)
+        # one bulk fetch for the 3 result buffers
+        stacked, vals, idx = jax.device_get((stacked, vals, idx))
+        a, u, m, score = (stacked[i][:n] for i in range(4))
         names = list(names) if names is not None else [f"svc-{i}" for i in range(n)]
         ranked = []
         for j, i in enumerate(idx.tolist()):

@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from rca_tpu.cluster.labels import selector_matches
+from rca_tpu.cluster.labels import SelectorIndex, selector_matches
 from rca_tpu.cluster.snapshot import ClusterSnapshot
 from rca_tpu.features.logscan import LOG_PATTERN_NAMES, scan_pod_logs
 from rca_tpu.features.schema import (
@@ -176,29 +176,16 @@ def extract_features(snapshot: ClusterSnapshot) -> FeatureSet:
     ]
     pod_labels = [p.get("metadata", {}).get("labels", {}) or {} for p in pods]
     pod_service = np.full(P, -1, dtype=np.int32)
-    # index selectors by their (k,v) items for O(P·avg_labels) matching of the
-    # overwhelmingly-common single-label selector; fall back to subset check.
-    # Every matching service is recorded (one pod may back several services,
-    # e.g. ClusterIP + headless with the same selector); pod_service keeps the
-    # first match as the primary owner.
-    single_label: Dict[tuple, List[int]] = {}
-    multi: List[int] = []
-    for j, sel in enumerate(selectors):
-        if len(sel) == 1:
-            single_label.setdefault(next(iter(sel.items())), []).append(j)
-        elif sel:
-            multi.append(j)
+    # inverted selector index: O(labels) per pod.  Every matching service is
+    # recorded (one pod may back several services, e.g. ClusterIP + headless
+    # sharing a selector); pod_service keeps the first match as primary owner.
+    index = SelectorIndex(selectors)
     memb_pod: List[int] = []
     memb_svc: List[int] = []
     for i, labels in enumerate(pod_labels):
-        hits: List[int] = []
-        for item in labels.items():
-            hits.extend(single_label.get(item, ()))
-        for j in multi:
-            if selector_matches(selectors[j], labels):
-                hits.append(j)
+        hits = index.matches(labels)
         if hits:
-            pod_service[i] = min(hits)
+            pod_service[i] = hits[0]
             memb_pod.extend([i] * len(hits))
             memb_svc.extend(hits)
 

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from rca_tpu.cluster.labels import selector_matches
+from rca_tpu.cluster.labels import SelectorIndex
 from rca_tpu.cluster.snapshot import ClusterSnapshot
 from rca_tpu.features.extract import FeatureSet
 
@@ -172,6 +172,12 @@ def build_typed_graph(snapshot: ClusterSnapshot) -> TypedGraph:
     for name in sorted(sec_names):
         b.node(NodeType.SECRET, name)
 
+    # inverted selector index: O(labels) per workload instead of O(services)
+    svc_selector_index = SelectorIndex(
+        [(s.get("spec") or {}).get("selector") or {}
+         for s in snapshot.services]
+    )
+
     workloads = _workloads(snapshot)
     for wname, w in workloads:
         widx = b.node(NodeType.WORKLOAD, wname)
@@ -181,14 +187,12 @@ def build_typed_graph(snapshot: ClusterSnapshot) -> TypedGraph:
         tspec = template.get("spec") or {}
 
         # SELECTS: service selector ⊆ template labels
-        for svc in snapshot.services:
-            sel = (svc.get("spec") or {}).get("selector") or {}
-            if sel and selector_matches(sel, tlabels):
-                b.edge(
-                    b.node(NodeType.SERVICE, svc["metadata"]["name"]),
-                    widx,
-                    EdgeType.SELECTS,
-                )
+        for j in svc_selector_index.matches(tlabels):
+            b.edge(
+                b.node(NodeType.SERVICE, service_names[j]),
+                widx,
+                EdgeType.SELECTS,
+            )
 
         # MOUNTS: volumes referencing configmaps/secrets
         for vol in tspec.get("volumes", []) or []:
