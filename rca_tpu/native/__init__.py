@@ -1,0 +1,181 @@
+"""Native (C++) log scanner: build-on-first-use via g++ + ctypes.
+
+The reference did all log scanning with Python ``re`` loops (reference:
+agents/logs_agent.py:146-149); here the 13-class scan is a C++ single-pass
+matcher ~10x faster, compiled lazily from :mod:`rca_tpu.native.logscan`
+source with the Python regex path as the always-available fallback
+(``RCA_NATIVE_SCAN=0`` disables; parity enforced by
+tests/test_native.py::test_native_matches_python_regex).
+
+The alternative table below mirrors rca_tpu.features.logscan.LOG_PATTERNS
+exactly — alternation order included, because findall counts depend on which
+branch consumes first.  Tokens: \\x01 digit, \\x02 word+, \\x03 ws*,
+\\x04 ws, \\x06 greedy-any-then-literal-tail.  Flags: 1 = word boundary,
+2 = case sensitive.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+D = "\x01"   # one digit
+W = "\x02"   # one or more word chars
+WS0 = "\x03"  # zero or more whitespace
+WS1 = "\x04"  # exactly one whitespace
+ANY = "\x06"  # greedy within-line any, followed by literal tail
+
+# (flags, pattern) per alternative; order matches the regex alternation in
+# rca_tpu.features.logscan.LOG_PATTERNS.
+SPEC_TABLE: List[Tuple[str, List[Tuple[int, str]]]] = [
+    ("oom_kill", [
+        (0, "out of memory"), (0, "oomkilled"),
+        (0, "signal:" + WS0 + "killed"),
+        (0, "oom-kill"), (0, "oom_kill"), (0, "oomkill"),
+    ]),
+    ("connection_refused", [(0, "connection refused"), (0, "econnrefused")]),
+    ("permission_denied", [
+        (0, "permission denied"), (0, "access denied"), (1, "forbidden"),
+    ]),
+    ("timeout", [
+        # timed?\s?-?out expanded, greedy order (d, ws, dash present first)
+        (0, "timed" + WS1 + "-out"), (0, "timed" + WS1 + "out"),
+        (0, "timed-out"), (0, "timedout"),
+        (0, "time" + WS1 + "-out"), (0, "time" + WS1 + "out"),
+        (0, "time-out"), (0, "timeout"),
+        (0, "etimedout"), (0, "deadline exceeded"),
+    ]),
+    ("crash_loop", [
+        (0, "crashloopbackoff"),
+        (0, "back-off restarting"), (0, "backoff restarting"),
+    ]),
+    ("api_error", [
+        (2, "api server error"), (2, "StatusCode=5" + D + D),
+    ]),
+    ("volume_mount", [
+        (0, "unable to attach or mount volumes"),
+        (0, "unable to mount volumes"),
+        (0, "mountvolume." + W + " failed"),
+    ]),
+    ("image_pull", [
+        (0, "errimagepull"), (0, "imagepullbackoff"),
+        (0, "failed to pull image"),
+    ]),
+    ("dns_resolution", [
+        (0, "could not resolve"), (0, "dns resolution failed"),
+        (0, "no such host"),
+    ]),
+    ("authentication", [(0, "unauthorized"), (0, "authentication fail")]),
+    ("config_error", [
+        (0, "invalid configuration"),
+        (0, "configmap " + ANY + "not found"),
+        (0, "secret " + ANY + "not found"),
+    ]),
+    ("internal_server_error", [
+        (0, "internal server error"), (0, "internal servererror"),
+        (0, "internalserver error"), (0, "internalservererror"),
+        (0, "500 internal"),
+    ]),
+    ("exception", [
+        (1, "exception"), (1, "error"), (0, "traceback"),
+        (1, "fatal"), (1, "critical"), (0, "panic:"), (0, "panic"),
+    ]),
+]
+
+SPEC_CLASS_NAMES = [name for name, _ in SPEC_TABLE]
+
+
+def serialize_spec() -> bytes:
+    classes = []
+    for _, alts in SPEC_TABLE:
+        classes.append(
+            "\x1f".join(chr(ord("0") + flags) + pat for flags, pat in alts)
+        )
+    return "\x1e".join(classes).encode("latin-1")
+
+
+_SOURCE = Path(__file__).with_name("logscan.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build_library() -> Optional[Path]:
+    """Compile logscan.cpp into a cached .so; None when no toolchain."""
+    try:
+        src = _SOURCE.read_bytes()
+    except OSError:
+        return None
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    cache_dir = Path(
+        os.environ.get("RCA_NATIVE_CACHE",
+                       os.path.join(tempfile.gettempdir(), "rca_tpu_native"))
+    )
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    out = cache_dir / f"liblogscan-{tag}.so"
+    if out.exists():
+        return out
+    tmp = out.with_suffix(f".{os.getpid()}.tmp.so")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           str(_SOURCE), "-o", str(tmp)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    os.replace(tmp, out)
+    return out
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """The compiled scanner, or None (disabled / no compiler / failed)."""
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("RCA_NATIVE_SCAN", "auto") == "0":
+        return None
+    path = _build_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+        lib.rca_load_spec.argtypes = [ctypes.c_char_p, ctypes.c_long]
+        lib.rca_load_spec.restype = ctypes.c_int
+        lib.rca_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.rca_scan.restype = ctypes.c_int
+        spec = serialize_spec()
+        n = lib.rca_load_spec(spec, len(spec))
+        if n != len(SPEC_TABLE):
+            return None
+        _lib = lib
+    except OSError:
+        return None
+    return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def scan_text_native(text: str) -> Optional[np.ndarray]:
+    """Counts per class via the C++ scanner; None when unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    data = text.encode("utf-8", errors="replace")
+    counts = (ctypes.c_int32 * len(SPEC_TABLE))()
+    rc = lib.rca_scan(data, len(data), counts)
+    if rc != 0:
+        return None
+    return np.asarray(list(counts), dtype=np.int32)

@@ -1,0 +1,110 @@
+"""Native C++ scanner: build, parity with the Python regex oracle, speed."""
+
+import time
+
+import numpy as np
+import pytest
+
+from rca_tpu.features.logscan import (
+    LOG_PATTERN_NAMES,
+    scan_text,
+    scan_text_python,
+)
+from rca_tpu.native import (
+    SPEC_CLASS_NAMES,
+    native_available,
+    scan_text_native,
+)
+
+SAMPLES = [
+    "",
+    "INFO: all good\n" * 50,
+    "ERROR: Database initialization failed\nFATAL: could not open file\n",
+    "container oomkilled by kernel: out of memory\nsignal: killed\n",
+    "oom-kill event; oom_killer invoked; OOMKilled\n",
+    "connection refused to db:5432 (ECONNREFUSED)\n",
+    "request timed out; deadline exceeded; ETIMEDOUT; timeout after 5s\n",
+    "time out while waiting; timed-out again; time-out\n",
+    "Back-off restarting failed container\nCrashLoopBackOff seen\n",
+    "backoff restarting container now\n",
+    "api server error; StatusCode=503 returned; StatusCode=5xx\n",
+    "API SERVER ERROR uppercase should not match api_error\n",
+    "Unable to attach or mount volumes: timed out\n",
+    "MountVolume.SetUp failed for volume xyz\n",
+    "ErrImagePull: failed to pull image 'x:1'\nImagePullBackOff\n",
+    "could not resolve host; no such host; DNS resolution failed\n",
+    "401 Unauthorized; authentication failure for user\n",
+    "invalid configuration detected\nconfigmap \"app-cfg\" not found\n",
+    "secret my-secret key not found in namespace\n",
+    "HTTP 500 Internal Server Error\ninternal server error again\n",
+    "Exception in thread main\nTraceback (most recent call last)\n",
+    "errors everywhere but the word error stands alone: error!\n",
+    "forbidden access; this_is_forbidden_token should not wordmatch\n",
+    "panic: runtime error\npanicking is fine\n",
+    "CRITICAL failure; criticality is not critical-word? critical.\n",
+    "fatal: FATAL mistake; fatally wrong\n",
+    # mixed real-world-ish blob
+    (
+        "2026-01-01T00:00:00Z ERROR failed to pull image registry/app:9\n"
+        "2026-01-01T00:00:01Z warn connection refused: backend:8080\n"
+        "2026-01-01T00:00:02Z info retrying in 5s\n"
+        "2026-01-01T00:00:03Z ERROR Exception: deadline exceeded\n"
+    ) * 20,
+]
+
+
+def test_spec_covers_all_pattern_classes():
+    assert SPEC_CLASS_NAMES == LOG_PATTERN_NAMES
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+@pytest.mark.parametrize("idx", range(len(SAMPLES)))
+def test_native_matches_python_regex(idx):
+    text = SAMPLES[idx]
+    got = scan_text_native(text)
+    want = scan_text_python(text)
+    assert got is not None
+    mismatches = {
+        LOG_PATTERN_NAMES[i]: (int(got[i]), int(want[i]))
+        for i in range(len(want))
+        if got[i] != want[i]
+    }
+    assert not mismatches, f"native != python on sample {idx}: {mismatches}"
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+def test_native_matches_on_fixture_logs():
+    from rca_tpu.cluster.fixtures import five_service_world
+
+    world = five_service_world()
+    for ns_logs in world.logs.values():
+        for per_container in ns_logs.values():
+            for text in (
+                per_container.values()
+                if isinstance(per_container, dict) else [per_container]
+            ):
+                got = scan_text_native(text)
+                want = scan_text_python(text)
+                assert (got == want).all()
+
+
+def test_scan_text_dispatches_and_agrees():
+    text = SAMPLES[-1]
+    assert (scan_text(text) == scan_text_python(text)).all()
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+def test_native_is_faster_on_bulk_logs():
+    text = SAMPLES[-1] * 50  # ~80 log lines * 50
+    # warm both
+    scan_text_native(text), scan_text_python(text)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        scan_text_native(text)
+    native_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(5):
+        scan_text_python(text)
+    python_s = time.perf_counter() - t0
+    # conservative bound to avoid flakiness; typical speedup is ~5-15x
+    assert native_s < python_s, (native_s, python_s)
