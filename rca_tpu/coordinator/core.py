@@ -135,17 +135,30 @@ class RCACoordinator:
     def _run_comprehensive(self, ctx: AnalysisContext) -> Dict[str, Any]:
         """All six signals over ONE shared snapshot, then fusion + summary
         (reference ran them serially re-fetching state each time,
-        mcp_coordinator.py:624-665)."""
+        mcp_coordinator.py:624-665).  Per-stage latency recorded under
+        ``results["profile"]``."""
+        from rca_tpu.obslog.profiling import StageTimer, maybe_jax_profile
+
+        timer = StageTimer()
         results: Dict[str, Any] = {}
+        with timer.stage("features"):
+            ctx.features  # materialize the shared packed arrays once
+        with timer.stage("graph"):
+            ctx.graph
+            ctx.dep_edges
         for agent_type in ALL_AGENT_TYPES:
-            res = self._agent_for(agent_type).analyze(ctx)
+            with timer.stage(f"agent.{agent_type}"):
+                res = self._agent_for(agent_type).analyze(ctx)
             results[agent_type] = res.to_dict()
-        correlated = correlate_findings(
-            results, ctx=ctx, backend=self.backend, llm_client=self.llm,
-            engine=self.engine,
-        )
+        with timer.stage("correlate"), maybe_jax_profile("correlate"):
+            correlated = correlate_findings(
+                results, ctx=ctx, backend=self.backend, llm_client=self.llm,
+                engine=self.engine,
+            )
         results["correlated"] = correlated
-        results["summary"] = self.generate_summary(results, ctx)
+        with timer.stage("summary"):
+            results["summary"] = self.generate_summary(results, ctx)
+        results["profile"] = timer.report()
         return results
 
     # -- summaries -----------------------------------------------------------

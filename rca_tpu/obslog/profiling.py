@@ -1,0 +1,53 @@
+"""Per-stage latency timing + optional jax.profiler device traces.
+
+The reference had no runtime profiler (SURVEY.md §5 tracing row — its only
+"tracing" was application-level prompt/evidence logs).  Here every
+comprehensive analysis carries a stage-latency breakdown (the north-star
+metric is end-to-end graph-inference latency, BASELINE.md), and
+``RCA_JAX_PROFILE=<dir>`` wraps the engine stage in a ``jax.profiler``
+trace for TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Dict, List, Optional
+
+
+class StageTimer:
+    """Collects (stage, seconds) pairs; nestable via context manager."""
+
+    def __init__(self) -> None:
+        self.stages: List[Dict[str, float]] = []
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.stages.append(
+                {"stage": name, "ms": (time.perf_counter() - t0) * 1e3}
+            )
+
+    def report(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for s in self.stages:
+            out[s["stage"]] = out.get(s["stage"], 0.0) + round(s["ms"], 3)
+        out["total_ms"] = round(sum(s["ms"] for s in self.stages), 3)
+        return out
+
+
+@contextlib.contextmanager
+def maybe_jax_profile(tag: str):
+    """Device trace when RCA_JAX_PROFILE=<dir> is set; no-op otherwise."""
+    trace_dir: Optional[str] = os.environ.get("RCA_JAX_PROFILE")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(os.path.join(trace_dir, tag)):
+        yield

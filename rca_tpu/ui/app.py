@@ -92,8 +92,8 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
     investigation = store.get_investigation(inv_id) or {}
 
     st.title("Kubernetes Root Cause Analysis")
-    tab_chat, tab_report, tab_topology = st.tabs(
-        ["Chat", "Report", "Topology"]
+    tab_chat, tab_report, tab_topology, tab_wizard = st.tabs(
+        ["Chat", "Report", "Topology", "Investigate"]
     )
 
     # ---- chat tab (reference: chatbot_interface.py) ----------------------
@@ -195,6 +195,102 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                 st.plotly_chart(fig, use_container_width=True)
             except ImportError:
                 st.json(data)
+
+    # ---- guided 4-stage wizard (reference: interactive_session.py) -------
+    with tab_wizard:
+        from rca_tpu.ui.render import wizard_stage_markdown
+
+        wiz = st.session_state.setdefault("wizard", {"stage": 0})
+        st.markdown(wizard_stage_markdown(wiz))
+
+        if wiz["stage"] == 0:
+            results = st.session_state.get("last_results")
+            if not results:
+                st.info("Run a comprehensive analysis in the Report tab "
+                        "first, then pick a finding to investigate.")
+            else:
+                findings = [
+                    f
+                    for res in results.values()
+                    if isinstance(res, dict)
+                    for f in res.get("findings", [])
+                ]
+                findings.sort(
+                    key=lambda f: ["info", "low", "medium", "high",
+                                   "critical"].index(
+                        str(f.get("severity", "info")).lower()
+                    ),
+                    reverse=True,
+                )
+                for i, f in enumerate(findings[:12]):
+                    if st.button(
+                        f"{f['component']}: {f['issue'][:60]}",
+                        key=f"wiz-f{i}",
+                    ):
+                        wiz.update(
+                            {"stage": 1, "finding": f,
+                             "component": f["component"]}
+                        )
+                        st.rerun()
+
+        elif wiz["stage"] == 1:
+            if "hypotheses" not in wiz:
+                with st.spinner("Generating hypotheses…"):
+                    wiz["hypotheses"] = coord.generate_hypotheses(
+                        wiz["component"], wiz["finding"], namespace,
+                        investigation_id=inv_id,
+                    )
+            for i, h in enumerate(wiz["hypotheses"]):
+                if st.button(
+                    f"{h['description'][:70]} ({h['confidence']:.0%})",
+                    key=f"wiz-h{i}",
+                ):
+                    wiz.update(
+                        {"stage": 2, "hypothesis": h, "executed": [],
+                         "plan": coord.get_investigation_plan(h, namespace)}
+                    )
+                    st.rerun()
+
+        elif wiz["stage"] == 2:
+            plan = wiz["plan"]
+            done = len(wiz["executed"])
+            for i, step in enumerate(plan["steps"]):
+                mark = "✅" if i < done else "⚪"
+                st.markdown(f"{mark} {step['description']}")
+            if done < len(plan["steps"]):
+                if st.button("Execute next step"):
+                    with st.spinner("Gathering evidence…"):
+                        out = coord.execute_investigation_step(
+                            plan["steps"][done], wiz["hypothesis"],
+                            namespace, investigation_id=inv_id,
+                        )
+                    wiz["executed"].append(out)
+                    st.rerun()
+                if wiz["executed"]:
+                    last = wiz["executed"][-1]["verdict"]
+                    st.markdown(
+                        f"Latest verdict: **{last['verdict']}** "
+                        f"({last['confidence']:.0%}) — {last['reasoning']}"
+                    )
+            else:
+                if st.button("Accept conclusion"):
+                    wiz["stage"] = 3
+                    st.rerun()
+
+        elif wiz["stage"] == 3:
+            report = coord.generate_root_cause_report(
+                {
+                    "component": wiz["component"],
+                    "accepted_hypothesis": wiz["hypothesis"],
+                    "steps": wiz["executed"],
+                    "finding": wiz["finding"],
+                }
+            )
+            st.markdown(report)
+            store.add_evidence(inv_id, "root_cause_report", report)
+            if st.button("Start a new investigation"):
+                st.session_state["wizard"] = {"stage": 0}
+                st.rerun()
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -118,6 +118,66 @@ def topology_plot_data(graph_dict: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def analysis_viz_data(agent_type: str, result: Dict[str, Any]) -> Dict[str, Any]:
+    """Chart-ready payload per analysis type (reference:
+    components/visualization.py renderers per type) — severity histogram for
+    every agent plus type-specific series."""
+    findings = result.get("findings", [])
+    sev_counts: Dict[str, int] = {}
+    for f in findings:
+        sev = str(f.get("severity", "info")).lower()
+        sev_counts[sev] = sev_counts.get(sev, 0) + 1
+    out: Dict[str, Any] = {
+        "agent_type": agent_type,
+        "severity_histogram": sev_counts,
+        "components": sorted({str(f.get("component", "")) for f in findings}),
+    }
+    if agent_type == "metrics":
+        out["utilization"] = [
+            {"component": f["component"], **f["evidence"]}
+            for f in findings
+            if isinstance(f.get("evidence"), dict)
+            and "usage_percentage" in f["evidence"]
+        ]
+    elif agent_type == "resources":
+        out["pod_buckets"] = result.get("data", {}).get("pod_buckets", {})
+    elif agent_type == "logs":
+        patterns: Dict[str, int] = {}
+        for f in findings:
+            ev = f.get("evidence")
+            if isinstance(ev, dict) and ev.get("pattern"):
+                patterns[ev["pattern"]] = (
+                    patterns.get(ev["pattern"], 0) + int(ev.get("count", 1))
+                )
+        out["pattern_counts"] = patterns
+    elif agent_type == "topology":
+        out["graph"] = result.get("data", {}).get("graph", {})
+        out["service_pod_mapping"] = result.get("data", {}).get(
+            "service_pod_mapping", {}
+        )
+    elif agent_type == "traces":
+        out["error_rates"] = [
+            {"component": f["component"],
+             "error_rate": f["evidence"]["error_rate"]}
+            for f in findings
+            if isinstance(f.get("evidence"), dict)
+            and "error_rate" in f["evidence"]
+        ]
+    return out
+
+
+def wizard_stage_markdown(session: Dict[str, Any]) -> str:
+    """Progress header for the 4-stage guided wizard (reference:
+    components/interactive_session.py:107-114 stages)."""
+    stages = ["Select finding", "Hypotheses", "Investigate", "Conclusion"]
+    current = int(session.get("stage", 0))
+    parts = []
+    for i, s in enumerate(stages):
+        mark = "✅" if i < current else ("▶️" if i == current else "⚪")
+        parts.append(f"{mark} {s}")
+    return "  →  ".join(parts)
+
+
 def report_markdown(results: Dict[str, Any]) -> str:
     """Full comprehensive-analysis report (reference: components/report.py)."""
     correlated = results.get("correlated", {})

@@ -1,0 +1,106 @@
+"""Streaming session ticks, stage profiling, per-analysis viz payloads."""
+
+import numpy as np
+
+from rca_tpu.cluster.generator import synthetic_cascade_arrays
+from rca_tpu.engine.streaming import StreamingSession
+from rca_tpu.obslog.profiling import StageTimer
+from rca_tpu.ui.render import analysis_viz_data, wizard_stage_markdown
+
+
+def test_streaming_session_tracks_fault_changes():
+    case = synthetic_cascade_arrays(300, n_roots=1, seed=7)
+    names = case.names
+    sess = StreamingSession(
+        names, case.dep_src, case.dep_dst,
+        num_features=case.features.shape[1], k=3,
+    )
+    sess.set_all(case.features)
+    out1 = sess.tick()
+    assert out1["tick"] == 1
+    assert out1["latency_ms"] > 0
+    root = case.names[case.roots[0]]
+    assert out1["ranked"][0]["component"] == root
+
+    # inject a second concurrent hard failure -> both roots rank top-2
+    new_root = (case.roots[0] + 137) % case.n
+    second = case.features.copy()
+    second[new_root, 0] = 1.0   # CRASH channel
+    second[new_root, 3] = 0.9   # RESTARTS
+    sess.set_all(second)
+    out2 = sess.tick()
+    assert out2["tick"] == 2
+    top2 = {r["component"] for r in out2["ranked"][:2]}
+    assert top2 == {root, case.names[new_root]}
+
+    # delta update path: clearing just the new fault restores the ranking
+    sess.update(int(new_root), case.features[new_root])
+    out3 = sess.tick()
+    assert out3["ranked"][0]["component"] == root
+    assert case.names[new_root] not in {
+        r["component"] for r in out3["ranked"][:2]
+    }
+
+
+def test_stage_timer_report():
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    with t.stage("b"):
+        with t.stage("a"):
+            pass
+    rep = t.report()
+    assert set(rep) == {"a", "b", "total_ms"}
+    assert rep["total_ms"] >= rep["a"]
+
+
+def test_comprehensive_carries_profile():
+    from rca_tpu.agents import AnalysisContext
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+    from rca_tpu.coordinator import RCACoordinator
+
+    coord = RCACoordinator(MockClusterClient(five_service_world()))
+    ctx = AnalysisContext(ClusterSnapshot.capture(
+        MockClusterClient(five_service_world()), NS
+    ))
+    rec = coord.run_analysis("comprehensive", NS, ctx=ctx)
+    profile = rec["results"]["profile"]
+    assert "correlate" in profile
+    assert "agent.topology" in profile
+    assert profile["total_ms"] > 0
+
+
+def test_analysis_viz_payloads():
+    logs_result = {
+        "findings": [
+            {"component": "Pod/x", "severity": "high",
+             "evidence": {"pattern": "oom_kill", "count": 3}},
+            {"component": "Pod/y", "severity": "high",
+             "evidence": {"pattern": "oom_kill", "count": 2}},
+        ],
+    }
+    viz = analysis_viz_data("logs", logs_result)
+    assert viz["severity_histogram"] == {"high": 2}
+    assert viz["pattern_counts"] == {"oom_kill": 5}
+
+    res_result = {"findings": [], "data": {"pod_buckets": {"crashloop": 1}}}
+    assert analysis_viz_data("resources", res_result)["pod_buckets"] == {
+        "crashloop": 1
+    }
+
+    traces_result = {
+        "findings": [
+            {"component": "Service/a", "severity": "high",
+             "evidence": {"error_rate": 0.25}},
+        ],
+    }
+    viz = analysis_viz_data("traces", traces_result)
+    assert viz["error_rates"][0]["error_rate"] == 0.25
+
+
+def test_wizard_stage_markdown():
+    md = wizard_stage_markdown({"stage": 2})
+    assert "▶️ Investigate" in md
+    assert md.count("✅") == 2
