@@ -17,12 +17,24 @@ import json
 import re
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from rca_tpu.llm.providers import Provider, ProviderReply, make_provider
+from rca_tpu.llm.providers import (
+    LLMQuotaExceeded,
+    LLMUnavailable,
+    OfflineProvider,
+    Provider,
+    ProviderReply,
+    make_provider,
+)
 from rca_tpu.llm.tools import ToolSpec
 
 MAX_TOOL_ROUNDS = 6
 
 LogFn = Callable[[Dict[str, Any]], None]
+
+# quota-failover chain (reference: app.py:50-67 fell over from OpenAI to
+# Anthropic on quota errors; here any provider can fail over, ending at the
+# deterministic offline provider so analysis never dies on a 429)
+_FAILOVER_ORDER = ("anthropic", "openai", "offline")
 
 
 class LLMClient:
@@ -34,6 +46,31 @@ class LLMClient:
     ):
         self.provider = provider or make_provider(provider_name)
         self.log_fn = log_fn
+
+    def _complete(self, messages, **kwargs) -> ProviderReply:
+        """One completion with runtime quota failover."""
+        try:
+            return self.provider.complete(messages, **kwargs)
+        except LLMQuotaExceeded:
+            failed = self.provider.name
+            for name in _FAILOVER_ORDER:
+                if name == failed:
+                    continue
+                try:
+                    candidate = (
+                        OfflineProvider() if name == "offline"
+                        else make_provider(name)
+                    )
+                    reply = candidate.complete(messages, **kwargs)
+                except LLMUnavailable:
+                    continue
+                self.provider = candidate  # stick with the working provider
+                self._log(
+                    "", "", kind="provider_failover",
+                    failed_provider=failed, new_provider=candidate.name,
+                )
+                return reply
+            raise
 
     # -- logging -----------------------------------------------------------
     def _log(self, prompt: str, response: str, **context: Any) -> None:
@@ -77,7 +114,7 @@ class LLMClient:
         messages.append({"role": "user", "content": context})
         steps: List[dict] = []
 
-        reply: ProviderReply = self.provider.complete(messages, schemas or None)
+        reply: ProviderReply = self._complete(messages, tools=schemas or None)
         rounds = 0
         while reply.tool_calls and rounds < max_rounds:
             rounds += 1
@@ -112,7 +149,7 @@ class LLMClient:
                 messages.append(
                     {"role": "tool", "tool_call_id": tc.id, "content": result}
                 )
-            reply = self.provider.complete(messages, schemas or None)
+            reply = self._complete(messages, tools=schemas or None)
 
         self._log(context, reply.text, kind="analyze", tool_rounds=rounds)
         return {"final_analysis": reply.text, "reasoning_steps": steps}
@@ -128,7 +165,7 @@ class LLMClient:
         if system_prompt:
             messages.append({"role": "system", "content": system_prompt})
         messages.append({"role": "user", "content": prompt})
-        reply = self.provider.complete(messages, json_mode=True)
+        reply = self._complete(messages, json_mode=True)
         self._log(prompt, reply.text, **{"kind": "structured", **log_context})
         return parse_json_response(reply.text)
 
@@ -145,7 +182,7 @@ class LLMClient:
         if system_prompt:
             messages.append({"role": "system", "content": system_prompt})
         messages.append({"role": "user", "content": prompt})
-        reply = self.provider.complete(
+        reply = self._complete(
             messages, temperature=temperature, max_tokens=max_tokens
         )
         self._log(prompt, reply.text, **{"kind": "completion", **log_context})

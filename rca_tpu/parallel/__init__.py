@@ -8,7 +8,7 @@ helpers shared by the engine, the trainer, and the driver's multi-chip dry
 run.
 """
 
-from rca_tpu.parallel.mesh import make_mesh
+from rca_tpu.parallel.mesh import make_mesh, make_multislice_mesh
 from rca_tpu.parallel.sharded import ShardedGraph, shard_graph, sharded_propagate
 
-__all__ = ["make_mesh", "ShardedGraph", "shard_graph", "sharded_propagate"]
+__all__ = ["make_mesh", "make_multislice_mesh", "ShardedGraph", "shard_graph", "sharded_propagate"]

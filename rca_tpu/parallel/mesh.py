@@ -30,3 +30,49 @@ def make_mesh(
         )
     grid = np.asarray(devices[:total]).reshape(sizes)
     return Mesh(grid, names)
+
+
+def make_multislice_mesh(
+    n_slices: int,
+    per_slice_axes: Sequence[Tuple[str, int]],
+    devices: Optional[Sequence] = None,
+    slice_axis: str = "slice",
+) -> Mesh:
+    """Multi-slice mesh: an outer DCN axis over intra-slice ICI axes.
+
+    For the 50k multi-cluster config (BASELINE.md) the service graph shards
+    node-wise over the intra-slice 'sp' axis (collectives ride ICI) while
+    independent hypothesis batches / cluster partitions spread across
+    ``slice_axis`` (collectives ride DCN — keep cross-slice communication to
+    the final top-k merge, never per propagation step).
+
+    On real multi-slice hardware, group devices by ``device.slice_index``
+    when available; on single-slice or CPU-virtual device sets, fall back to
+    contiguous partitioning (the layout the driver's virtual-device dry run
+    exercises).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    per_slice = int(np.prod([s for _, s in per_slice_axes]))
+    need = n_slices * per_slice
+    if need > len(devices):
+        raise ValueError(
+            f"multislice mesh needs {need} devices "
+            f"({n_slices} slices x {per_slice}), have {len(devices)}"
+        )
+    devices = devices[:need]
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) >= n_slices:
+        by_slice: dict = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        groups = [
+            group[:per_slice]
+            for _, group in sorted(by_slice.items())[:n_slices]
+            if len(group) >= per_slice
+        ]
+        if len(groups) == n_slices:
+            devices = [d for group in groups for d in group]
+    sizes = (n_slices, *(s for _, s in per_slice_axes))
+    names = (slice_axis, *(a for a, _ in per_slice_axes))
+    grid = np.asarray(devices).reshape(sizes)
+    return Mesh(grid, names)

@@ -109,11 +109,17 @@ def _propagate_block(
 
 @functools.lru_cache(maxsize=32)
 def _jitted_shard_fn(
-    mesh: Mesh, steps: int, decay: float, mu: float, beta: float
+    mesh: Mesh, steps: int, decay: float, mu: float, beta: float,
+    batch_axes: tuple = ("dp",),
 ):
     """One traced+compiled shard_map per (mesh, scalar-params); weight
     vectors are runtime args so repeated calls hit jit's shape cache
-    instead of re-tracing (jit is keyed on function identity)."""
+    instead of re-tracing (jit is keyed on function identity).
+
+    ``batch_axes`` names the mesh axes the hypothesis batch shards over —
+    ``("dp",)`` single-slice, ``("slice", "dp")`` multi-slice (hypotheses
+    spread over DCN, node shards over ICI; no cross-slice collective is
+    ever issued inside the propagation)."""
 
     def per_device(f_loc, src_l, src_g, dst_g, mask, aw, hw):
         # f_loc: [B/dp, block, C]; edge arrays arrive [1, e_pad] — drop the
@@ -128,15 +134,16 @@ def _jitted_shard_fn(
             lambda f: kernel(f, src_l, src_g, dst_g, mask, aw=aw, hw=hw)
         )(f_loc)
 
+    batch_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
     shard_fn = jax.shard_map(
         per_device,
         mesh=mesh,
         in_specs=(
-            P("dp", "sp", None),
+            P(batch_spec, "sp", None),
             P("sp", None), P("sp", None), P("sp", None), P("sp", None),
             P(), P(),
         ),
-        out_specs=P("dp", "sp"),
+        out_specs=P(batch_spec, "sp"),
         check_vma=False,
     )
     return jax.jit(shard_fn)
@@ -147,16 +154,23 @@ def sharded_propagate(
     features_batch: np.ndarray,  # [B, n_pad, C] hypothesis batch, same graph
     graph: ShardedGraph,
     params: PropagationParams,
+    batch_axes: Tuple[str, ...] = ("dp",),
 ) -> jax.Array:
-    """Scores [B, n_pad]: batch sharded over 'dp', nodes sharded over 'sp'."""
+    """Scores [B, n_pad]: batch sharded over ``batch_axes``, nodes over 'sp'.
+
+    Pass ``batch_axes=("slice", "dp")`` with a
+    :func:`rca_tpu.parallel.mesh.make_multislice_mesh` mesh for the
+    multi-slice configs — hypothesis parallelism rides DCN, node-shard
+    collectives stay on ICI."""
     aw, hw = params.weight_arrays()
     fn = _jitted_shard_fn(
         mesh, params.steps, params.decay,
-        params.explain_strength, params.impact_bonus,
+        params.explain_strength, params.impact_bonus, tuple(batch_axes),
     )
+    batch_spec = tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
     fb = jax.device_put(
         jnp.asarray(features_batch),
-        NamedSharding(mesh, P("dp", "sp", None)),
+        NamedSharding(mesh, P(batch_spec, "sp", None)),
     )
     edge_sharding = NamedSharding(mesh, P("sp", None))
     args = tuple(

@@ -138,3 +138,28 @@ def test_quota_error_classification():
     assert not isinstance(
         _classify_error(Exception("boom")), LLMQuotaExceeded
     )
+
+
+def test_runtime_quota_failover_lands_on_offline(monkeypatch):
+    """A provider that 429s mid-session fails over (reference: app.py:50-67)
+    and, with no API keys available, lands on the offline provider."""
+    from rca_tpu.llm.providers import LLMQuotaExceeded, OfflineProvider
+
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    monkeypatch.delenv("ANTHROPIC_API_KEY", raising=False)
+
+    class QuotaProvider(OfflineProvider):
+        name = "openai"
+
+        def complete(self, *a, **k):
+            raise LLMQuotaExceeded("429 rate limit")
+
+    events = []
+    llm = LLMClient(provider=QuotaProvider(), log_fn=events.append)
+    out = llm.generate_completion("hello")
+    assert out  # offline provider answered
+    assert llm.provider.name == "offline"
+    assert any(
+        e["additional_context"].get("kind") == "provider_failover"
+        for e in events
+    )

@@ -1,0 +1,52 @@
+"""Pallas fused noisy-OR kernel: interpret-mode correctness (CPU CI) and
+live-backend agreement when Mosaic is available."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from rca_tpu.engine.pallas_kernels import (  # noqa: E402
+    BLOCK_S,
+    noisy_or_pair_pallas,
+    noisy_or_pair_xla,
+    pallas_supported,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(1)
+    S, C = 2 * BLOCK_S, 12
+    f = rng.random((S, C)).astype(np.float32)
+    return (
+        jnp.asarray(f),
+        jnp.asarray(np.ascontiguousarray(f.T)),
+        jnp.asarray(rng.random(C).astype(np.float32)),
+        jnp.asarray(rng.random(C).astype(np.float32)),
+    )
+
+
+def test_interpret_matches_xla(data):
+    f, ft, aw, hw = data
+    a_ref, h_ref = noisy_or_pair_xla(f, aw, hw)
+    a, h = noisy_or_pair_pallas(ft, aw, hw, interpret=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref), atol=1e-6)
+
+
+def test_live_backend_if_supported(data):
+    if not pallas_supported():
+        pytest.skip("pallas not lowerable on this backend")
+    f, ft, aw, hw = data
+    a_ref, h_ref = noisy_or_pair_xla(f, aw, hw)
+    a, h = noisy_or_pair_pallas(ft, aw, hw)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref), atol=1e-6)
+
+
+def test_env_flag_disables(monkeypatch):
+    import rca_tpu.engine.pallas_kernels as pk
+
+    monkeypatch.setenv("RCA_PALLAS", "0")
+    assert pk.pallas_supported() is False
