@@ -19,7 +19,8 @@ import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+
+from rca_tpu.engine.propagate import _noisy_or
 
 BLOCK_S = 1024
 
@@ -43,23 +44,25 @@ def _pair_kernel(ft_ref, aw_ref, hw_ref, a_ref, h_ref):
 def noisy_or_pair_pallas(features_t, anomaly_w, hard_w, interpret=False):
     """(anomaly, hard) noisy-OR vectors from channel-major features.
 
-    ``features_t``: float32 [C, S] with S a multiple of ``BLOCK_S``.
+    ``features_t``: float32 [C, S] with S a power of two (block size adapts
+    to min(S, BLOCK_S)).
     """
     from jax.experimental import pallas as pl
 
     C, S = features_t.shape
-    grid = (S // BLOCK_S,)
+    block = min(S, BLOCK_S)
+    grid = (S // block,)
     out = pl.pallas_call(
         _pair_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((C, BLOCK_S), lambda i: (0, i)),
+            pl.BlockSpec((C, block), lambda i: (0, i)),
             pl.BlockSpec((C, 1), lambda i: (0, 0)),
             pl.BlockSpec((C, 1), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, BLOCK_S), lambda i: (0, i)),
-            pl.BlockSpec((1, BLOCK_S), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec((1, block), lambda i: (0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, S), jnp.float32),
@@ -71,15 +74,15 @@ def noisy_or_pair_pallas(features_t, anomaly_w, hard_w, interpret=False):
 
 
 def noisy_or_pair_xla(features, anomaly_w, hard_w):
-    """Reference implementation on row-major [S, C] features."""
-    clipped = jnp.clip(features, 0.0, 1.0)
-    a = 1.0 - jnp.prod(1.0 - clipped * anomaly_w[None, :], axis=1)
-    h = 1.0 - jnp.prod(1.0 - clipped * hard_w[None, :], axis=1)
-    return a, h
+    """Reference implementation on row-major [S, C] features (the same
+    expression the propagation core uses — one definition, propagate.py)."""
+    return _noisy_or(features, anomaly_w), _noisy_or(features, hard_w)
 
 
 def pallas_supported() -> bool:
-    """Try-compile probe, cached; honours RCA_PALLAS=0/1."""
+    """Whether the fused kernel is usable: ``RCA_PALLAS=0`` disables,
+    ``RCA_PALLAS=1`` requires it (raises if the probe fails), default
+    ``auto`` try-compiles once and caches the verdict."""
     global _SUPPORTED
     flag = os.environ.get("RCA_PALLAS", "auto")
     if flag == "0":
@@ -93,6 +96,11 @@ def pallas_supported() -> bool:
             _SUPPORTED = True
         except Exception:
             _SUPPORTED = False
+    if flag == "1" and not _SUPPORTED:
+        raise RuntimeError(
+            "RCA_PALLAS=1 but the Pallas kernel failed to compile on this "
+            "backend (set RCA_PALLAS=auto to fall back silently)"
+        )
     return _SUPPORTED
 
 

@@ -94,6 +94,23 @@ def propagate(
     """Returns (anomaly, hard, upstream, impact, score), all [S]."""
     a = _noisy_or(features, anomaly_w)
     h = _noisy_or(features, hard_w)
+    return propagate_core(
+        a, h, dep_src, dep_dst, steps, decay, explain_strength, impact_bonus
+    )
+
+
+def propagate_core(
+    a: jnp.ndarray,         # [S] anomaly evidence
+    h: jnp.ndarray,         # [S] hard evidence
+    dep_src: jnp.ndarray,   # [E] int32 — the dependent
+    dep_dst: jnp.ndarray,   # [E] int32 — the dependency
+    steps: int,
+    decay: float,
+    explain_strength: float,
+    impact_bonus: float,
+):
+    """Propagation given precomputed evidence vectors (lets the fused
+    Pallas noisy-OR feed the same core)."""
 
     def up_step(u, _):
         vals = jnp.maximum(h[dep_dst], decay * u[dep_dst])

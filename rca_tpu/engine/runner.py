@@ -37,20 +37,39 @@ def _use_ell_layout() -> bool:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("steps", "decay", "explain_strength", "impact_bonus", "k"),
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus", "k",
+        "use_pallas",
+    ),
 )
 def _propagate_ranked(
     features, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
-    k: int,
+    k: int, use_pallas: bool = False,
 ):
     """One dispatch, minimal transfers: edges arrive as one [2, E] buffer;
     diagnostics leave as one stacked [4, S] buffer plus the top-k pair.
-    Matters on tunneled TPUs where every host<->device hop pays an RTT."""
-    a, h, u, m, score = propagate(
-        features, edges[0], edges[1], anomaly_w, hard_w,
-        steps, decay, explain_strength, impact_bonus,
-    )
+    Matters on tunneled TPUs where every host<->device hop pays an RTT.
+
+    With ``use_pallas`` the two noisy-OR evidence passes run as the fused
+    Pallas kernel over the channel-major transpose (one feature read feeds
+    both products); the propagation core is shared either way."""
+    from rca_tpu.engine.propagate import propagate_core
+
+    if use_pallas:
+        from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
+
+        a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
+        out = propagate_core(
+            a, h, edges[0], edges[1],
+            steps, decay, explain_strength, impact_bonus,
+        )
+        a, h, u, m, score = out
+    else:
+        a, h, u, m, score = propagate(
+            features, edges[0], edges[1], anomaly_w, hard_w,
+            steps, decay, explain_strength, impact_bonus,
+        )
     vals, idx = jax.lax.top_k(score, k)
     return jnp.stack([a, u, m, score]), vals, idx
 
@@ -157,11 +176,24 @@ class GraphEngine:
                 )
         else:
             ej = jnp.asarray(np.stack([s, d]))  # one [2, E] upload
+            from rca_tpu.engine.pallas_kernels import (
+                BLOCK_S,
+                pallas_supported,
+            )
+
+            # kernel grid needs the node pad to divide into blocks (true
+            # for every power-of-two shape bucket; off-bucket giant graphs
+            # fall back to the XLA expression)
+            use_pallas = (
+                f.shape[0] % min(f.shape[0], BLOCK_S) == 0
+                and pallas_supported()
+            )
 
             def run():
                 return _propagate_ranked(
                     fj, ej, self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
+                    use_pallas,
                 )
 
         if timed:

@@ -65,11 +65,14 @@ def make_multislice_mesh(
         by_slice: dict = {}
         for d in devices:
             by_slice.setdefault(d.slice_index, []).append(d)
+        # keep every slice that can fill per_slice, then take the first
+        # n_slices qualifying ones (an undersized early slice must not
+        # abandon slice-aware grouping when later slices qualify)
         groups = [
             group[:per_slice]
-            for _, group in sorted(by_slice.items())[:n_slices]
+            for _, group in sorted(by_slice.items())
             if len(group) >= per_slice
-        ]
+        ][:n_slices]
         if len(groups) == n_slices:
             devices = [d for group in groups for d in group]
     sizes = (n_slices, *(s for _, s in per_slice_axes))
