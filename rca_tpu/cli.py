@@ -123,6 +123,33 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_train(args) -> int:
+    from rca_tpu.engine.train import TrainConfig, hit_at_1, save_params, train
+
+    cfg = TrainConfig(
+        n_services=args.services, n_cases=args.cases,
+        iters=args.iters, lr=args.lr, seed=args.seed,
+    )
+    params, history = train(cfg)
+    acc = hit_at_1(params, cfg)
+    if args.out:
+        save_params(params, args.out)
+    print(
+        json.dumps(
+            {
+                "final_loss": round(history[-1], 5),
+                "initial_loss": round(history[0], 5),
+                "holdout_hit_at_1": acc,
+                "checkpoint": args.out or None,
+                "decay": round(params.decay, 4),
+                "explain_strength": round(params.explain_strength, 4),
+                "impact_bonus": round(params.impact_bonus, 4),
+            }
+        )
+    )
+    return 0
+
+
 def cmd_investigations(args) -> int:
     from rca_tpu.store import InvestigationStore
 
@@ -205,6 +232,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--roots", type=int, default=3)
     sp.add_argument("--seed", type=int, default=0)
     sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser("train", help="fit propagation weights on "
+                        "synthetic cascades; save an orbax checkpoint")
+    sp.add_argument("--services", type=int, default=256)
+    sp.add_argument("--cases", type=int, default=64)
+    sp.add_argument("--iters", type=int, default=150)
+    sp.add_argument("--lr", type=float, default=0.05)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--out", default=None,
+                    help="checkpoint directory (loadable via RCA_WEIGHTS)")
+    sp.set_defaults(fn=cmd_train)
 
     sp = sub.add_parser("investigations", help="list/show investigations")
     sp.add_argument("--id", default=None)

@@ -121,6 +121,12 @@ class GraphEngine:
         params: Optional[PropagationParams] = None,
     ):
         self.config = config or RCAConfig()
+        if params is None:
+            ckpt = os.environ.get("RCA_WEIGHTS")
+            if ckpt:
+                from rca_tpu.engine.train import load_params
+
+                params = load_params(ckpt)
         self.params = params or default_params(self.config.propagation_steps)
         self._aw, self._hw = self.params.weight_arrays()
 
