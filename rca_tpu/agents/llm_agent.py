@@ -24,11 +24,9 @@ from rca_tpu.findings import SEVERITY_ORDER
 from rca_tpu.llm.client import LLMClient
 from rca_tpu.llm.tools import ToolSpec, cluster_toolsets
 
-_SYSTEM_TEMPLATE = (
-    "You are the {signal} analysis agent in a Kubernetes root-cause-analysis "
-    "system. Use the provided tools to gather evidence about the namespace, "
-    "then report concrete findings. Severity scale: info, low, medium, high, "
-    "critical. Be specific: name components, cite the evidence you fetched."
+_SEVERITY_GUIDE = (
+    " Severity scale: info, low, medium, high, critical. Be specific: name "
+    "components, cite the evidence you fetched."
 )
 
 _FINDINGS_PROMPT = (
