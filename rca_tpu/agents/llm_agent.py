@@ -5,8 +5,9 @@ context blob to the LLM, declared tools that were never invoked, and parsed
 findings out of ``Issue:/Component:/Severity:`` markdown headers
 (reference: agents/mcp_agent.py:170-251).  This family:
 
-- runs a REAL tool loop (rca_tpu.llm.toolloop) against the typed cluster
-  client, so evidence in the answer is evidence that was actually fetched;
+- runs a REAL tool loop (rca_tpu.llm.client.LLMClient.analyze) against the
+  typed cluster client, so evidence in the answer is evidence that was
+  actually fetched;
 - requests findings as structured JSON instead of header-parsing;
 - degrades deterministically: with the offline provider (or on any LLM
   failure) it falls back to the deterministic rule agent of the same signal,
@@ -29,6 +30,64 @@ _SEVERITY_GUIDE = (
     "components, cite the evidence you fetched."
 )
 
+# Per-signal system prompts (reference declares these per agent class:
+# agents/mcp_metrics_agent.py / mcp_logs_agent.py / mcp_events_agent.py /
+# mcp_topology_agent.py / mcp_traces_agent.py, each _get_system_prompt; the
+# resources signal maps to resource_analyzer.py's sweep).  Unlike the
+# reference, these prompts instruct the model to USE the tools, because our
+# loop really executes them.
+_SIGNAL_PROMPTS: Dict[str, str] = {
+    "metrics": (
+        "You are a Kubernetes metrics analyst. Use the tools to fetch pod "
+        "and node CPU/memory usage, HPA state, and resource quotas. Flag "
+        "utilization above 80% (above 90% is high severity), missing "
+        "requests/limits, HPAs pinned at max or with desired > current "
+        "replicas, and node pressure."
+    ),
+    "logs": (
+        "You are a Kubernetes log analyst. Use the tools to pull logs from "
+        "suspicious pods (crash-looping, restarting, failed) and search for "
+        "error patterns: OOM kills, connection refused, permission denied, "
+        "timeouts, crash loops, API errors, volume mounts, image pulls, DNS "
+        "failures, auth errors, config errors, 5xx, exceptions."
+    ),
+    "events": (
+        "You are a Kubernetes events analyst. Use the tools to fetch "
+        "namespace and per-resource events. Group events by involved "
+        "object; flag scheduling failures, volume problems, frequently "
+        "repeating warnings, control-plane component errors, and node "
+        "condition problems (NotReady, MemoryPressure, DiskPressure)."
+    ),
+    "topology": (
+        "You are a Kubernetes topology analyst. Use the tools to map "
+        "services, endpoints, deployments, ingresses, and network "
+        "policies. Flag services whose selectors match no ready pods, "
+        "ingresses routing to missing backends, dependency cycles, "
+        "single points of failure (high-fanin services with replicas < 2), "
+        "and over-permissive or missing network policies."
+    ),
+    "traces": (
+        "You are a distributed-tracing analyst. Use the tools to fetch "
+        "per-service latency percentiles, error rates, the service "
+        "dependency map, and slow operations. Flag services with elevated "
+        "p99 latency or error rate, and trace the failure to the most "
+        "upstream unhealthy dependency."
+    ),
+    "resources": (
+        "You are a Kubernetes resource-health analyst. Use the tools to "
+        "sweep pods, deployments, and events in the namespace. Flag "
+        "crash-looping / image-pull-failed / pending / evicted pods, "
+        "deployments with ready < desired replicas, selector mismatches, "
+        "and correlate warning events with the affected objects."
+    ),
+}
+
+_SYSTEM_TEMPLATE = (
+    "{prompt} Investigate the {signal} signal for the namespace described "
+    "by the user, calling tools to gather real evidence before concluding."
+    + _SEVERITY_GUIDE
+)
+
 _FINDINGS_PROMPT = (
     "Convert this {signal} analysis into JSON: "
     '{{"findings": [{{"component": "Kind/name", "issue": "...", '
@@ -47,44 +106,66 @@ class LLMAgent(Agent):
         client: LLMClient,
         tools: Optional[List[ToolSpec]] = None,
         fallback: Optional[Agent] = None,
+        cluster_client=None,
+        tools_namespace: Optional[str] = None,
     ):
         self.agent_type = agent_type
         self.client = client
         self.tools = tools or []
         self.fallback = fallback
+        self.cluster_client = cluster_client
+        self._tools_ns = tools_namespace if self.tools else None
+        self._toolset_cache: Dict[Any, List[ToolSpec]] = {}
 
-    # tools are bound per-namespace at analyze time when not preset
+    # tools are bound per-namespace at ANALYZE time (from the snapshot's
+    # namespace) unless preset for that same namespace — binding at
+    # construction time with an unknown namespace would aim every tool at
+    # the wrong place.
     def _tools_for(self, ctx: AnalysisContext, client) -> List[ToolSpec]:
-        if self.tools:
+        ns = ctx.snapshot.namespace
+        if self.tools and (self._tools_ns in (None, ns) or client is None):
             return self.tools
         if client is None:
             return []
-        return cluster_toolsets(client, ctx.snapshot.namespace).get(
-            self.agent_type, []
-        )
+        key = (id(client), ns)
+        if key not in self._toolset_cache:
+            self._toolset_cache[key] = cluster_toolsets(client, ns).get(
+                self.agent_type, []
+            )
+        return self._toolset_cache[key]
 
     def analyze(
         self, ctx: AnalysisContext, cluster_client=None
     ) -> AgentResult:
         r = AgentResult(self.agent_type)
-        tools = self._tools_for(ctx, cluster_client)
+        tools = self._tools_for(ctx, cluster_client or self.cluster_client)
         context = self._context_blob(ctx)
+        system_prompt = _SYSTEM_TEMPLATE.format(
+            prompt=_SIGNAL_PROMPTS.get(
+                self.agent_type,
+                f"You are a Kubernetes {self.agent_type} analyst.",
+            ),
+            signal=self.agent_type,
+        )
         try:
             out = self.client.analyze(
-                context,
-                tools=tools,
-                system_prompt=_SYSTEM_TEMPLATE.format(signal=self.agent_type),
+                context, tools=tools, system_prompt=system_prompt,
             )
         except Exception as e:
             return self._fall_back(ctx, r, f"LLM analyze failed: {e}")
         r.reasoning_steps.extend(out.get("reasoning_steps", []))
         analysis = out.get("final_analysis", "")
 
-        structured = self.client.generate_structured_output(
-            _FINDINGS_PROMPT.format(
-                signal=self.agent_type, analysis=analysis[:6000]
+        try:
+            structured = self.client.generate_structured_output(
+                _FINDINGS_PROMPT.format(
+                    signal=self.agent_type, analysis=analysis[:6000]
+                )
             )
-        )
+        except Exception as e:
+            return self._fall_back(
+                ctx, r, f"structured output failed: {e}", narrative=analysis,
+            )
         findings = (structured or {}).get("findings")
         if isinstance(findings, list) and findings:
             for f in findings:
@@ -160,18 +241,26 @@ class LLMAgent(Agent):
 def make_llm_agents(
     client: LLMClient, cluster_client=None, namespace: str = ""
 ) -> Dict[str, LLMAgent]:
-    """LLM agent per signal, each with its deterministic twin as fallback."""
+    """LLM agent per signal, each with its deterministic twin as fallback.
+
+    When ``namespace`` is given, tools are pre-bound to it; otherwise each
+    agent binds its toolset at analyze time from the snapshot's namespace
+    (so one agent set serves every namespace the coordinator analyzes).
+    """
     from rca_tpu.agents import make_agents
 
     det = make_agents()
     toolsets = (
-        cluster_toolsets(cluster_client, namespace) if cluster_client else {}
+        cluster_toolsets(cluster_client, namespace)
+        if (cluster_client and namespace) else {}
     )
     return {
         name: LLMAgent(
             name, client,
             tools=toolsets.get(name),
             fallback=det[name],
+            cluster_client=cluster_client,
+            tools_namespace=namespace or None,
         )
         for name in det
     }

@@ -53,6 +53,7 @@ class RCACoordinator:
         self.engine = engine
         self.use_llm_agents = use_llm_agents
         self.agents = make_agents()
+        self._llm_agents: Optional[Dict[str, Any]] = None
         self.analyses: Dict[str, Dict[str, Any]] = {}
 
     # -- session registry (reference: mcp_coordinator.py:243-975) ----------
@@ -96,9 +97,12 @@ class RCACoordinator:
 
     def _agent_for(self, agent_type: str):
         if self.use_llm_agents:
-            return make_llm_agents(
-                self.llm, cluster_client=self.cluster
-            )[agent_type]
+            # built once; tools bind per-analysis to the snapshot namespace
+            if self._llm_agents is None:
+                self._llm_agents = make_llm_agents(
+                    self.llm, cluster_client=self.cluster
+                )
+            return self._llm_agents[agent_type]
         return self.agents[agent_type]
 
     # -- analysis runners ----------------------------------------------------

@@ -8,8 +8,9 @@ claude-3-5-sonnet-20241022 defaults) with three deliberate changes:
   the process (reference: llm_client_improved.py:44-48 called ``sys.exit``);
 - every provider implements one small surface — ``complete(messages, tools)``
   returning text plus structured tool calls — so the tool loop in
-  :mod:`rca_tpu.llm.toolloop` actually executes tools (the reference accepted
-  a ``tools`` argument and ignored it, reference: llm_client_improved.py:68);
+  :meth:`rca_tpu.llm.client.LLMClient.analyze` actually executes tools (the
+  reference accepted a ``tools`` argument and ignored it, reference:
+  llm_client_improved.py:68);
 - an :class:`OfflineProvider` provides deterministic, network-free behavior
   so the hermetic/JAX path has zero network deps (SURVEY.md §7 hard parts).
 """

@@ -7,7 +7,7 @@ mcp_traces_agent.py:36-136) but its LLM client never invoked them
 (reference: utils/llm_client_improved.py:68 ignores ``tools``).  Here every
 schema is paired with an executable bound to the one typed
 :class:`~rca_tpu.cluster.protocol.ClusterClient`, so the loop in
-:mod:`rca_tpu.llm.toolloop` really runs them — and since both the real and
+:meth:`rca_tpu.llm.client.LLMClient.analyze` really runs them — and since both the real and
 mock backends implement the same protocol, every tool works against both
 (the reference's mock-only tool breakage, SURVEY.md §2.6, cannot recur).
 """

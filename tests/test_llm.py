@@ -129,6 +129,59 @@ def test_llm_agent_parses_structured_findings(ctx):
     assert res.summary == "database down"
 
 
+def test_coordinator_llm_agents_bind_namespace_and_cache():
+    """Coordinator-level LLM path: agents are built once (cached), tools are
+    bound to the SNAPSHOT's namespace at analyze time (regression: they were
+    bound to namespace "" at construction), and structured findings flow
+    through without the deterministic fallback firing."""
+    from rca_tpu.coordinator import RCACoordinator
+
+    calls = []
+
+    class SpyClient(MockClusterClient):
+        def get_pods(self, namespace):
+            calls.append(namespace)
+            return super().get_pods(namespace)
+
+    class ScriptedProvider(OfflineProvider):
+        def complete(self, messages, tools=None, temperature=0.2,
+                     max_tokens=2000, json_mode=False):
+            if json_mode:
+                return ProviderReply(text=json.dumps({
+                    "findings": [{
+                        "component": "Pod/database-7c9f8b6d5e-3x5qp",
+                        "issue": "crash looping",
+                        "severity": "critical",
+                        "evidence": "restart count 5",
+                        "recommendation": "fix the init script",
+                    }],
+                    "summary": "database down",
+                }))
+            return super().complete(
+                messages, tools=tools, temperature=temperature,
+                max_tokens=max_tokens, json_mode=json_mode,
+            )
+
+    coord = RCACoordinator(
+        SpyClient(five_service_world()),
+        llm_client=LLMClient(provider=ScriptedProvider()),
+        use_llm_agents=True,
+    )
+    assert coord._agent_for("logs") is coord._agent_for("logs")  # cached
+
+    rec = coord.run_analysis("logs", NS)
+    assert rec["status"] == "completed"
+    res = rec["results"]["logs"]
+    # the tool loop really executed the logs toolset's get_pods
+    assert any(s.get("tool") == "get_pods" for s in res["reasoning_steps"])
+    # every cluster call (snapshot capture AND tools) hit the real namespace
+    assert NS in calls
+    assert "" not in calls
+    # structured findings were adopted from the provider, not the fallback
+    assert res["findings"][0]["source"] == "llm"
+    assert res["summary"] == "database down"
+
+
 def test_quota_error_classification():
     from rca_tpu.llm.providers import LLMQuotaExceeded, _classify_error
 
