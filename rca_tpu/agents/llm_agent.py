@@ -115,7 +115,7 @@ class LLMAgent(Agent):
         self.fallback = fallback
         self.cluster_client = cluster_client
         self._tools_ns = tools_namespace if self.tools else None
-        self._toolset_cache: Dict[Any, List[ToolSpec]] = {}
+        self._toolset_cache: Dict[str, List[ToolSpec]] = {}
 
     # tools are bound per-namespace at ANALYZE time (from the snapshot's
     # namespace) unless preset for that same namespace — binding at
@@ -123,16 +123,20 @@ class LLMAgent(Agent):
     # the wrong place.
     def _tools_for(self, ctx: AnalysisContext, client) -> List[ToolSpec]:
         ns = ctx.snapshot.namespace
-        if self.tools and (self._tools_ns in (None, ns) or client is None):
+        # preset tools are trusted only for the namespace they were bound
+        # to (or when no client is available to rebind them)
+        if self.tools and (self._tools_ns == ns or client is None):
             return self.tools
         if client is None:
             return []
-        key = (id(client), ns)
-        if key not in self._toolset_cache:
-            self._toolset_cache[key] = cluster_toolsets(client, ns).get(
+        if client is not self.cluster_client:
+            # ad-hoc client for this one call: build fresh, don't retain it
+            return cluster_toolsets(client, ns).get(self.agent_type, [])
+        if ns not in self._toolset_cache:
+            self._toolset_cache[ns] = cluster_toolsets(client, ns).get(
                 self.agent_type, []
             )
-        return self._toolset_cache[key]
+        return self._toolset_cache[ns]
 
     def analyze(
         self, ctx: AnalysisContext, cluster_client=None
