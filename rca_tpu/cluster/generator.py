@@ -111,14 +111,50 @@ def _bfs_hops(n: int, adj, roots: np.ndarray) -> np.ndarray:
     return dist
 
 
+CASCADE_MODES = (
+    "standard",
+    "crashing_victims",
+    "missing_signals",
+    "correlated_noise",
+    "overlapping_roots",
+    "adversarial",
+)
+
+
 def synthetic_cascade_arrays(
     n_services: int,
     n_roots: int = 1,
     seed: int = 0,
     decay: float = 0.75,
     noise: float = 0.05,
+    mode: str = "standard",
 ) -> CascadeArrays:
-    """Generate the raw-array cascade (any scale; used for bench + training)."""
+    """Generate the raw-array cascade (any scale; used for bench + training).
+
+    ``mode`` selects how adversarial the cascade is (VERDICT round-1: the
+    standard generator makes roots nearly separable from the noisy-OR alone,
+    so accuracy numbers ride an easy distribution):
+
+    - ``standard`` — roots crash hard, victims degrade softly (no crash).
+    - ``crashing_victims`` — probe-kill: victims near the root ALSO crash
+      and restart (liveness probes kill pods that time out on a dead
+      dependency), while roots crash with a wider, weaker range; the max
+      per-service feature no longer identifies the root.
+    - ``missing_signals`` — per-(service, channel) dropout: each fault
+      signal is observed only with probability ~0.65 (agents miss data in
+      real clusters); roots can lose their crash channel entirely.
+    - ``correlated_noise`` — low-rank correlated background (shared noise
+      factors across services, e.g. a noisy node or scrape jitter) plus
+      loud decoy services with error/latency spikes but no downstream
+      blast radius.
+    - ``overlapping_roots`` — multi-root with overlapping blast radii:
+      later roots are drawn from inside the first root's affected set, so
+      victim symptoms stack and per-root evidence overlaps.
+    - ``adversarial`` — crashing_victims + missing_signals +
+      correlated_noise at once.
+    """
+    if mode not in CASCADE_MODES:
+        raise ValueError(f"unknown cascade mode {mode!r}; one of {CASCADE_MODES}")
     rng = np.random.default_rng(seed)
     dep_src, dep_dst = _build_dag(n_services, rng)
     adj = _dependents_adj(n_services, dep_src, dep_dst)
@@ -128,15 +164,49 @@ def synthetic_cascade_arrays(
     candidates = np.nonzero(impact > 0)[0]
     if len(candidates) < n_roots:
         candidates = np.arange(n_services)
-    roots = rng.choice(candidates, size=min(n_roots, len(candidates)), replace=False)
+    if mode == "overlapping_roots" and n_roots > 1:
+        first = rng.choice(candidates, size=1)
+        hops0 = _bfs_hops(n_services, adj, first.astype(np.int32))
+        blast = np.nonzero(
+            (hops0 > 0) & (hops0 < np.iinfo(np.int32).max)
+        )[0]
+        pool = blast if len(blast) >= n_roots - 1 else np.setdiff1d(
+            candidates, first
+        )
+        rest = rng.choice(pool, size=min(n_roots - 1, len(pool)), replace=False)
+        roots = np.concatenate([first, rest])
+    else:
+        roots = rng.choice(
+            candidates, size=min(n_roots, len(candidates)), replace=False
+        )
     roots = roots.astype(np.int32)
 
     hops = _bfs_hops(n_services, adj, roots)
     feats = np.zeros((n_services, NUM_FEATURES), dtype=np.float32)
 
-    background = rng.uniform(0.0, noise, size=(n_services, NUM_FEATURES)).astype(
-        np.float32
-    )
+    correlated = mode in ("correlated_noise", "adversarial")
+    if correlated:
+        # low-rank noise: a few shared factors load onto every service
+        # (scrape jitter, a hot node) — raises the background floor in a
+        # structured way that per-service thresholds cannot remove.  The
+        # factors load only onto SOFT channels: jitter inflates latency /
+        # error rates / event counts, it does not fabricate OOM kills or
+        # image-pull failures.
+        n_factors = 3
+        soft = np.zeros(NUM_FEATURES, dtype=np.float32)
+        soft[[F_ERROR_RATE, F_LATENCY, F_EVENTS, F_LOG_ERRORS, F_RESOURCE]] = 1.0
+        loadings = rng.uniform(0, 1, (n_services, n_factors)).astype(np.float32)
+        factors = (
+            rng.uniform(0, 0.25, (n_factors, NUM_FEATURES)).astype(np.float32)
+            * soft[None, :]
+        )
+        background = loadings @ factors + rng.uniform(
+            0.0, noise, size=(n_services, NUM_FEATURES)
+        ).astype(np.float32)
+    else:
+        background = rng.uniform(
+            0.0, noise, size=(n_services, NUM_FEATURES)
+        ).astype(np.float32)
     feats += background
 
     is_root = np.zeros(n_services, dtype=bool)
@@ -145,21 +215,62 @@ def synthetic_cascade_arrays(
     aff_idx = np.nonzero(affected)[0]
     aff_decay = (decay ** hops[aff_idx]).astype(np.float32)
 
-    # Roots: hard failure symptoms.
-    feats[roots, F_CRASH] = rng.uniform(0.85, 1.0, size=len(roots))
-    feats[roots, F_RESTARTS] = rng.uniform(0.7, 1.0, size=len(roots))
+    crashing_victims = mode in ("crashing_victims", "adversarial")
+    if crashing_victims:
+        # roots crash over a wider, weaker range (flaky rather than dead) …
+        feats[roots, F_CRASH] = rng.uniform(0.55, 0.95, size=len(roots))
+        feats[roots, F_RESTARTS] = rng.uniform(0.5, 0.9, size=len(roots))
+    else:
+        feats[roots, F_CRASH] = rng.uniform(0.85, 1.0, size=len(roots))
+        feats[roots, F_RESTARTS] = rng.uniform(0.7, 1.0, size=len(roots))
     feats[roots, F_EVENTS] = rng.uniform(0.6, 1.0, size=len(roots))
     feats[roots, F_LOG_ERRORS] = rng.uniform(0.7, 1.0, size=len(roots))
-    feats[roots, F_NOT_READY] = 1.0
+    feats[roots, F_NOT_READY] = rng.uniform(0.8, 1.0, size=len(roots))
     feats[roots, F_ERROR_RATE] = rng.uniform(0.5, 1.0, size=len(roots))
 
-    # Dependents: soft degradation decaying with hop distance — crucially, NO
-    # crash signal (they are victims, not causes).
+    # Dependents: soft degradation decaying with hop distance.  In standard
+    # mode victims carry NO crash signal (they are victims, not causes);
+    # in probe-kill modes close victims saturate latency/errors AND crash,
+    # so their max feature routinely exceeds the root's.
     jitter = rng.uniform(0.8, 1.0, size=len(aff_idx)).astype(np.float32)
-    feats[aff_idx, F_ERROR_RATE] = 0.7 * aff_decay * jitter
-    feats[aff_idx, F_LATENCY] = 0.8 * aff_decay * jitter
     feats[aff_idx, F_LOG_ERRORS] = 0.4 * aff_decay * jitter
     feats[aff_idx, F_EVENTS] = 0.3 * aff_decay * jitter
+    if crashing_victims:
+        feats[aff_idx, F_LATENCY] = np.clip(
+            1.1 * aff_decay * jitter, 0, 1.0
+        )
+        feats[aff_idx, F_ERROR_RATE] = np.clip(
+            1.0 * aff_decay * rng.uniform(0.85, 1.0, len(aff_idx)), 0, 1.0
+        )
+        feats[aff_idx, F_CRASH] = np.clip(
+            0.75 * aff_decay * rng.uniform(0.7, 1.0, len(aff_idx)), 0, 1.0
+        )
+        feats[aff_idx, F_RESTARTS] = np.clip(
+            0.7 * aff_decay * rng.uniform(0.6, 1.0, len(aff_idx)), 0, 1.0
+        )
+        feats[aff_idx, F_NOT_READY] = (aff_decay > 0.5).astype(np.float32)
+    else:
+        feats[aff_idx, F_ERROR_RATE] = 0.7 * aff_decay * jitter
+        feats[aff_idx, F_LATENCY] = 0.8 * aff_decay * jitter
+
+    if correlated:
+        # decoy services: loud but inert (no blast radius) — error/latency
+        # spikes from e.g. a bad canary; ~2% of services, never roots or
+        # their direct dependents
+        n_decoys = max(1, n_services // 50)
+        eligible = np.nonzero(~is_root & ~affected)[0]
+        if len(eligible) >= n_decoys:
+            decoys = rng.choice(eligible, size=n_decoys, replace=False)
+            feats[decoys, F_ERROR_RATE] = rng.uniform(0.9, 1.0, n_decoys)
+            feats[decoys, F_LATENCY] = rng.uniform(0.9, 1.0, n_decoys)
+            feats[decoys, F_LOG_ERRORS] = rng.uniform(0.3, 0.7, n_decoys)
+
+    if mode in ("missing_signals", "adversarial"):
+        # per-(service, channel) dropout of the fault signals: each channel
+        # is observed with probability 0.65 (background survives — missing
+        # data looks like *quiet*, not like zeroed noise)
+        keep = rng.random((n_services, NUM_FEATURES)) < 0.65
+        feats = np.where(keep, feats, background).astype(np.float32)
 
     anomaly = feats.max(axis=1)
     names = None
@@ -182,13 +293,14 @@ def synthetic_cascade_world(
     seed: int = 0,
     namespace: str = "synthetic",
     pods_per_service: int = 1,
+    mode: str = "standard",
 ) -> World:
     """Generate a full dict-world cascade (drives the agent/coordinator layer).
 
     Suitable up to a few thousand services; the raw-array form above covers
     10k-50k scale without dict materialization.
     """
-    case = synthetic_cascade_arrays(n_services, n_roots, seed)
+    case = synthetic_cascade_arrays(n_services, n_roots, seed, mode=mode)
     rng = np.random.default_rng(seed + 1)
     names = [f"svc-{i:05d}" for i in range(n_services)]
 
@@ -331,5 +443,6 @@ def synthetic_cascade_world(
         "fault_roots": [names[r] for r in case.roots.tolist()],
         "n_services": n_services,
         "seed": seed,
+        "mode": mode,
     }
     return w

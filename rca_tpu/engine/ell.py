@@ -26,7 +26,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rca_tpu.engine.propagate import _noisy_or
+from rca_tpu.engine.propagate import (
+    _noisy_or,
+    background_excess,
+    combine_score,
+)
 
 DEFAULT_WIDTH_CAP = 32
 
@@ -120,6 +124,7 @@ def propagate_ell(
     dn_ovf_seg, dn_ovf_other,    # [Od]
     anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    n_live=None,
 ):
     """Scatter-free variant of :func:`rca_tpu.engine.propagate.propagate`.
 
@@ -142,18 +147,18 @@ def propagate_ell(
 
     u, _ = jax.lax.scan(up_step, jnp.zeros_like(a), None, length=steps)
 
+    a_ex = background_excess(a, n_live)
+
     def imp_step(m, _):
-        vals = (a[dn_idx] + decay * m[dn_idx]) * dn_mask
+        vals = (a_ex[dn_idx] + decay * m[dn_idx]) * dn_mask
         m_new = vals.sum(axis=1)
         # padded overflow lanes point at the dummy node whose a=m=0
-        ovf = a[dn_ovf_other] + decay * m[dn_ovf_other]
+        ovf = a_ex[dn_ovf_other] + decay * m[dn_ovf_other]
         m_new = m_new.at[dn_ovf_seg].add(ovf)
         m_new = m_new.at[-1].set(0.0)
         return m_new, None
 
     m, _ = jax.lax.scan(imp_step, jnp.zeros_like(a), None, length=steps)
 
-    score = (a + impact_bonus * jnp.tanh(m / 4.0)) * (
-        1.0 - explain_strength * u * (1.0 - h)
-    )
+    score = combine_score(a, h, u, m, explain_strength, impact_bonus)
     return a, h, u, m, score

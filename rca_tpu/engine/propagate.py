@@ -31,6 +31,12 @@ import numpy as np
 from rca_tpu.features.schema import NUM_SERVICE_FEATURES, SvcF
 
 
+# Bumped whenever the scoring semantics change (weights fitted against one
+# objective surface mis-rank under another): v2 = multiplicative impact
+# bonus on background-excess accumulation (v1 was additive on raw anomaly).
+SCORE_FORMULA_VERSION = 2
+
+
 @dataclasses.dataclass(frozen=True)
 class PropagationParams:
     anomaly_weights: tuple       # per-channel weights for a
@@ -80,6 +86,44 @@ def _noisy_or(features: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
     return 1.0 - jnp.prod(1.0 - clipped * weights[None, :], axis=1)
 
 
+def background_excess(a: jnp.ndarray, n_live=None) -> jnp.ndarray:
+    """Anomaly excess over the cascade-wide background level.  Correlated
+    noise (scrape jitter, a hot node) lifts every service's evidence
+    uniformly; impact must accumulate only the excess, otherwise any hub
+    with enough dependents saturates its impact term on background alone.
+
+    The background is the MEDIAN over live services — a robust location
+    with a 50% breakdown point, so it tracks the quiet majority instead of
+    being dragged up by the incident's own victims (a mean+σ cut zeroes the
+    excess entirely on small graphs where most services are symptomatic).
+
+    ``n_live`` is the number of REAL services: slots 0..n_live-1 are live
+    (quiet services with a == 0 legitimately count toward the background),
+    slots beyond are shape-bucket padding and are excluded.  ``None`` means
+    every slot is live."""
+    if n_live is None:
+        return jnp.maximum(a - jnp.median(a), 0.0)
+    live = jnp.arange(a.shape[0]) < n_live
+    masked = jnp.where(live, a, jnp.nan)
+    a_bg = jnp.nan_to_num(jnp.nanmedian(masked), nan=0.0)
+    return jnp.where(live, jnp.maximum(a - a_bg, 0.0), 0.0)
+
+
+def combine_score(a, h, u, m, explain_strength, impact_bonus):
+    """Final root-cause score.  Explain-away suppresses *soft* symptoms
+    (latency, error rates) that an anomalous upstream accounts for, damped
+    by the node's own hard evidence: a crashed service is a cause in its own
+    right even when a dependency is also broken (concurrent-root cascades).
+    The impact bonus is MULTIPLICATIVE on the node's own evidence: a
+    symptomatic blast radius amplifies existing evidence of being broken; it
+    cannot make a healthy hub look like a root cause on fan-out alone."""
+    return (
+        a
+        * (1.0 + impact_bonus * jnp.tanh(m / 4.0))
+        * (1.0 - explain_strength * u * (1.0 - h))
+    )
+
+
 def propagate(
     features: jnp.ndarray,  # [S, C] float32
     dep_src: jnp.ndarray,   # [E] int32 — the dependent
@@ -90,12 +134,14 @@ def propagate(
     decay: float,
     explain_strength: float,
     impact_bonus: float,
+    n_live=None,            # real-service count; slots beyond are padding
 ):
     """Returns (anomaly, hard, upstream, impact, score), all [S]."""
     a = _noisy_or(features, anomaly_w)
     h = _noisy_or(features, hard_w)
     return propagate_core(
-        a, h, dep_src, dep_dst, steps, decay, explain_strength, impact_bonus
+        a, h, dep_src, dep_dst, steps, decay, explain_strength, impact_bonus,
+        n_live=n_live,
     )
 
 
@@ -108,6 +154,7 @@ def propagate_core(
     decay: float,
     explain_strength: float,
     impact_bonus: float,
+    n_live=None,            # real-service count; slots beyond are padding
 ):
     """Propagation given precomputed evidence vectors (lets the fused
     Pallas noisy-OR feed the same core)."""
@@ -119,19 +166,15 @@ def propagate_core(
 
     u, _ = jax.lax.scan(up_step, jnp.zeros_like(a), None, length=steps)
 
+    a_ex = background_excess(a, n_live)
+
     def imp_step(m, _):
-        vals = a[dep_src] + decay * m[dep_src]
+        vals = a_ex[dep_src] + decay * m[dep_src]
         return jnp.zeros_like(m).at[dep_dst].add(vals), None
 
     m, _ = jax.lax.scan(imp_step, jnp.zeros_like(a), None, length=steps)
 
-    # Explain-away suppresses *soft* symptoms (latency, error rates) that an
-    # anomalous upstream accounts for, damped by the node's own hard
-    # evidence: a crashed service is a cause in its own right even when a
-    # dependency is also broken (concurrent-root cascades).
-    score = (a + impact_bonus * jnp.tanh(m / 4.0)) * (
-        1.0 - explain_strength * u * (1.0 - h)
-    )
+    score = combine_score(a, h, u, m, explain_strength, impact_bonus)
     return a, h, u, m, score
 
 

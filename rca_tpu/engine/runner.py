@@ -45,7 +45,7 @@ def _use_ell_layout() -> bool:
 def _propagate_ranked(
     features, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
-    k: int, use_pallas: bool = False,
+    k: int, use_pallas: bool = False, n_live=None,
 ):
     """One dispatch, minimal transfers: edges arrive as one [2, E] buffer;
     diagnostics leave as one stacked [4, S] buffer plus the top-k pair.
@@ -62,13 +62,13 @@ def _propagate_ranked(
         a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
         out = propagate_core(
             a, h, edges[0], edges[1],
-            steps, decay, explain_strength, impact_bonus,
+            steps, decay, explain_strength, impact_bonus, n_live=n_live,
         )
         a, h, u, m, score = out
     else:
         a, h, u, m, score = propagate(
             features, edges[0], edges[1], anomaly_w, hard_w,
-            steps, decay, explain_strength, impact_bonus,
+            steps, decay, explain_strength, impact_bonus, n_live=n_live,
         )
     vals, idx = jax.lax.top_k(score, k)
     return jnp.stack([a, u, m, score]), vals, idx
@@ -82,12 +82,13 @@ def _propagate_ranked_ell(
     features, up_idx, up_mask, up_ovf, dn_idx, dn_mask, dn_ovf,
     anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
-    k: int,
+    k: int, n_live=None,
 ):
     a, h, u, m, score = propagate_ell(
         features, up_idx, up_mask, up_ovf[0], up_ovf[1],
         dn_idx, dn_mask, dn_ovf[0], dn_ovf[1],
         anomaly_w, hard_w, steps, decay, explain_strength, impact_bonus,
+        n_live=n_live,
     )
     vals, idx = jax.lax.top_k(score, k)
     return jnp.stack([a, u, m, score]), vals, idx
@@ -161,6 +162,9 @@ class GraphEngine:
         fj = jnp.asarray(f)
         p = self.params
         kk = min(k + 8, f.shape[0])
+        # live-count as a traced scalar: same executable serves every graph
+        # size within a shape bucket
+        n_live = jnp.asarray(n, jnp.int32)
 
         if _use_ell_layout():
             # scatter-free layout for large graphs
@@ -179,6 +183,7 @@ class GraphEngine:
                     fj, up_idx, up_mask, up_ovf, dn_idx, dn_mask, dn_ovf,
                     self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
+                    n_live,
                 )
         else:
             ej = jnp.asarray(np.stack([s, d]))  # one [2, E] upload
@@ -199,7 +204,7 @@ class GraphEngine:
                 return _propagate_ranked(
                     fj, ej, self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
-                    use_pallas,
+                    use_pallas, n_live,
                 )
 
         if timed:

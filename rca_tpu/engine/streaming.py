@@ -70,6 +70,7 @@ class StreamingSession:
             jnp.asarray(self._features), self._edges,
             self.engine._aw, self.engine._hw,
             p.steps, p.decay, p.explain_strength, p.impact_bonus, self._kk,
+            False, jnp.asarray(self._n, jnp.int32),
         )
         idx.block_until_ready()
         latency_ms = (time.perf_counter() - t0) * 1e3

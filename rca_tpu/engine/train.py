@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rca_tpu.engine.propagate import (
+    SCORE_FORMULA_VERSION,
     PropagationParams,
     default_params,
     propagate_core,
@@ -41,6 +42,9 @@ class TrainConfig:
     iters: int = 150
     lr: float = 0.05
     seed: int = 0
+    # cascade modes sampled round-robin across the dataset (hard modes give
+    # the learned weights something the hand-set defaults don't already ace)
+    modes: Tuple[str, ...] = ("standard",)
 
 
 def _logit(p: float) -> float:
@@ -86,6 +90,7 @@ def make_dataset(
             synthetic_cascade_arrays(
                 S, n_roots=int(rng.integers(1, cfg.n_roots_max + 1)),
                 seed=cfg.seed + seed_offset + b,
+                mode=cfg.modes[b % len(cfg.modes)],
             )
         )
     e_max = max(len(c.dep_src) for c in cases)
@@ -114,6 +119,7 @@ def _forward(tree, features, edges, steps: int):
     _, _, _, _, score = propagate_core(
         a, h, edges[0], edges[1], steps,
         sig(tree["decay"]), sig(tree["mu"]), sig(tree["beta"]),
+        n_live=features.shape[0] - 1,  # last slot is the edge-padding dummy
     )
     return score
 
@@ -130,7 +136,7 @@ def _loss(tree, feats, edges, roots, steps: int):
 
 
 def hit_at_1(params: PropagationParams, cfg: TrainConfig,
-             seed_offset: int = 10_000) -> float:
+             seed_offset: int = 10_000, mode: str = "standard") -> float:
     """Held-out top-1 accuracy (single-root cases for an unambiguous metric)."""
     from rca_tpu.cluster.generator import synthetic_cascade_arrays
     from rca_tpu.engine import GraphEngine
@@ -140,7 +146,8 @@ def hit_at_1(params: PropagationParams, cfg: TrainConfig,
     trials = 20
     for t in range(trials):
         case = synthetic_cascade_arrays(
-            cfg.n_services, n_roots=1, seed=cfg.seed + seed_offset + t
+            cfg.n_services, n_roots=1, seed=cfg.seed + seed_offset + t,
+            mode=mode,
         )
         r = engine.analyze_arrays(
             case.features, case.dep_src, case.dep_dst, k=1
@@ -185,6 +192,7 @@ def save_params(params: PropagationParams, path: str) -> None:
         "decay": np.asarray(params.decay, np.float32),
         "explain_strength": np.asarray(params.explain_strength, np.float32),
         "impact_bonus": np.asarray(params.impact_bonus, np.float32),
+        "formula_version": np.asarray(SCORE_FORMULA_VERSION, np.int32),
     }
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(Path(path).absolute(), tree, force=True)
@@ -195,6 +203,15 @@ def load_params(path: str) -> PropagationParams:
 
     ckptr = ocp.PyTreeCheckpointer()
     tree = ckptr.restore(Path(path).absolute())
+    version = int(tree.get("formula_version", 1))
+    if version != SCORE_FORMULA_VERSION:
+        raise ValueError(
+            f"checkpoint {path} was trained against score formula "
+            f"v{version}, but this engine computes v{SCORE_FORMULA_VERSION} "
+            "(rca_tpu.engine.propagate.SCORE_FORMULA_VERSION) — weights "
+            "fitted to a different objective mis-rank silently; retrain "
+            "with `rca train`."
+        )
     n = NUM_SERVICE_FEATURES
     aw = tuple(float(x) for x in np.asarray(tree["anomaly_weights"])[:n])
     hw = tuple(float(x) for x in np.asarray(tree["hard_weights"])[:n])

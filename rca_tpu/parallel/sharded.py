@@ -30,7 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from rca_tpu.engine.propagate import PropagationParams, _noisy_or
+from rca_tpu.engine.propagate import (
+    PropagationParams,
+    _noisy_or,
+    background_excess,
+    combine_score,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +43,7 @@ class ShardedGraph:
     """Edge partition for an sp-way node sharding."""
 
     n_pad: int                 # padded node count (multiple of sp)
+    n: int                     # real node count (slots n..n_pad-1 are pad)
     block: int                 # nodes per shard = n_pad // sp
     sp: int
     src_local: np.ndarray      # int32 [sp, e_pad] — src index within block
@@ -67,14 +73,14 @@ def shard_graph(
             dst_global[k, :m] = dst[ix]
             mask[k, :m] = 1.0
     return ShardedGraph(
-        n_pad=n_pad, block=block, sp=sp,
+        n_pad=n_pad, n=n, block=block, sp=sp,
         src_local=src_local, src_global=src_global,
         dst_global=dst_global, mask=mask,
     )
 
 
 def _propagate_block(
-    f_blk, src_local, src_global, dst_global, mask,
+    f_blk, src_local, src_global, dst_global, mask, n_live,
     aw, hw, steps: int, decay: float, mu: float, beta: float,
 ):
     """Per-device kernel for ONE graph: f_blk is this shard's node block."""
@@ -91,9 +97,13 @@ def _propagate_block(
 
     u_blk, _ = jax.lax.scan(up_step, jnp.zeros_like(a_blk), None, length=steps)
 
+    # background excess over the FULL (all-gathered) anomaly vector so every
+    # shard subtracts the same global background as the dense path
+    a_ex_full = background_excess(a_full, n_live)
+
     def imp_step(m_blk, _):
         m_full = jax.lax.all_gather(m_blk, "sp", tiled=True)
-        vals = mask * (a_full[src_global] + decay * m_full[src_global])
+        vals = mask * (a_ex_full[src_global] + decay * m_full[src_global])
         contrib_full = jnp.zeros_like(m_full).at[dst_global].add(vals)
         # reduce-scatter: every shard receives its reduced block only
         return jax.lax.psum_scatter(
@@ -101,10 +111,9 @@ def _propagate_block(
         ), None
 
     m_blk, _ = jax.lax.scan(imp_step, jnp.zeros_like(a_blk), None, length=steps)
-    # same hard-evidence-damped suppression as engine.propagate
-    return (a_blk + beta * jnp.tanh(m_blk / 4.0)) * (
-        1.0 - mu * u_blk * (1.0 - h_blk)
-    )
+    # same hard-evidence-damped suppression + multiplicative impact as
+    # engine.propagate.combine_score
+    return combine_score(a_blk, h_blk, u_blk, m_blk, mu, beta)
 
 
 @functools.lru_cache(maxsize=32)
@@ -121,7 +130,7 @@ def _jitted_shard_fn(
     spread over DCN, node shards over ICI; no cross-slice collective is
     ever issued inside the propagation)."""
 
-    def per_device(f_loc, src_l, src_g, dst_g, mask, aw, hw):
+    def per_device(f_loc, src_l, src_g, dst_g, mask, n_live, aw, hw):
         # f_loc: [B/dp, block, C]; edge arrays arrive [1, e_pad] — drop the
         # collapsed shard axis, then vmap the block kernel over the local batch
         src_l, src_g = src_l[0], src_g[0]
@@ -131,7 +140,7 @@ def _jitted_shard_fn(
             steps=steps, decay=decay, mu=mu, beta=beta,
         )
         return jax.vmap(
-            lambda f: kernel(f, src_l, src_g, dst_g, mask, aw=aw, hw=hw)
+            lambda f: kernel(f, src_l, src_g, dst_g, mask, n_live, aw=aw, hw=hw)
         )(f_loc)
 
     batch_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
@@ -141,7 +150,7 @@ def _jitted_shard_fn(
         in_specs=(
             P(batch_spec, "sp", None),
             P("sp", None), P("sp", None), P("sp", None), P("sp", None),
-            P(), P(),
+            P(), P(), P(),
         ),
         out_specs=P(batch_spec, "sp"),
         check_vma=False,
@@ -178,4 +187,7 @@ def sharded_propagate(
         for x in (graph.src_local, graph.src_global, graph.dst_global, graph.mask)
     )
     with mesh:
-        return fn(fb, *args, jnp.asarray(aw), jnp.asarray(hw))
+        return fn(
+            fb, *args, jnp.asarray(graph.n, jnp.int32),
+            jnp.asarray(aw), jnp.asarray(hw),
+        )

@@ -86,6 +86,59 @@ def test_generator_arrays_ground_truth():
     assert (case.dep_dst < case.dep_src).all()
 
 
+def test_cascade_modes_valid_and_distinct():
+    """Every adversarial mode yields bounded features, recorded ground
+    truth, and the property it advertises."""
+    from rca_tpu.cluster.generator import CASCADE_MODES
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        synthetic_cascade_arrays(50, mode="bogus")
+
+    for mode in CASCADE_MODES:
+        case = synthetic_cascade_arrays(160, n_roots=2, seed=5, mode=mode)
+        assert np.isfinite(case.features).all()
+        assert case.features.min() >= 0.0 and case.features.max() <= 1.0
+        assert len(case.roots) == 2
+        assert (case.dep_dst < case.dep_src).all()
+
+    # crashing_victims: some non-root services carry a crash signal
+    cv = synthetic_cascade_arrays(160, n_roots=1, seed=5,
+                                  mode="crashing_victims")
+    root_mask = np.zeros(160, bool)
+    root_mask[cv.roots] = True
+    assert cv.features[~root_mask, 0].max() > 0.3
+    # correlated_noise: background floor is clearly lifted vs standard
+    cn = synthetic_cascade_arrays(160, n_roots=1, seed=5,
+                                  mode="correlated_noise")
+    std = synthetic_cascade_arrays(160, n_roots=1, seed=5)
+    assert cn.features.mean() > std.features.mean() * 2
+    # world carries the mode in ground truth
+    w = synthetic_cascade_world(30, seed=3, mode="missing_signals")
+    assert w.ground_truth["mode"] == "missing_signals"
+
+
+def test_hard_modes_defeat_naive_but_not_engine():
+    """The reason the modes exist: max-anomaly ranking fails where the
+    explain-away engine does not (VERDICT round-1: accuracy numbers must
+    not ride an easy generator)."""
+    from rca_tpu.engine import GraphEngine
+
+    engine = GraphEngine()
+    eng_hits = naive_hits = 0
+    trials = 8
+    for seed in range(trials):
+        c = synthetic_cascade_arrays(300, n_roots=1, seed=seed,
+                                     mode="crashing_victims")
+        root = int(c.roots[0])
+        res = engine.analyze_case(c, k=1)
+        eng_hits += int(np.argmax(res.score)) == root
+        naive_hits += int(np.argmax(c.anomaly)) == root
+    assert eng_hits == trials
+    assert naive_hits <= trials // 2
+
+
 def test_generator_world_consistency():
     w = synthetic_cascade_world(50, n_roots=1, seed=7)
     client = MockClusterClient(w)

@@ -11,8 +11,12 @@ from rca_tpu.features.schema import NUM_SERVICE_FEATURES, SvcF
 
 
 def _chain_case():
-    """0 depends on 1 depends on 2; 2 is crashed, 0/1 degraded."""
-    f = np.zeros((3, NUM_SERVICE_FEATURES), np.float32)
+    """0 depends on 1 depends on 2; 2 is crashed, 0/1 degraded.  Three
+    PERFECTLY healthy bystanders (all-zero features — the normal shape of
+    real extracted snapshots) anchor the background median at zero; impact
+    is background-relative (propagate.background_excess) and must treat
+    quiet-but-live services as background, not as padding."""
+    f = np.zeros((6, NUM_SERVICE_FEATURES), np.float32)
     f[2, SvcF.CRASH] = 1.0
     f[2, SvcF.NOT_READY] = 1.0
     f[1, SvcF.ERROR_RATE] = 0.6
@@ -26,12 +30,16 @@ def _chain_case():
 
 def test_explain_away_chain():
     f, src, dst = _chain_case()
-    res = GraphEngine().analyze_arrays(f, src, dst, ["a", "b", "c"])
+    res = GraphEngine().analyze_arrays(
+        f, src, dst, ["a", "b", "c", "x", "y", "z"]
+    )
     assert res.ranked[0]["component"] == "c"
     # the middle service is anomalous but explained by its broken dependency
     assert res.upstream[1] > 0.8
     assert res.score[1] < res.score[2]
-    # impact flows downstream: the root accumulated its dependents' anomaly
+    # impact flows downstream: the root accumulated its dependents'
+    # above-background anomaly — nonzero even though every non-incident
+    # service is exactly zero (clean-input regression)
     assert res.impact[2] > res.impact[1] > 0
 
 

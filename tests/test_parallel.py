@@ -20,7 +20,7 @@ def _reference_scores(features, src, dst, n_pad, params):
         propagate(
             jnp.asarray(f), jnp.asarray(src), jnp.asarray(dst), aw, hw,
             params.steps, params.decay, params.explain_strength,
-            params.impact_bonus,
+            params.impact_bonus, n_live=features.shape[0],
         )[4]
     )
 

@@ -16,7 +16,12 @@ from rca_tpu.engine.train import (
     train,
 )
 
-CFG = TrainConfig(n_services=64, n_cases=16, iters=40, lr=0.05, seed=1)
+# train on the hard modes — the defaults already near-ace "standard", so
+# that regime has no loss headroom for the 10% improvement assertion
+CFG = TrainConfig(
+    n_services=64, n_cases=16, iters=60, lr=0.05, seed=1,
+    modes=("adversarial", "crashing_victims"),
+)
 
 
 @pytest.fixture(scope="module")
