@@ -109,6 +109,37 @@ def main(skip_accuracy: bool = False) -> int:
         reps.append((time.perf_counter() - t0) * 1e3)
     batch_ms = float(np.median(reps))
 
+    # -- streaming: 10k-service 1 Hz session (BASELINE.md row 4).  Device-
+    # resident feature buffer; each tick flushes ~1% of services as a
+    # donated-argument row scatter then reruns the cached executable.
+    from rca_tpu.engine.streaming import StreamingSession
+
+    sk = synthetic_cascade_arrays(10_000, n_roots=3, seed=1)
+    sess = StreamingSession(
+        [f"svc-{i:05d}" for i in range(sk.n)], sk.dep_src, sk.dep_dst,
+        num_features=sk.features.shape[1], k=5,
+    )
+    sess.set_all(sk.features)
+    sess.tick()  # warm the propagation executable
+    # warm the 128-row scatter tier too, so no measured tick pays a compile
+    sess.update_many({i: sk.features[i] for i in range(100)})
+    sess.tick()
+    srng = np.random.default_rng(2)
+    tick_times = []
+    for _ in range(20):
+        rows = {
+            int(i): np.clip(
+                sk.features[i]
+                + srng.uniform(-0.05, 0.05, sk.features.shape[1]), 0, 1
+            ).astype(np.float32)
+            for i in srng.integers(0, sk.n, 100)
+        }
+        sess.update_many(rows)
+        out = sess.tick()
+        tick_times.append(out["latency_ms"])
+    tick_ms_10k = float(np.median(tick_times))
+    tick_upload_rows = int(out["upload_rows"])
+
     # -- accuracy under adversarial cascade modes (VERDICT round-1 item 3):
     # (skippable with --skip-accuracy when only the latency numbers are
     # wanted — this block trains a model and runs ~270 extra analyses)
@@ -172,6 +203,8 @@ def main(skip_accuracy: bool = False) -> int:
         "latency_50k_amortized_ms": round(big_ms, 4),
         "top1_hit_50k": bool(big_top1),
         "batch16_2k_dispatch_ms": round(batch_ms, 3),
+        "tick_ms_10k": round(tick_ms_10k, 3),
+        "tick_upload_rows_10k": tick_upload_rows,
         "backend": "jax",
     }
     if accuracy is not None:

@@ -1,23 +1,38 @@
 """Streaming analysis session: repeated ticks over a fixed service graph.
 
 The BASELINE.md 10k-service streaming config ticks metrics at 1 Hz.  A
-:class:`StreamingSession` pins the padded edge arrays (and weights) on the
-device once; each tick uploads only the feature matrix and runs the cached
-executable — no per-tick graph rebuild, no edge re-upload, no recompile
-(shapes are fixed at session construction).  Feature deltas can be applied
-host-side via :meth:`update` so a tick touches only changed services.
+:class:`StreamingSession` pins the padded edge arrays, the weights, AND the
+feature matrix on the device for the whole session; between ticks only the
+changed rows travel host→device, applied with a donated-argument scatter so
+XLA updates the resident buffer in place (SURVEY.md §7 "donate-argument
+in-place updates to avoid host↔device churn" — round 1 re-uploaded the full
+[S, C] matrix every tick).
+
+Per-tick transfer is therefore proportional to the delta count: U changed
+services upload one [U] int32 index vector and one [U, C] float32 row block
+(U padded to a small power of two so the scatter executable is reused), not
+the [S_pad, C] matrix.
 """
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from rca_tpu.config import RCAConfig, bucket_for
 from rca_tpu.engine.runner import GraphEngine, _propagate_ranked
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _apply_rows(features, idx, rows):
+    """Scatter changed rows into the DONATED device-resident feature buffer;
+    XLA reuses the buffer in place instead of materializing a copy."""
+    return features.at[idx].set(rows)
 
 
 class StreamingSession:
@@ -36,41 +51,73 @@ class StreamingSession:
         n = len(self.names)
         cfg = self.engine.config
         self._n = n
+        self._n_live = jnp.asarray(n, jnp.int32)
         self._n_pad = bucket_for(n + 1, cfg.shape_buckets)
+        self._num_features = num_features
         e_pad = bucket_for(max(len(dep_src), 1), cfg.shape_buckets)
         dummy = self._n_pad - 1
         s = np.full(e_pad, dummy, np.int32)
         d = np.full(e_pad, dummy, np.int32)
         s[: len(dep_src)] = dep_src
         d[: len(dep_dst)] = dep_dst
-        # edges + weights live on device for the whole session
+        # edges + weights + FEATURES live on device for the whole session
         self._edges = jnp.asarray(np.stack([s, d]))
-        self._features = np.zeros((self._n_pad, num_features), np.float32)
+        self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
+        # pending row updates, keyed by service index (last write wins, so
+        # the scatter never carries duplicate indices)
+        self._pending: Dict[int, np.ndarray] = {}
         self._kk = min(k + 8, self._n_pad)
         self.ticks = 0
+        self.last_upload_rows = 0  # padded rows uploaded by the last flush
 
     # -- host-side incremental state --------------------------------------
     def update(self, service_index: int, features: np.ndarray) -> None:
         """Replace one service's feature row (delta update between ticks)."""
-        self._features[service_index] = features
+        # copy: callers may reuse one scratch buffer across update() calls
+        self._pending[int(service_index)] = np.array(features, np.float32)
 
     def update_many(self, rows: Dict[int, np.ndarray]) -> None:
         for i, f in rows.items():
-            self._features[i] = f
+            self.update(i, f)
 
     def set_all(self, features: np.ndarray) -> None:
-        self._features[: len(features)] = features
+        """Full re-upload (session start or resync) — the one bulk path."""
+        f = np.zeros((self._n_pad, self._num_features), np.float32)
+        f[: len(features)] = features
+        self._features = jnp.asarray(f)
+        self._pending.clear()
+
+    # -- device-side delta flush -------------------------------------------
+    def _flush(self) -> None:
+        if not self._pending:
+            self.last_upload_rows = 0
+            return
+        u = len(self._pending)
+        # pad the delta block to a power of two: one scatter executable per
+        # tier, padded lanes write zeros onto the zero dummy row
+        u_pad = 1 << max(0, (u - 1).bit_length())
+        idx = np.full(u_pad, self._n_pad - 1, np.int32)
+        rows = np.zeros((u_pad, self._num_features), np.float32)
+        for j, (i, f) in enumerate(self._pending.items()):
+            idx[j] = i
+            rows[j] = f
+        self._features = _apply_rows(
+            self._features, jnp.asarray(idx), jnp.asarray(rows)
+        )
+        self.last_upload_rows = u_pad
+        self._pending.clear()
 
     # -- tick ---------------------------------------------------------------
     def tick(self) -> Dict[str, object]:
         """One inference pass; returns ranked root causes + tick latency."""
         p = self.engine.params
         t0 = time.perf_counter()
+        self._flush()
         stacked, vals, idx = _propagate_ranked(
-            jnp.asarray(self._features), self._edges,
+            self._features, self._edges,
             self.engine._aw, self.engine._hw,
             p.steps, p.decay, p.explain_strength, p.impact_bonus, self._kk,
-            False, jnp.asarray(self._n, jnp.int32),
+            False, self._n_live,
         )
         idx.block_until_ready()
         latency_ms = (time.perf_counter() - t0) * 1e3
@@ -85,4 +132,4 @@ class StreamingSession:
             )
         self.ticks += 1
         return {"ranked": ranked, "latency_ms": latency_ms,
-                "tick": self.ticks}
+                "tick": self.ticks, "upload_rows": self.last_upload_rows}

@@ -42,6 +42,51 @@ def test_streaming_session_tracks_fault_changes():
     }
 
 
+def test_streaming_delta_uploads_proportional_and_exact():
+    """SURVEY §7 / BASELINE row 4: per-tick upload is proportional to the
+    delta count (padded-pow2 rows, not the [S, C] matrix), a quiet tick
+    uploads nothing, and the delta path lands on exactly the state a full
+    re-upload would."""
+    case = synthetic_cascade_arrays(1000, n_roots=1, seed=3)
+    sess = StreamingSession(
+        case.names, case.dep_src, case.dep_dst,
+        num_features=case.features.shape[1], k=3,
+    )
+    sess.set_all(case.features)
+    first = sess.tick()
+    assert first["upload_rows"] == 0  # set_all is the bulk path, not a delta
+
+    # quiet tick: no host->device rows at all
+    assert sess.tick()["upload_rows"] == 0
+
+    # 10 changed services -> 16 padded rows, NOT 1000
+    changed = {(case.roots[0] + 31 * j) % case.n: np.full(
+        case.features.shape[1], 0.5, np.float32
+    ) for j in range(10)}
+    sess.update_many(changed)
+    out = sess.tick()
+    assert out["upload_rows"] == 16
+
+    # exactness: a fresh session fed the same final state ranks identically
+    full = case.features.copy()
+    for i, row in changed.items():
+        full[i] = row
+    ref = StreamingSession(
+        case.names, case.dep_src, case.dep_dst,
+        num_features=case.features.shape[1], k=3,
+    )
+    ref.set_all(full)
+    expected = ref.tick()
+    assert [r["component"] for r in out["ranked"]] == [
+        r["component"] for r in expected["ranked"]
+    ]
+    np.testing.assert_allclose(
+        [r["score"] for r in out["ranked"]],
+        [r["score"] for r in expected["ranked"]],
+        rtol=1e-6,
+    )
+
+
 def test_stage_timer_report():
     t = StageTimer()
     with t.stage("a"):
