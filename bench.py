@@ -56,34 +56,35 @@ def main(skip_accuracy: bool = False) -> int:
         explain_strength=p.explain_strength, impact_bonus=p.impact_bonus,
     )
 
-    def amortized_ms(features, src, dst, reps_in_jit=10, outer=5):
-        n_live = features.shape[0]
-        f, s, d = engine._pad(features, src, dst)
-        fj, sj, dj = jnp.asarray(f), jnp.asarray(s), jnp.asarray(d)
-
-        @jax.jit
-        def many(f, s, d):
-            def body(i, acc):
-                # scale features per rep so XLA cannot hoist the body
-                score = prop(f * (1.0 + i * 1e-7), s, d, n_live=n_live)[4]
-                return acc + score
-            return jax.lax.fori_loop(
-                0, reps_in_jit, body, jnp.zeros(f.shape[0])
-            )
-
-        many(fj, sj, dj).block_until_ready()
+    def amort_min_ms(many, args, reps_in_jit, outer=5):
+        """Shared amortized-timing scaffold: warm once, min over ``outer``
+        dispatches of a jitted ``reps_in_jit``-rep loop (min across reps:
+        transient device contention only inflates)."""
+        many(*args).block_until_ready()
         outs = []
         for _ in range(outer):
             t0 = time.perf_counter()
-            many(fj, sj, dj).block_until_ready()
+            many(*args).block_until_ready()
             outs.append((time.perf_counter() - t0) * 1e3)
-        # min across reps: transient device contention only inflates
         return float(np.min(outs)) / reps_in_jit
 
     big = synthetic_cascade_arrays(50000, n_roots=5, seed=0)
     rb = engine.analyze_arrays(big.features, big.dep_src, big.dep_dst, k=5)
     big_top1 = int(np.argmax(rb.score)) in set(big.roots.tolist())
-    big_ms = amortized_ms(big.features, big.dep_src, big.dep_dst)
+
+    big_n = big.features.shape[0]
+    bf, bs, bd = engine._pad(big.features, big.dep_src, big.dep_dst)
+    bfj, bsj, bdj = jnp.asarray(bf), jnp.asarray(bs), jnp.asarray(bd)
+
+    @jax.jit
+    def many_prop(f, s, d):
+        def body(i, acc):
+            # scale features per rep so XLA cannot hoist the body
+            score = prop(f * (1.0 + i * 1e-7), s, d, n_live=big_n)[4]
+            return acc + score
+        return jax.lax.fori_loop(0, 10, body, jnp.zeros(f.shape[0]))
+
+    big_ms = amort_min_ms(many_prop, (bfj, bsj, bdj), reps_in_jit=10)
 
     # batched multi-hypothesis scoring (BASELINE.md 10k streaming row):
     # 16 perturbed feature sets over the 2k graph, one vmapped executable
@@ -108,6 +109,33 @@ def main(skip_accuracy: bool = False) -> int:
         batched(fb, sj, dj).block_until_ready()
         reps.append((time.perf_counter() - t0) * 1e3)
     batch_ms = float(np.median(reps))
+
+    # -- Pallas proof (VERDICT round-1 item 6): record whether the fused
+    # noisy-OR kernel compiles on THIS backend and its amortized timing vs
+    # the XLA expression at 50k scale.  (Measured wash on v5e — see
+    # rca_tpu/engine/pallas_kernels.py docstring — hence opt-in.)
+    from rca_tpu.engine.pallas_kernels import (
+        noisy_or_pair_pallas,
+        noisy_or_pair_xla,
+        pallas_enabled,
+        pallas_supported,
+    )
+
+    pallas_ok = pallas_supported()
+    aw_j, hw_j = jnp.asarray(aw), jnp.asarray(hw)
+    ft = bfj.T  # kernel reads channel-major; bfj is the padded 50k matrix
+
+    def nor_amort(fn, arg):
+        @jax.jit
+        def many(x):
+            def body(i, acc):
+                a, h = fn(x * (1.0 + i * 1e-9), aw_j, hw_j)
+                return acc + a + h
+            return jax.lax.fori_loop(0, 50, body, jnp.zeros(bfj.shape[0]))
+        return amort_min_ms(many, (arg,), reps_in_jit=50)
+
+    xla_nor_ms = nor_amort(noisy_or_pair_xla, bfj)
+    pallas_nor_ms = nor_amort(noisy_or_pair_pallas, ft) if pallas_ok else None
 
     # -- streaming: 10k-service 1 Hz session (BASELINE.md row 4).  Device-
     # resident feature buffer; each tick flushes ~1% of services as a
@@ -205,6 +233,12 @@ def main(skip_accuracy: bool = False) -> int:
         "batch16_2k_dispatch_ms": round(batch_ms, 3),
         "tick_ms_10k": round(tick_ms_10k, 3),
         "tick_upload_rows_10k": tick_upload_rows,
+        "pallas_supported": bool(pallas_ok),
+        "pallas_engaged": bool(pallas_enabled()),  # reflects RCA_PALLAS env
+        "xla_noisyor_50k_ms": round(xla_nor_ms, 4),
+        "pallas_noisyor_50k_ms": (
+            round(pallas_nor_ms, 4) if pallas_nor_ms is not None else None
+        ),
         "backend": "jax",
     }
     if accuracy is not None:

@@ -189,15 +189,16 @@ class GraphEngine:
             ej = jnp.asarray(np.stack([s, d]))  # one [2, E] upload
             from rca_tpu.engine.pallas_kernels import (
                 BLOCK_S,
-                pallas_supported,
+                pallas_enabled,
             )
 
-            # kernel grid needs the node pad to divide into blocks (true
-            # for every power-of-two shape bucket; off-bucket giant graphs
-            # fall back to the XLA expression)
+            # Pallas evidence pass is explicit opt-in (RCA_PALLAS=1): it
+            # measures as a wash vs XLA on real TPU (pallas_kernels
+            # docstring).  Kernel grid also needs the node pad to divide
+            # into blocks (true for every power-of-two shape bucket).
             use_pallas = (
                 f.shape[0] % min(f.shape[0], BLOCK_S) == 0
-                and pallas_supported()
+                and pallas_enabled()
             )
 
             def run():

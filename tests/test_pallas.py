@@ -50,3 +50,15 @@ def test_env_flag_disables(monkeypatch):
 
     monkeypatch.setenv("RCA_PALLAS", "0")
     assert pk.pallas_supported() is False
+
+
+def test_engine_routing_is_opt_in(monkeypatch):
+    """The kernel measures as a wash vs XLA on real TPU, so the engine only
+    routes through it under RCA_PALLAS=1 (capability stays probed/tested)."""
+    import rca_tpu.engine.pallas_kernels as pk
+
+    monkeypatch.setenv("RCA_PALLAS", "auto")
+    assert pk.pallas_enabled() is False
+    monkeypatch.setenv("RCA_PALLAS", "1")
+    monkeypatch.setattr(pk, "_SUPPORTED", True)
+    assert pk.pallas_enabled() is True
