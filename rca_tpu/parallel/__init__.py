@@ -9,6 +9,18 @@ run.
 """
 
 from rca_tpu.parallel.mesh import make_mesh, make_multislice_mesh
-from rca_tpu.parallel.sharded import ShardedGraph, shard_graph, sharded_propagate
+from rca_tpu.parallel.sharded import (
+    ShardedGraph,
+    shard_graph,
+    sharded_propagate,
+    sharded_topk,
+)
 
-__all__ = ["make_mesh", "make_multislice_mesh", "ShardedGraph", "shard_graph", "sharded_propagate"]
+__all__ = [
+    "make_mesh",
+    "make_multislice_mesh",
+    "ShardedGraph",
+    "shard_graph",
+    "sharded_propagate",
+    "sharded_topk",
+]

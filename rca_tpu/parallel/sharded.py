@@ -158,6 +158,59 @@ def _jitted_shard_fn(
     return jax.jit(shard_fn)
 
 
+@functools.lru_cache(maxsize=32)
+def _jitted_topk_fn(mesh: Mesh, k: int, batch_axes: tuple = ("dp",)):
+    """Distributed top-k: each node shard reduces its block to k local
+    candidates, the k·sp candidate set rides ONE small all_gather over the
+    'sp' axis (ICI), and every device merges on-device — the full [S]
+    score vector never leaves its shard and nothing is argmaxed on host."""
+
+    def per_device(s_blk):
+        # s_blk: [B/dp, block] — this shard's slice of the score vector
+        block = s_blk.shape[1]
+        sp = mesh.shape["sp"]
+        if k > block * sp:
+            raise ValueError(
+                f"sharded_topk: k={k} exceeds the sharded vector length "
+                f"{block * sp} (block {block} x sp {sp})"
+            )
+        # a shard can contribute at most `block` candidates; sp*k_local
+        # candidates still cover any global top-k with k <= block*sp
+        k_local = min(k, block)
+        v, i = jax.lax.top_k(s_blk, k_local)
+        gi = i + jax.lax.axis_index("sp") * block
+        # [B/dp, sp*k_local] candidate values/indices on every device
+        vg = jax.lax.all_gather(v, "sp", axis=1, tiled=True)
+        ig = jax.lax.all_gather(gi, "sp", axis=1, tiled=True)
+        vv, pos = jax.lax.top_k(vg, k)
+        return vv, jnp.take_along_axis(ig, pos, axis=1)
+
+    batch_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
+    shard_fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(batch_spec, "sp"),),
+        # merged results are replicated across 'sp' (every shard holds the
+        # same k winners after the gather+merge)
+        out_specs=(P(batch_spec, None), P(batch_spec, None)),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
+def sharded_topk(
+    mesh: Mesh,
+    scores: jax.Array,           # [B, n_pad] as returned by sharded_propagate
+    k: int,
+    batch_axes: Tuple[str, ...] = ("dp",),
+):
+    """On-device cross-shard top-k merge; returns (values [B, k],
+    global indices [B, k])."""
+    fn = _jitted_topk_fn(mesh, k, tuple(batch_axes))
+    with mesh:
+        return fn(scores)
+
+
 def sharded_propagate(
     mesh: Mesh,
     features_batch: np.ndarray,  # [B, n_pad, C] hypothesis batch, same graph
