@@ -38,8 +38,12 @@ class MockClusterClient:
             "name": self.world.cluster_name,
             "nodes": len(self.world.nodes),
             "namespaces": self.world.namespaces(),
+            "errors": [],
             "mock": True,
         }
+
+    def collect_errors(self, clear: bool = True) -> List[Dict[str, str]]:
+        return []  # in-memory world: fetches cannot fail
 
     def get_namespaces(self) -> List[str]:
         return self.world.namespaces()

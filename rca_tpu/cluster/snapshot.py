@@ -39,6 +39,9 @@ class ClusterSnapshot:
     # pod name -> {container -> log text} (tail-limited at capture time)
     logs: Dict[str, Dict[str, str]]
     traces: Dict[str, Any]
+    # fetch failures swallowed during capture ([{"op", "error"}]): non-empty
+    # means this snapshot is PARTIAL and every consumer should say so
+    errors: List[Dict[str, str]] = dataclasses.field(default_factory=list)
 
     @classmethod
     def capture(
@@ -56,6 +59,9 @@ class ClusterSnapshot:
         only the first 5 pods' logs (reference: mcp_coordinator.py:396-409)
         and could miss the faulty pod entirely.
         """
+        # drain stale errors so this snapshot reports only ITS failures
+        if hasattr(client, "collect_errors"):
+            client.collect_errors()
         pods = client.get_pods(namespace)
         logs: Dict[str, Dict[str, str]] = {}
         pods_for_logs = _prioritize_pods_for_logs(pods, max_log_pods)
@@ -108,6 +114,10 @@ class ClusterSnapshot:
             events=client.get_events(namespace),
             logs=logs,
             traces=traces,
+            errors=(
+                client.collect_errors()
+                if hasattr(client, "collect_errors") else []
+            ),
         )
 
     # convenience lookups -------------------------------------------------

@@ -36,6 +36,14 @@ def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
 
+def _degraded_note(errors: List[Dict[str, str]]) -> str:
+    ops = sorted({e.get("op", "?") for e in errors})
+    return (
+        f"⚠ analysis ran against PARTIAL cluster state — "
+        f"{len(errors)} fetch failure(s) ({', '.join(ops[:5])})"
+    )
+
+
 class RCACoordinator:
     def __init__(
         self,
@@ -122,7 +130,12 @@ class RCACoordinator:
             ctx = ctx or self.capture(namespace)
             if analysis_type == "comprehensive":
                 record["results"] = self._run_comprehensive(ctx)
-                record["summary"] = record["results"]["correlated"]["summary"]
+                # the cross-agent summary carries the degraded-state note;
+                # fall back to the fusion one-liner
+                record["summary"] = (
+                    record["results"].get("summary")
+                    or record["results"]["correlated"]["summary"]
+                )
             elif analysis_type in ALL_AGENT_TYPES:
                 res = self._agent_for(analysis_type).analyze(ctx)
                 record["results"][analysis_type] = res.to_dict()
@@ -130,6 +143,15 @@ class RCACoordinator:
             else:
                 raise ValueError(f"unknown analysis type: {analysis_type}")
             record["status"] = "completed"
+            # degraded-mode honesty for EVERY analysis type: a snapshot
+            # captured through fetch failures is PARTIAL — say so instead
+            # of letting an RBAC error read as "no issues detected"
+            if ctx.snapshot.errors:
+                note = _degraded_note(ctx.snapshot.errors)
+                record["results"]["degraded"] = {
+                    "errors": ctx.snapshot.errors, "note": note,
+                }
+                record["summary"] = f"{note}. {record['summary']}"
         except Exception as e:
             record["status"] = "failed"
             record["error"] = f"{type(e).__name__}: {e}"

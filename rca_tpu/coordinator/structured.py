@@ -93,7 +93,7 @@ def cluster_state_counts(ctx: AnalysisContext) -> Dict[str, Any]:
         for e in ctx.snapshot.events
         if e.get("type") != "Normal"
     )
-    return {
+    state = {
         "namespace": ctx.snapshot.namespace,
         "total_pods": P,
         "pods_by_phase": {k: v for k, v in phases.items() if v},
@@ -103,6 +103,13 @@ def cluster_state_counts(ctx: AnalysisContext) -> Dict[str, Any]:
         "warning_event_count": warning_events,
         "services": fs.service_names,
     }
+    if ctx.snapshot.errors:
+        # partial snapshot: keep the chat turn honest about what's missing —
+        # presence + op names, not the full dump (the client buffer caps at
+        # 100x300-char entries, far too much to embed in every LLM prompt)
+        state["fetch_errors"] = ctx.snapshot.errors[-10:]
+        state["fetch_error_count"] = len(ctx.snapshot.errors)
+    return state
 
 
 def format_structured_response(

@@ -98,9 +98,71 @@ def cluster_toolsets(client, namespace: str) -> Dict[str, List[ToolSpec]]:
             ),
         )
 
+    def deployment_resource_usage(deployment: str = ""):
+        """Deployment-level usage: join deployment → pod metrics by pod-name
+        prefix and aggregate (reference: mcp_metrics_agent.py:35-114 declares
+        the tool, :201-204 joins by name substring — here the join actually
+        executes and averages usage_percentage across the pods)."""
+        pod_mets = (client.get_pod_metrics(ns) or {}).get("pods", {})
+
+        def avg(vals):
+            vals = [v for v in vals if isinstance(v, (int, float))]
+            return round(sum(vals) / len(vals), 2) if vals else None
+
+        deployments = client.get_deployments(ns)
+        all_names = [
+            d.get("metadata", {}).get("name", "") for d in deployments
+        ]
+
+        def owner_of(pod_name: str):
+            """Longest deployment-name prefix wins, so pods of
+            'backend-worker' never count toward 'backend'."""
+            best = None
+            for n in all_names:
+                if pod_name == n or pod_name.startswith(n + "-"):
+                    if best is None or len(n) > len(best):
+                        best = n
+            return best
+
+        out = []
+        for dep in deployments:
+            name = dep.get("metadata", {}).get("name", "")
+            if deployment and name != deployment:
+                continue
+            pods = {
+                p: m for p, m in pod_mets.items() if owner_of(p) == name
+            }
+            status = dep.get("status", {}) or {}
+            out.append({
+                "deployment": name,
+                "replicas_desired": (dep.get("spec", {}) or {}).get("replicas"),
+                "replicas_ready": status.get("readyReplicas", 0),
+                "pods_with_metrics": len(pods),
+                "cpu_usage_percentage_avg": avg(
+                    (m.get("cpu", {}) or {}).get("usage_percentage")
+                    for m in pods.values()
+                ),
+                "memory_usage_percentage_avg": avg(
+                    (m.get("memory", {}) or {}).get("usage_percentage")
+                    for m in pods.values()
+                ),
+                "per_pod": {
+                    p: {
+                        "cpu": (m.get("cpu", {}) or {}).get("usage"),
+                        "memory": (m.get("memory", {}) or {}).get("usage"),
+                    }
+                    for p, m in pods.items()
+                },
+            })
+        return out
+
     metrics = [
         ToolSpec("get_pod_metrics", "CPU/memory usage per pod in the namespace",
                  _obj({}), lambda: client.get_pod_metrics(ns)),
+        ToolSpec("get_deployment_resource_usage",
+                 "Aggregated CPU/memory usage per deployment (joins pod "
+                 "metrics onto deployments; optionally one deployment)",
+                 _obj({"deployment": _STR}), deployment_resource_usage),
         ToolSpec("get_node_metrics", "CPU/memory usage per cluster node",
                  _obj({}), client.get_node_metrics),
         ToolSpec("get_hpas", "HorizontalPodAutoscaler specs and status",

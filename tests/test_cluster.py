@@ -156,6 +156,77 @@ def test_generator_world_consistency():
     assert "BackOff" in reasons
 
 
+def test_degraded_client_yields_degraded_report():
+    """VERDICT round-1 item 8: an RBAC-denied / failing fetch must surface
+    as a PARTIAL-state analysis, not a clean bill of health."""
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+    from rca_tpu.coordinator import RCACoordinator
+
+    class RBACDeniedClient(MockClusterClient):
+        """Events fetch is denied; failures land in the error channel."""
+
+        def __init__(self, world):
+            super().__init__(world)
+            self._errs = []
+
+        def get_events(self, namespace, field_selector=None):
+            self._errs.append({
+                "op": "list_namespaced_event",
+                "error": "ApiException: (403) Forbidden: events is forbidden",
+            })
+            return []
+
+        def collect_errors(self, clear=True):
+            out = list(self._errs)
+            if clear:
+                self._errs.clear()
+            return out
+
+    client = RBACDeniedClient(five_service_world())
+    snap = ClusterSnapshot.capture(client, NS)
+    assert snap.errors  # the denial is recorded on the snapshot
+    assert any("Forbidden" in e["error"] for e in snap.errors)
+
+    coord = RCACoordinator(client)
+    rec = coord.run_analysis("comprehensive", NS)
+    assert rec["status"] == "completed"
+    degraded = rec["results"]["degraded"]
+    assert any("Forbidden" in e["error"] for e in degraded["errors"])
+    assert "PARTIAL cluster state" in rec["summary"]
+    # chat turns carry the fetch errors in the exact-counts state too
+    out = coord.process_user_query("how are my pods?", NS)
+    assert out["cluster_state"]["fetch_errors"]
+
+    # a healthy client stays clean: no degraded key, no note
+    healthy = RCACoordinator(MockClusterClient(five_service_world()))
+    rec2 = healthy.run_analysis("comprehensive", NS)
+    assert "degraded" not in rec2["results"]
+    assert "PARTIAL" not in rec2["summary"]
+
+
+def test_deployment_resource_usage_join():
+    """Deployment → pod metrics join tool (the reference declared it but
+    only the mock could serve it; reference: mcp_metrics_agent.py:201-204)."""
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.llm import cluster_toolsets
+
+    client = MockClusterClient(five_service_world())
+    tools = {t.name: t for t in cluster_toolsets(client, NS)["metrics"]}
+    spec = tools["get_deployment_resource_usage"]
+    rows = spec.fn()
+    assert rows
+    by_name = {r["deployment"]: r for r in rows}
+    assert "backend" in by_name
+    b = by_name["backend"]
+    assert b["pods_with_metrics"] >= 1
+    assert b["cpu_usage_percentage_avg"] is not None
+    assert b["per_pod"]
+    # single-deployment filter
+    only = spec.fn(deployment="backend")
+    assert len(only) == 1 and only[0]["deployment"] == "backend"
+
+
 def test_quantity_parsers():
     assert parse_cpu("100m") == 100.0
     assert parse_cpu("2") == 2000.0
