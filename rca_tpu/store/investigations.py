@@ -196,6 +196,13 @@ class InvestigationStore:
             investigation_id, lambda inv: inv.__setitem__("summary", summary)
         )
 
+    def set_title(
+        self, investigation_id: str, title: str
+    ) -> Optional[Dict[str, Any]]:
+        return self._update(
+            investigation_id, lambda inv: inv.__setitem__("title", title)
+        )
+
     def update_status(
         self, investigation_id: str, status: str
     ) -> Optional[Dict[str, Any]]:

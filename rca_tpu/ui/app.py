@@ -13,6 +13,10 @@ from __future__ import annotations
 import os
 
 from rca_tpu.ui.render import (
+    analysis_chart_series,
+    analysis_viz_data,
+    correlated_markdown,
+    finding_markdown,
     initial_suggestions,
     report_markdown,
     response_markdown,
@@ -59,6 +63,13 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
         st.session_state.services = _build_services()
     client, coord, store = st.session_state.services
 
+    # deep link: restore the investigation named in the URL
+    # (?investigation=<id>, reference: app.py:88-105)
+    url_inv = st.query_params.get("investigation")
+    if url_inv and st.session_state.get("investigation_id") != url_inv:
+        if store.get_investigation(url_inv):
+            st.session_state.investigation_id = url_inv
+
     # ---- sidebar: investigations + connection (reference: sidebar.py) ----
     with st.sidebar:
         st.title("Investigations")
@@ -74,6 +85,7 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                 "New investigation", namespace=namespace
             )
             st.session_state.investigation_id = inv["id"]
+            st.query_params["investigation"] = inv["id"]
             st.session_state.pop("suggestions", None)
             st.rerun()
         for row in store.list_investigations()[:15]:
@@ -82,6 +94,7 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                 key=f"inv-{row['id']}",
             ):
                 st.session_state.investigation_id = row["id"]
+                st.query_params["investigation"] = row["id"]
                 st.rerun()
 
     inv_id = st.session_state.get("investigation_id")
@@ -114,9 +127,10 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
         )
         cols = st.columns(min(len(suggestions), 5) or 1)
         clicked = None
-        for col, sugg in zip(cols, suggestions):
+        for i, (col, sugg) in enumerate(zip(cols, suggestions)):
             with col:
-                if st.button(sugg["text"], key=f"sugg-{sugg['text'][:30]}"):
+                # index-keyed: suggestion texts can repeat across turns
+                if st.button(sugg["text"], key=f"sugg-{i}"):
                     clicked = sugg
 
         query = st.chat_input("Ask about the cluster…")
@@ -146,12 +160,10 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
             store.add_accumulated_findings(inv_id, out["key_findings"])
             if len(investigation.get("conversation", [])) == 0:
                 title = coord.generate_summary_from_query(query, out)
-                store._update(
-                    inv_id, lambda inv: inv.__setitem__("title", title)
-                )
+                store.set_title(inv_id, title)
             st.rerun()
 
-    # ---- report tab (reference: report.py) -------------------------------
+    # ---- report tab (reference: report.py:57-196 tabbed report) ----------
     with tab_report:
         if st.button("Run comprehensive analysis"):
             with st.spinner("Analyzing (TPU fusion)…"):
@@ -160,9 +172,38 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
             store.add_agent_findings(inv_id, "comprehensive", record)
         results = st.session_state.get("last_results")
         if results:
-            st.markdown(root_causes_markdown(results.get("correlated", {})))
-            with st.expander("Full report"):
-                st.markdown(report_markdown(results))
+            if results.get("degraded"):
+                st.warning(results["degraded"]["note"])
+            agent_types = [
+                a for a in ("resources", "metrics", "logs", "events",
+                            "topology", "traces")
+                if isinstance(results.get(a), dict)
+            ]
+            sub = st.tabs(["Root Causes", "Correlated"] + agent_types)
+            with sub[0]:
+                st.markdown(
+                    root_causes_markdown(results.get("correlated", {}))
+                )
+                with st.expander("Full report"):
+                    st.markdown(report_markdown(results))
+            with sub[1]:
+                st.markdown(correlated_markdown(results.get("correlated", {})))
+            for tab, agent in zip(sub[2:], agent_types):
+                with tab:
+                    res = results[agent]
+                    st.markdown(res.get("summary", ""))
+                    viz = analysis_viz_data(agent, res)
+                    for chart in analysis_chart_series(viz):
+                        st.caption(chart["title"])
+                        if chart["kind"] == "bar":
+                            st.bar_chart(chart["data"])
+                        else:
+                            st.dataframe(chart["data"])
+                    if agent == "topology" and viz.get("graph"):
+                        st.caption("Dependency graph")
+                        st.json(topology_plot_data(viz["graph"]))
+                    for f in res.get("findings", [])[:12]:
+                        st.markdown(finding_markdown(f))
 
     # ---- topology tab (reference: visualization.py) ----------------------
     with tab_topology:

@@ -166,6 +166,88 @@ def analysis_viz_data(agent_type: str, result: Dict[str, Any]) -> Dict[str, Any]
     return out
 
 
+def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Renderer-agnostic chart specs for one agent's viz payload
+    (reference renders per-type Plotly views, components/visualization.py
+    :8-764).  Each spec is ``{"title", "kind": "bar"|"table", "data"}`` —
+    ``bar`` data is {label: value}, ``table`` data is a list of row dicts —
+    so the Streamlit layer can draw st.bar_chart/st.dataframe without any
+    plotly dependency."""
+    charts: List[Dict[str, Any]] = []
+    sev = viz.get("severity_histogram") or {}
+    if sev:
+        order = ["critical", "high", "medium", "low", "info"]
+        charts.append({
+            "title": "Findings by severity", "kind": "bar",
+            "data": {s: sev[s] for s in order if s in sev},
+        })
+    agent = viz.get("agent_type", "")
+    if agent == "metrics" and viz.get("utilization"):
+        charts.append({
+            "title": "Utilization (% of limit)", "kind": "bar",
+            "data": {
+                row["component"]: row.get("usage_percentage", 0)
+                for row in viz["utilization"]
+            },
+        })
+    elif agent == "logs" and viz.get("pattern_counts"):
+        charts.append({
+            "title": "Log error classes", "kind": "bar",
+            "data": dict(viz["pattern_counts"]),
+        })
+    elif agent == "resources" and viz.get("pod_buckets"):
+        charts.append({
+            "title": "Pod status buckets", "kind": "bar",
+            "data": {k: v for k, v in viz["pod_buckets"].items() if v},
+        })
+    elif agent == "traces" and viz.get("error_rates"):
+        charts.append({
+            "title": "Error rate per service", "kind": "bar",
+            "data": {
+                row["component"]: row["error_rate"]
+                for row in viz["error_rates"]
+            },
+        })
+    elif agent == "topology" and viz.get("service_pod_mapping"):
+        charts.append({
+            "title": "Service → pod mapping", "kind": "table",
+            "data": [
+                {"service": svc, **(
+                    info if isinstance(info, dict) else {"pods": info}
+                )}
+                for svc, info in viz["service_pod_mapping"].items()
+            ],
+        })
+    return charts
+
+
+def correlated_markdown(correlated: Dict[str, Any]) -> str:
+    """Correlated-findings tab body: grouped findings per component
+    (reference: components/report.py Correlated tab)."""
+    groups = correlated.get("groups", {})
+    if not groups:
+        return "_No correlated findings._"
+    lines = [f"**{len(groups)} component(s) with findings**", ""]
+    ranked_order = [r["component"] for r in correlated.get("root_causes", [])]
+    rest = [c for c in groups if c not in ranked_order]
+    for comp in ranked_order + sorted(rest):
+        if comp not in groups:
+            continue
+        findings = groups[comp]
+        worst = max(
+            (str(f.get("severity", "info")) for f in findings),
+            key=lambda s: ["info", "low", "medium", "high",
+                           "critical"].index(s)
+            if s in ("info", "low", "medium", "high", "critical") else 0,
+        )
+        icon = SEVERITY_ICONS.get(worst, "⚪")
+        lines.append(
+            f"- {icon} **{comp}** — {len(findings)} finding(s) from "
+            f"{', '.join(sorted({str(f.get('source', '')) for f in findings}))}"
+        )
+    return "\n".join(lines)
+
+
 def wizard_stage_markdown(session: Dict[str, Any]) -> str:
     """Progress header for the 4-stage guided wizard (reference:
     components/interactive_session.py:107-114 stages)."""

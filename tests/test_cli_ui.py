@@ -161,3 +161,84 @@ def test_topology_plot_data_layout():
 
 def test_ui_app_importable_without_streamlit():
     import rca_tpu.ui.app  # noqa: F401
+
+
+def test_analysis_chart_series_per_agent():
+    """Every agent's viz payload yields renderable chart specs — the UI
+    renders these with st.bar_chart/st.dataframe per agent tab (reference:
+    components/visualization.py per-type renderers)."""
+    from rca_tpu.ui.render import analysis_chart_series, analysis_viz_data
+
+    logs_result = {
+        "findings": [
+            {"component": "Pod/x", "severity": "high",
+             "evidence": {"pattern": "oom_kill", "count": 3}},
+        ],
+    }
+    charts = analysis_chart_series(analysis_viz_data("logs", logs_result))
+    titles = {c["title"] for c in charts}
+    assert "Findings by severity" in titles
+    assert "Log error classes" in titles
+    by_title = {c["title"]: c for c in charts}
+    assert by_title["Log error classes"]["data"] == {"oom_kill": 3}
+
+    metrics_result = {
+        "findings": [
+            {"component": "Pod/y", "severity": "medium",
+             "evidence": {"usage_percentage": 92.0}},
+        ],
+    }
+    charts = analysis_chart_series(
+        analysis_viz_data("metrics", metrics_result)
+    )
+    util = next(c for c in charts if c["title"].startswith("Utilization"))
+    assert util["data"]["Pod/y"] == 92.0
+
+    res_result = {"findings": [],
+                  "data": {"pod_buckets": {"crashloop": 2, "pending": 0}}}
+    charts = analysis_chart_series(
+        analysis_viz_data("resources", res_result)
+    )
+    buckets = next(c for c in charts if "buckets" in c["title"])
+    assert buckets["data"] == {"crashloop": 2}  # zero buckets dropped
+
+    topo_result = {
+        "findings": [],
+        "data": {"service_pod_mapping": {"svc-a": {"ready": 1, "total": 2}}},
+    }
+    charts = analysis_chart_series(
+        analysis_viz_data("topology", topo_result)
+    )
+    table = next(c for c in charts if c["kind"] == "table")
+    assert table["data"][0]["service"] == "svc-a"
+
+
+def test_correlated_markdown_groups():
+    from rca_tpu.ui.render import correlated_markdown
+
+    correlated = {
+        "root_causes": [{"component": "database"}],
+        "groups": {
+            "database": [
+                {"severity": "critical", "source": "logs"},
+                {"severity": "high", "source": "events"},
+            ],
+            "cache": [{"severity": "low", "source": "metrics"}],
+        },
+    }
+    md = correlated_markdown(correlated)
+    # ranked components first, then the rest
+    assert md.index("database") < md.index("cache")
+    assert "2 finding(s)" in md and "events, logs" in md
+    assert correlated_markdown({}) == "_No correlated findings._"
+
+
+def test_store_set_title(tmp_path):
+    from rca_tpu.store import InvestigationStore
+
+    store = InvestigationStore(root=str(tmp_path))
+    inv = store.create_investigation("untitled", namespace="ns")
+    store.set_title(inv["id"], "database crash investigation")
+    assert store.get_investigation(inv["id"])["title"] == (
+        "database crash investigation"
+    )
