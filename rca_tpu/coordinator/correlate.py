@@ -241,16 +241,39 @@ def correlate_findings(
     top_k: int = 10,
     engine=None,
 ) -> Dict[str, Any]:
-    """Dispatch on backend; unusable backends degrade to deterministic."""
-    backend = (backend or default_backend()).lower()
-    if backend == "jax" and ctx is not None:
-        try:
-            return correlate_jax(agent_results, ctx, top_k=top_k, engine=engine)
-        except Exception:
+    """Dispatch on backend; unusable backends degrade to deterministic.
+
+    A degraded result carries ``fallback_from``/``fallback_reason`` so a
+    caller (or a parity test) can tell "deterministic by choice" apart from
+    "jax/llm crashed and we hid it" — the same honesty rule the cluster
+    client applies to fetch errors."""
+    requested = (backend or default_backend()).lower()
+    backend = requested
+    fallback_reason = None
+    if backend == "jax":
+        if ctx is None:
+            fallback_reason = "no AnalysisContext for the jax engine"
             backend = "deterministic"
-    if backend == "llm" and llm_client is not None:
-        try:
-            return correlate_llm(agent_results, llm_client, top_k=top_k)
-        except Exception:
+        else:
+            try:
+                return correlate_jax(
+                    agent_results, ctx, top_k=top_k, engine=engine
+                )
+            except Exception as exc:  # noqa: BLE001 - degrade, but say so
+                fallback_reason = f"{type(exc).__name__}: {exc}"
+                backend = "deterministic"
+    if backend == "llm":
+        if llm_client is None:
+            fallback_reason = "no LLM client configured"
             backend = "deterministic"
-    return correlate_deterministic(agent_results, top_k=top_k)
+        else:
+            try:
+                return correlate_llm(agent_results, llm_client, top_k=top_k)
+            except Exception as exc:  # noqa: BLE001 - degrade, but say so
+                fallback_reason = f"{type(exc).__name__}: {exc}"
+                backend = "deterministic"
+    out = correlate_deterministic(agent_results, top_k=top_k)
+    if fallback_reason is not None:
+        out["fallback_from"] = requested
+        out["fallback_reason"] = fallback_reason
+    return out

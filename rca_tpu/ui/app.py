@@ -69,6 +69,11 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
     if url_inv and st.session_state.get("investigation_id") != url_inv:
         if store.get_investigation(url_inv):
             st.session_state.investigation_id = url_inv
+        else:
+            # unknown id: drop it so the URL can't keep advertising an
+            # investigation this store doesn't have
+            st.warning(f"Investigation {url_inv!r} not found in this store.")
+            del st.query_params["investigation"]
 
     # ---- sidebar: investigations + connection (reference: sidebar.py) ----
     with st.sidebar:
@@ -102,6 +107,7 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
         inv = store.create_investigation("New investigation",
                                          namespace=namespace)
         inv_id = st.session_state.investigation_id = inv["id"]
+        st.query_params["investigation"] = inv_id  # URL mirrors the view
     investigation = store.get_investigation(inv_id) or {}
 
     st.title("Kubernetes Root Cause Analysis")
@@ -168,8 +174,16 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
         if st.button("Run comprehensive analysis"):
             with st.spinner("Analyzing (TPU fusion)…"):
                 record = coord.run_analysis("comprehensive", namespace)
-            st.session_state.last_results = record.get("results", {})
-            store.add_agent_findings(inv_id, "comprehensive", record)
+            if record.get("status") != "completed":
+                st.error(
+                    "Analysis failed: "
+                    + str(record.get("error", "unknown error"))
+                )
+                # don't render a previous run's results under the error
+                st.session_state.pop("last_results", None)
+            else:
+                st.session_state.last_results = record.get("results", {})
+                store.add_agent_findings(inv_id, "comprehensive", record)
         results = st.session_state.get("last_results")
         if results:
             if results.get("degraded"):

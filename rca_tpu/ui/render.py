@@ -11,6 +11,8 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List
 
+from rca_tpu.findings import max_severity
+
 SEVERITY_ICONS = {
     "critical": "🔴", "high": "🟠", "medium": "🟡", "low": "🔵", "info": "⚪",
 }
@@ -183,10 +185,15 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
         })
     agent = viz.get("agent_type", "")
     if agent == "metrics" and viz.get("utilization"):
+        # one component can carry several metrics findings (cpu AND memory)
+        # — key by component+resource so neither overwrites the other
         charts.append({
             "title": "Utilization (% of limit)", "kind": "bar",
             "data": {
-                row["component"]: row.get("usage_percentage", 0)
+                (
+                    f"{row['component']} ({row['resource']})"
+                    if row.get("resource") else row["component"]
+                ): row.get("usage_percentage", 0)
                 for row in viz["utilization"]
             },
         })
@@ -234,13 +241,10 @@ def correlated_markdown(correlated: Dict[str, Any]) -> str:
         if comp not in groups:
             continue
         findings = groups[comp]
-        worst = max(
-            (str(f.get("severity", "info")) for f in findings),
-            key=lambda s: ["info", "low", "medium", "high",
-                           "critical"].index(s)
-            if s in ("info", "low", "medium", "high", "critical") else 0,
+        worst = max_severity(
+            str(f.get("severity", "info")) for f in findings
         )
-        icon = SEVERITY_ICONS.get(worst, "⚪")
+        icon = SEVERITY_ICONS.get(worst.lower(), "⚪")
         lines.append(
             f"- {icon} **{comp}** — {len(findings)} finding(s) from "
             f"{', '.join(sorted({str(f.get('source', '')) for f in findings}))}"

@@ -182,17 +182,22 @@ def test_analysis_chart_series_per_agent():
     by_title = {c["title"]: c for c in charts}
     assert by_title["Log error classes"]["data"] == {"oom_kill": 3}
 
+    # real metrics findings always carry a 'resource' kind (agents/metrics
+    # emits one finding per resource), so one component can own several
+    # bars — cpu and memory must not overwrite each other
     metrics_result = {
         "findings": [
             {"component": "Pod/y", "severity": "medium",
-             "evidence": {"usage_percentage": 92.0}},
+             "evidence": {"usage_percentage": 92.0, "resource": "cpu"}},
+            {"component": "Pod/y", "severity": "medium",
+             "evidence": {"usage_percentage": 61.0, "resource": "memory"}},
         ],
     }
     charts = analysis_chart_series(
         analysis_viz_data("metrics", metrics_result)
     )
     util = next(c for c in charts if c["title"].startswith("Utilization"))
-    assert util["data"]["Pod/y"] == 92.0
+    assert util["data"] == {"Pod/y (cpu)": 92.0, "Pod/y (memory)": 61.0}
 
     res_result = {"findings": [],
                   "data": {"pod_buckets": {"crashloop": 2, "pending": 0}}}

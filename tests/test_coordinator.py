@@ -151,7 +151,13 @@ def test_parity_gate_50svc_findings_json_identical(fifty_svc_client):
     det_corr = rec_det["results"]["correlated"]
     jax_corr = rec_jax["results"]["correlated"]
     assert det_corr["backend"] == "deterministic"
-    assert jax_corr["backend"] == "jax"
+    # a degraded run records why (correlate_findings fallback channel) —
+    # surface it so a rare engine failure here is diagnosable, not a bare
+    # string mismatch
+    assert jax_corr["backend"] == "jax", (
+        f"jax backend degraded: from={jax_corr.get('fallback_from')} "
+        f"reason={jax_corr.get('fallback_reason')}"
+    )
     # grouped findings byte-identical across backends
     assert (
         json.dumps(det_corr["groups"], sort_keys=True, default=str)
@@ -182,14 +188,38 @@ def test_parity_gate_50svc_findings_json_identical(fifty_svc_client):
 
 
 def test_correlate_backend_fallback(ctx):
-    # no ctx -> jax backend silently degrades to deterministic
+    # no ctx -> jax backend degrades to deterministic AND says so
     out = correlate_findings(
         {"logs": {"findings": [{"component": "Pod/x", "issue": "boom",
                                 "severity": "high"}]}},
         ctx=None, backend="jax",
     )
     assert out["backend"] == "deterministic"
+    assert out["fallback_from"] == "jax"
+    assert "AnalysisContext" in out["fallback_reason"]
     assert out["root_causes"][0]["component"] == "Pod/x"
+
+    # an explicitly requested deterministic run carries no fallback keys
+    chosen = correlate_findings(
+        {"logs": {"findings": [{"component": "Pod/x", "issue": "boom",
+                                "severity": "high"}]}},
+        ctx=None, backend="deterministic",
+    )
+    assert "fallback_from" not in chosen
+
+    # a jax engine that raises mid-run degrades with the exception recorded
+    class _Boom:
+        def analyze_features(self, *a, **k):
+            raise RuntimeError("engine exploded")
+
+    out2 = correlate_findings(
+        {"logs": {"findings": [{"component": "Pod/x", "issue": "boom",
+                                "severity": "high"}]}},
+        ctx=ctx, backend="jax", engine=_Boom(),
+    )
+    assert out2["backend"] == "deterministic"
+    assert out2["fallback_from"] == "jax"
+    assert "engine exploded" in out2["fallback_reason"]
 
 
 def test_process_user_query_structured(coord, ctx):

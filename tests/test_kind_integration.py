@@ -1,0 +1,155 @@
+"""Opt-in end-to-end test against a live kind cluster.
+
+Closes the loop the reference had (reference: setup_test_cluster.py:382-398 —
+expected findings documented for the live faulted environment): applies the
+manifests from ``tools/setup_test_cluster.py`` to a real kind cluster, waits
+for the injected faults to manifest, runs the comprehensive analyzer through
+the live ``K8sApiClient``, and asserts every component in the
+``expected_findings()`` oracle is surfaced.
+
+Opt-in because it needs Docker + kind + several minutes of wall clock:
+
+    RCA_KIND_TEST=1 python -m pytest tests/test_kind_integration.py -v
+
+Skipped automatically when ``RCA_KIND_TEST`` is unset or kind/kubectl/docker
+are unavailable.  ``RCA_KIND_KEEP=1`` keeps the cluster afterwards for
+interactive use (``python -m rca_tpu ui`` against it).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SETUP = os.path.join(REPO, "tools", "setup_test_cluster.py")
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("RCA_KIND_TEST")
+    or shutil.which("kind") is None
+    or shutil.which("kubectl") is None
+    or shutil.which("docker") is None,
+    reason="live kind test is opt-in: set RCA_KIND_TEST=1 with "
+    "docker+kind+kubectl installed",
+)
+
+
+def _sh(*cmd: str, timeout: int = 600) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        list(cmd), capture_output=True, text=True, timeout=timeout
+    )
+
+
+@pytest.fixture(scope="module")
+def kind_cluster():
+    """Create (or reuse) the faulted kind cluster; tear down unless kept."""
+    from tools.setup_test_cluster import CLUSTER_NAME, NAMESPACE
+
+    rc = subprocess.call([sys.executable, SETUP])
+    if rc != 0:
+        pytest.fail(f"setup_test_cluster.py exited {rc}")
+    # wait until the injected faults are observable: BOTH crashing workloads
+    # (database exits 1 after ~30s; api-gateway exits on its missing env
+    # var) must have restarted, then settle a bit longer so the slower
+    # faults — backend's CPU spin crossing the utilization threshold and
+    # resource-service's 90Mi memory fill — have manifested in kubectl-top
+    # metrics before the analyzer runs
+    restarts: dict = {}
+    deadline = time.time() + 360
+    while time.time() < deadline:
+        out = _sh(
+            "kubectl", "get", "pods", "-n", NAMESPACE,
+            "-o", "jsonpath={range .items[*]}{.metadata.name} "
+            "{.status.containerStatuses[0].restartCount}\n{end}",
+        ).stdout
+        restarts = {
+            line.split()[0]: int(line.split()[1])
+            for line in out.strip().splitlines()
+            if len(line.split()) == 2
+        }
+        crashed = {
+            prefix: any(
+                name.startswith(prefix) and count >= 1
+                for name, count in restarts.items()
+            )
+            for prefix in ("database", "api-gateway")
+        }
+        if all(crashed.values()):
+            break
+        time.sleep(10)
+    else:
+        pytest.fail(f"faults never manifested; pod restarts: {restarts}")
+    time.sleep(60)  # metrics-server scrape interval for the slow faults
+    yield NAMESPACE
+    if not os.environ.get("RCA_KIND_KEEP"):
+        subprocess.call([sys.executable, SETUP, "--delete"])
+
+
+def test_analyzer_finds_injected_faults_on_live_cluster(kind_cluster):
+    from rca_tpu.cluster.k8s_client import K8sApiClient
+    from rca_tpu.coordinator import RCACoordinator
+    from tools.setup_test_cluster import expected_findings
+
+    client = K8sApiClient()
+    assert client.is_connected(), "kind cluster not reachable via kubeconfig"
+
+    coord = RCACoordinator(client, backend="deterministic")
+    record = coord.run_analysis("comprehensive", kind_cluster)
+    assert record["status"] == "completed"
+    results = record["results"]
+
+    flat = [
+        f
+        for res in results.values()
+        if isinstance(res, dict)
+        for f in res.get("findings", [])
+    ]
+    assert flat, "no findings at all against the faulted cluster"
+
+    # per-oracle: some finding's COMPONENT must name the faulted workload
+    # (substring over the concatenated blob would let 'backend' be satisfied
+    # by the 'backend-network-policy' finding), and — where the fault has an
+    # unambiguous signature — that finding's text must carry it
+    signature_terms = {
+        "database": ("crashloopbackoff", "restart", "exit"),
+        "api-gateway": ("exit", "crash", "fail", "env"),
+        "backend": ("cpu",),
+        "resource-service": ("memory",),
+        "backend-network-policy": ("selector", "ingress", "network"),
+    }
+    missed = []
+    for oracle in expected_findings():
+        want = oracle["component"].lower()
+        matching = [
+            f for f in flat
+            if want in str(f.get("component", "")).lower()
+            # exact-word guard: 'backend' must not match the policy object
+            and (want != "backend"
+                 or "network-policy" not in str(f.get("component", "")))
+        ]
+        terms = signature_terms[want]
+        if not any(
+            any(
+                t in f"{f.get('issue', '')} {f.get('evidence', '')}".lower()
+                for t in terms
+            )
+            for f in matching
+        ):
+            missed.append(oracle)
+    assert not missed, (
+        f"injected faults never surfaced with their signature: {missed}"
+    )
+
+    # the fused ranking must put one of the two hard-failing workloads
+    # (database restart loop / api-gateway missing env) at the top
+    roots = results.get("correlated", {}).get("root_causes", [])
+    assert roots, "correlation produced no ranked root causes"
+    top = roots[0]["component"].lower()
+    assert any(name in top for name in ("database", "api-gateway")), (
+        f"top root cause {top!r} is not one of the crashing workloads"
+    )
