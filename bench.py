@@ -1,10 +1,20 @@
 """Headline benchmark: RCA graph-inference latency on a 2k-service cascade.
 
-Measures the north-star metric (BASELINE.json): median device latency of the
-jit'd explain-away propagation + top-k ranking over a 2,000-service synthetic
-fault cascade (3 concurrent roots), and whether the true roots are ranked
-top-1/top-k.  Baseline target: < 150 ms on TPU v5e-1 with top-1 hit.
-``vs_baseline`` = 150 / measured_ms (higher is better; >1 beats target).
+Measures the north-star metric (BASELINE.json): END-TO-END latency (dispatch
++ device execution + result fetch) of the jit'd explain-away propagation +
+top-k ranking over a 2,000-service synthetic fault cascade (3 concurrent
+roots), and whether the true roots are ranked top-1/top-k.  Baseline target:
+< 150 ms on TPU v5e-1 with top-1 hit.  ``vs_baseline`` = 150 / measured_ms
+(higher is better; >1 beats target).
+
+Timing semantics (round-2 correction): every measurement synchronizes by
+FETCHING a result slice (``jax.device_get``), never by ``block_until_ready``
+alone — on tunneled TPU backends (axon) block_until_ready can return at
+enqueue time, which is how round 1 printed a 0.027 ms "latency" that was
+really dispatch-queue insertion.  The per-sync host<->device round trip is
+measured separately (``sync_floor_ms``, ~90 ms through the tunnel, ~0 on a
+host-attached chip) and subtracted from the in-jit amortized numbers, which
+therefore report pure device compute per inference.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
@@ -56,23 +66,56 @@ def main(skip_accuracy: bool = False) -> int:
         explain_strength=p.explain_strength, impact_bonus=p.impact_bonus,
     )
 
+    # the per-sync round trip (dispatch + fetch of a tiny buffer): this is
+    # transport, not inference — measured once, reported, and subtracted
+    # from the amortized per-rep numbers below
+    @jax.jit
+    def _triv(x, s):
+        return x * s
+
+    xt = jnp.ones((8,))
+    jax.device_get(_triv(xt, jnp.float32(1.0)))
+    floors = []
+    for j in range(10):
+        t0 = time.perf_counter()
+        jax.device_get(_triv(xt, jnp.float32(j + 2.0)))
+        floors.append((time.perf_counter() - t0) * 1e3)
+    sync_floor_ms = float(np.median(floors))
+
     def amort_min_ms(make_many, args, reps_in_jit, outer=5):
-        """Shared amortized-timing scaffold: warm once, min over ``outer``
-        dispatches of a jitted ``reps_in_jit``-rep loop (min across reps:
-        transient device contention only inflates).  ``make_many`` receives
-        the rep count so the loop length and the divisor cannot drift, and
-        its function must take a trailing ``salt`` scalar folded into the
-        computation — every dispatch carries a fresh salt so no transport
-        layer can serve a cached result for a repeated identical call."""
-        many = make_many(reps_in_jit)
-        many(*args, jnp.float32(1e-7)).block_until_ready()
-        outs = []
-        for j in range(outer):
-            salt = jnp.float32((j + 2) * 1e-7)
-            t0 = time.perf_counter()
-            many(*args, salt).block_until_ready()
-            outs.append((time.perf_counter() - t0) * 1e3)
-        return float(np.min(outs)) / reps_in_jit
+        """Shared amortized-timing scaffold, MARGINAL form: time a jitted
+        R-rep loop and a 2R-rep loop (min over ``outer`` dispatches each;
+        transient contention only inflates) and report (t_2R - t_R) / R —
+        the per-sync transport floor cancels exactly, leaving pure device
+        compute per rep, immune to the floor's run-to-run jitter.
+        ``make_many`` receives the rep count so the loop length and the
+        divisor cannot drift, and its function must take a trailing ``salt``
+        scalar folded into the computation — every dispatch carries a fresh
+        salt so no transport layer can serve a cached result for a repeated
+        identical call.  Syncs by FETCHING a 4-element slice (see module
+        docstring) — never by block_until_ready."""
+
+        def min_total(reps):
+            many = make_many(reps)
+            jax.device_get(many(*args, jnp.float32(1e-7))[:4])
+            outs = []
+            for j in range(outer):
+                salt = jnp.float32((j + 2) * 1e-7)
+                t0 = time.perf_counter()
+                jax.device_get(many(*args, salt)[:4])
+                outs.append((time.perf_counter() - t0) * 1e3)
+            return float(np.min(outs))
+
+        reps = reps_in_jit
+        for _ in range(3):
+            t_r = min_total(reps)
+            t_2r = min_total(2 * reps)
+            if t_2r > t_r:
+                return (t_2r - t_r) / reps
+            # marginal vanished under floor jitter: quadruple the work so
+            # the compute term dominates, instead of reporting a fake 0.0
+            reps *= 4
+        return None  # unresolvable — report honestly as unmeasured
 
     big = synthetic_cascade_arrays(50000, n_roots=5, seed=0)
     rb = engine.analyze_arrays(big.features, big.dep_src, big.dep_dst, k=5)
@@ -82,24 +125,35 @@ def main(skip_accuracy: bool = False) -> int:
     bf, bs, bd = engine._pad(big.features, big.dep_src, big.dep_dst)
     bfj, bsj, bdj = jnp.asarray(bf), jnp.asarray(bs), jnp.asarray(bd)
 
-    def make_many_prop(reps):
-        @jax.jit
-        def many(f, s, d, salt):
-            def body(i, acc):
-                # scale features per rep so XLA cannot hoist the body
-                score = prop(
-                    f * (1.0 + salt + i * 1e-7), s, d, n_live=big_n
-                )[4]
-                return acc + score
-            return jax.lax.fori_loop(0, reps, body, jnp.zeros(f.shape[0]))
-        return many
+    from rca_tpu.engine.runner import up_ell_for
 
-    big_ms = amort_min_ms(make_many_prop, (bfj, bsj, bdj), reps_in_jit=10)
+    def make_many_prop_for(n_live, prop_fn, up_ell=None):
+        def make_many(reps):
+            @jax.jit
+            def many(f, s, d, salt):
+                def body(i, acc):
+                    # scale features per rep so XLA cannot hoist the body
+                    score = prop_fn(
+                        f * (1.0 + salt + i * 1e-7), s, d, n_live=n_live,
+                        up_ell=up_ell,
+                    )[4]
+                    return acc + score
+                return jax.lax.fori_loop(0, reps, body, jnp.zeros(f.shape[0]))
+            return many
+        return make_many
+
+    # measure the engine's REAL layout (hybrid by default)
+    big_up_ell = up_ell_for(bf.shape[0], big.dep_src, big.dep_dst)
+    big_ms = amort_min_ms(
+        make_many_prop_for(big_n, prop, big_up_ell), (bfj, bsj, bdj),
+        reps_in_jit=10,
+    )
 
     # batched multi-hypothesis scoring (BASELINE.md 10k streaming row):
     # 16 perturbed feature sets over the 2k graph, one vmapped executable
     B = 16
     f, s, d = engine._pad(case.features, case.dep_src, case.dep_dst)
+    up_ell_2k = up_ell_for(f.shape[0], case.dep_src, case.dep_dst)
     rng = np.random.default_rng(0)
     batch = np.clip(
         f[None].repeat(B, 0)
@@ -109,16 +163,27 @@ def main(skip_accuracy: bool = False) -> int:
 
     @jax.jit
     def batched(fb, s, d):
-        return jax.vmap(lambda f: prop(f, s, d, n_live=n_services)[4])(fb)
+        return jax.vmap(
+            lambda f: prop(f, s, d, n_live=n_services, up_ell=up_ell_2k)[4]
+        )(fb)
 
     fb, sj, dj = jnp.asarray(batch), jnp.asarray(s), jnp.asarray(d)
-    batched(fb, sj, dj).block_until_ready()
+    jax.device_get(batched(fb, sj, dj))
     reps = []
     for _ in range(10):
         t0 = time.perf_counter()
-        batched(fb, sj, dj).block_until_ready()
+        jax.device_get(batched(fb, sj, dj))
         reps.append((time.perf_counter() - t0) * 1e3)
     batch_ms = float(np.median(reps))
+
+    # pure device compute per 2k inference, amortized over an in-jit loop
+    # (the headline ``value`` is single-shot end-to-end and so includes one
+    # sync_floor_ms of transport; this isolates the chip's share)
+    f2, s2, d2 = jnp.asarray(f), jnp.asarray(s), jnp.asarray(d)
+    device_2k_ms = amort_min_ms(
+        make_many_prop_for(n_services, prop, up_ell_2k), (f2, s2, d2),
+        reps_in_jit=64,
+    )
 
     # -- Pallas proof (VERDICT round-1 item 6): record whether the fused
     # noisy-OR kernel compiles on THIS backend and its amortized timing vs
@@ -146,7 +211,9 @@ def main(skip_accuracy: bool = False) -> int:
                     return acc + a + h
                 return jax.lax.fori_loop(0, reps, body, jnp.zeros(bfj.shape[0]))
             return many
-        return amort_min_ms(make_many, (arg,), reps_in_jit=50)
+        # high rep count: a single noisy-OR pass is ~20 us, so the pair must
+        # be amortized far below the sync floor to be resolvable
+        return amort_min_ms(make_many, (arg,), reps_in_jit=500)
 
     xla_nor_ms = nor_amort(noisy_or_pair_xla, bfj)
     pallas_nor_ms = nor_amort(noisy_or_pair_pallas, ft) if pallas_ok else None
@@ -231,6 +298,10 @@ def main(skip_accuracy: bool = False) -> int:
                          "adversarial")
         }
 
+    def r(x, nd=4):
+        """Round, passing through None (= honestly unmeasured)."""
+        return round(x, nd) if x is not None else None
+
     target_ms = 150.0
     line = {
         "metric": "rca_graph_inference_latency_2k_service",
@@ -242,17 +313,17 @@ def main(skip_accuracy: bool = False) -> int:
         "hit_at_1_500svc": hits / trials,
         "n_services": n_services,
         "n_edges": result.n_edges,
-        "latency_50k_amortized_ms": round(big_ms, 4),
+        "sync_floor_ms": round(sync_floor_ms, 3),
+        "device_compute_ms_2k": r(device_2k_ms),
+        "latency_50k_amortized_ms": r(big_ms),
         "top1_hit_50k": bool(big_top1),
         "batch16_2k_dispatch_ms": round(batch_ms, 3),
         "tick_ms_10k": round(tick_ms_10k, 3),
         "tick_upload_rows_10k": tick_upload_rows,
         "pallas_supported": bool(pallas_ok),
         "pallas_engaged": bool(pallas_enabled()),  # reflects RCA_PALLAS env
-        "xla_noisyor_50k_ms": round(xla_nor_ms, 4),
-        "pallas_noisyor_50k_ms": (
-            round(pallas_nor_ms, 4) if pallas_nor_ms is not None else None
-        ),
+        "xla_noisyor_50k_ms": r(xla_nor_ms),
+        "pallas_noisyor_50k_ms": r(pallas_nor_ms),
         "backend": "jax",
     }
     if accuracy is not None:

@@ -6,14 +6,19 @@ Real graphs have hub nodes (in-degree p99 ≈ 24 but max ≈ 2k at 50k
 services), so the width is capped and the residue goes to a small COO
 overflow list.
 
-Measured on v5e via in-jit loop timing, XLA's scatter handles even heavily
-duplicated indices in sub-microsecond time per step at 65k nodes, so the
-default engine path stays COO scatter; this layout is kept as a validated
-alternative (``RCA_EDGE_LAYOUT=ell``) for hardware/XLA versions where
-scatter lowers poorly, and is verified bit-compatible with the scatter path
-by tests/test_engine_layouts.py.  (Reference comparison: the reference
-rebuilt an ``nx.DiGraph`` per analysis, agents/topology_agent.py:94; neither
-layout here materializes dense adjacency, per SURVEY.md §7.)
+Measured on v5e via device_get-synced in-jit loop timing: a FULL-ELL
+propagate (both directions through width-capped tables) loses to COO
+scatter — 10.9 vs 1.4 ms at 2k services, 158 vs 34 ms at 50k — because hub
+fan-in forces a wide (32-lane) down table.  But the UP direction's degree
+distribution is the opposite (services depend on 3-8 things), and a narrow
+up table beats the scatter-max 2.4x per step; the default engine layout is
+therefore the HYBRID (``RCA_EDGE_LAYOUT=hybrid``): up-scan through
+:func:`build_ell_segments`' table, down-scan through COO scatter-add.  Pure
+``coo`` and pure ``ell`` remain selectable, and all three are verified
+bit-compatible by tests/test_engine_layouts.py.  (Reference comparison: the
+reference rebuilt an ``nx.DiGraph`` per analysis,
+agents/topology_agent.py:94; no layout here materializes dense adjacency,
+per SURVEY.md §7.)
 """
 
 from __future__ import annotations

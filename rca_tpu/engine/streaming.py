@@ -11,7 +11,10 @@ in-place updates to avoid host↔device churn" — round 1 re-uploaded the full
 Per-tick transfer is therefore proportional to the delta count: U changed
 services upload one [U] int32 index vector and one [U, C] float32 row block
 (U padded to a small power of two so the scatter executable is reused), not
-the [S_pad, C] matrix.
+the [S_pad, C] matrix.  The whole tick — scatter, propagation, top-k — runs
+as a SINGLE fused dispatch (:func:`_flush_propagate_ranked`): on tunneled
+TPUs each dispatch pays a host round trip that dwarfs device compute, so
+flush-then-propagate as two calls would double the tick latency.
 """
 
 from __future__ import annotations
@@ -25,14 +28,35 @@ import jax.numpy as jnp
 import numpy as np
 
 from rca_tpu.config import RCAConfig, bucket_for
-from rca_tpu.engine.runner import GraphEngine, _propagate_ranked
+from rca_tpu.engine.runner import GraphEngine, _propagate_ranked, up_ell_for
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _apply_rows(features, idx, rows):
-    """Scatter changed rows into the DONATED device-resident feature buffer;
-    XLA reuses the buffer in place instead of materializing a copy."""
-    return features.at[idx].set(rows)
+@functools.partial(
+    jax.jit,
+    donate_argnums=(0,),
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus", "k",
+    ),
+)
+def _flush_propagate_ranked(
+    features, idx, rows, edges, anomaly_w, hard_w,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    k: int, n_live, up_ell=None,
+):
+    """Whole tick in ONE dispatch: scatter the delta rows into the donated
+    resident buffer, propagate, top-k.  On tunneled TPUs every dispatch pays
+    a host round trip, so flush-then-propagate as two calls doubles tick
+    latency; fused, the tick costs one RTT plus device compute."""
+    from rca_tpu.engine.propagate import propagate
+
+    features = features.at[idx].set(rows)
+    a, h, u, m, score = propagate(
+        features, edges[0], edges[1], anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus, n_live=n_live,
+        up_ell=up_ell,
+    )
+    vals, topi = jax.lax.top_k(score, k)
+    return features, vals, topi
 
 
 class StreamingSession:
@@ -62,6 +86,8 @@ class StreamingSession:
         d[: len(dep_dst)] = dep_dst
         # edges + weights + FEATURES live on device for the whole session
         self._edges = jnp.asarray(np.stack([s, d]))
+        # hybrid layout's upstream table, built once for the session
+        self._up_ell = up_ell_for(self._n_pad, dep_src, dep_dst)
         self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
         # pending row updates, keyed by service index (last write wins, so
         # the scatter never carries duplicate indices)
@@ -87,42 +113,42 @@ class StreamingSession:
         self._features = jnp.asarray(f)
         self._pending.clear()
 
-    # -- device-side delta flush -------------------------------------------
-    def _flush(self) -> None:
-        if not self._pending:
-            self.last_upload_rows = 0
-            return
-        u = len(self._pending)
-        # pad the delta block to a power of two: one scatter executable per
-        # tier, padded lanes write zeros onto the zero dummy row
-        u_pad = 1 << max(0, (u - 1).bit_length())
-        idx = np.full(u_pad, self._n_pad - 1, np.int32)
-        rows = np.zeros((u_pad, self._num_features), np.float32)
-        for j, (i, f) in enumerate(self._pending.items()):
-            idx[j] = i
-            rows[j] = f
-        self._features = _apply_rows(
-            self._features, jnp.asarray(idx), jnp.asarray(rows)
-        )
-        self.last_upload_rows = u_pad
-        self._pending.clear()
-
     # -- tick ---------------------------------------------------------------
     def tick(self) -> Dict[str, object]:
         """One inference pass; returns ranked root causes + tick latency."""
         p = self.engine.params
         t0 = time.perf_counter()
-        self._flush()
-        stacked, vals, idx = _propagate_ranked(
-            self._features, self._edges,
-            self.engine._aw, self.engine._hw,
-            p.steps, p.decay, p.explain_strength, p.impact_bonus, self._kk,
-            False, self._n_live,
-        )
-        idx.block_until_ready()
+        if self._pending:
+            # fused path: scatter + propagate + top-k in a single dispatch
+            u = len(self._pending)
+            u_pad = 1 << max(0, (u - 1).bit_length())
+            idx_h = np.full(u_pad, self._n_pad - 1, np.int32)
+            rows_h = np.zeros((u_pad, self._num_features), np.float32)
+            for j, (i, f) in enumerate(self._pending.items()):
+                idx_h[j] = i
+                rows_h[j] = f
+            self._features, vals, idx = _flush_propagate_ranked(
+                self._features, jnp.asarray(idx_h), jnp.asarray(rows_h),
+                self._edges, self.engine._aw, self.engine._hw,
+                p.steps, p.decay, p.explain_strength, p.impact_bonus,
+                self._kk, self._n_live, self._up_ell,
+            )
+            # only drop the deltas once the dispatch is accepted — a raise
+            # above (fresh-tier compile failure) must leave them retryable
+            self._pending.clear()
+            self.last_upload_rows = u_pad
+        else:
+            self.last_upload_rows = 0
+            stacked, vals, idx = _propagate_ranked(
+                self._features, self._edges,
+                self.engine._aw, self.engine._hw,
+                p.steps, p.decay, p.explain_strength, p.impact_bonus,
+                self._kk, False, self._n_live, self._up_ell,
+            )
+        # sync through the fetch: block_until_ready alone can return at
+        # enqueue time on tunneled backends, under-measuring the tick
+        vals, idx = jax.device_get((vals, idx))
         latency_ms = (time.perf_counter() - t0) * 1e3
-        idx = np.asarray(idx)
-        vals = np.asarray(vals)
         ranked: List[dict] = []
         for j, i in enumerate(idx.tolist()):
             if i >= self._n or len(ranked) >= self.k:

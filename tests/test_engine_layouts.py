@@ -36,8 +36,12 @@ def test_ell_matches_scatter(n, n_roots, cap):
 
 
 def test_ell_engine_path_env_switch(monkeypatch):
+    """All three layouts (default hybrid, pure coo, pure ell) agree."""
     case = synthetic_cascade_arrays(200, n_roots=1, seed=0)
     eng = GraphEngine()
+    monkeypatch.setenv("RCA_EDGE_LAYOUT", "hybrid")  # pin: ambient env must not skip the hybrid leg
+    r_hybrid = eng.analyze_arrays(case.features, case.dep_src, case.dep_dst, k=3)
+    monkeypatch.setenv("RCA_EDGE_LAYOUT", "coo")
     r_coo = eng.analyze_arrays(case.features, case.dep_src, case.dep_dst, k=3)
     monkeypatch.setenv("RCA_EDGE_LAYOUT", "ell")
     r_ell = eng.analyze_arrays(case.features, case.dep_src, case.dep_dst, k=3)
@@ -45,6 +49,44 @@ def test_ell_engine_path_env_switch(monkeypatch):
         x["component"] for x in r_ell.ranked
     ]
     np.testing.assert_allclose(r_coo.score, r_ell.score, atol=1e-6)
+    # hybrid's up-scan reorders only MAX reductions -> bit-identical to coo
+    assert [x["component"] for x in r_hybrid.ranked] == [
+        x["component"] for x in r_coo.ranked
+    ]
+    np.testing.assert_array_equal(r_hybrid.score, r_coo.score)
+    np.testing.assert_array_equal(r_hybrid.upstream, r_coo.upstream)
+
+
+def test_hybrid_up_table_overflow_regime():
+    """A service with more dependencies than the width cap (8) exercises the
+    hybrid up-scan's overflow scatter; scores must stay bit-identical."""
+    import jax.numpy as jnp
+
+    from rca_tpu.engine.propagate import propagate
+    from rca_tpu.engine.runner import build_up_ell
+
+    rng = np.random.default_rng(0)
+    n, n_pad = 40, 41
+    # node 0 depends on 20 services (overflow), the rest form a chain
+    src = np.concatenate([np.zeros(20, np.int32),
+                          np.arange(1, n - 1, dtype=np.int32)])
+    dst = np.concatenate([np.arange(1, 21, dtype=np.int32),
+                          np.arange(2, n, dtype=np.int32)])
+    from rca_tpu.features.schema import NUM_SERVICE_FEATURES
+
+    f = np.zeros((n_pad, NUM_SERVICE_FEATURES), np.float32)
+    f[:n] = rng.uniform(0, 1, (n, NUM_SERVICE_FEATURES)).astype(np.float32)
+    p = default_params()
+    aw, hw = p.weight_arrays()
+
+    args = (aw, hw, p.steps, p.decay, p.explain_strength, p.impact_bonus)
+    coo = propagate(jnp.asarray(f), src, dst, *args, n_live=n)
+    hyb = propagate(
+        jnp.asarray(f), src, dst, *args, n_live=n,
+        up_ell=build_up_ell(n_pad, src, dst),
+    )
+    for x, y in zip(coo, hyb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
 def test_build_ell_segments_empty_and_overflow():
