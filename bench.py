@@ -13,8 +13,9 @@ alone — on tunneled TPU backends (axon) block_until_ready can return at
 enqueue time, which is how round 1 printed a 0.027 ms "latency" that was
 really dispatch-queue insertion.  The per-sync host<->device round trip is
 measured separately (``sync_floor_ms``, ~90 ms through the tunnel, ~0 on a
-host-attached chip) and subtracted from the in-jit amortized numbers, which
-therefore report pure device compute per inference.
+host-attached chip) and cancels out of the in-jit amortized numbers, which
+time an R-rep and a 2R-rep loop and report the marginal (t_2R - t_R)/R —
+pure device compute per inference, immune to the floor's jitter.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
@@ -67,8 +68,8 @@ def main(skip_accuracy: bool = False) -> int:
     )
 
     # the per-sync round trip (dispatch + fetch of a tiny buffer): this is
-    # transport, not inference — measured once, reported, and subtracted
-    # from the amortized per-rep numbers below
+    # transport, not inference — measured once and reported for context;
+    # the amortized numbers below cancel it via their marginal form
     @jax.jit
     def _triv(x, s):
         return x * s

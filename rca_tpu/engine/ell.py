@@ -117,6 +117,21 @@ class EllGraph:
         )
 
 
+def ell_up_step(u, h, decay, idx, mask, ovf_seg, ovf_other):
+    """One upstream-explanation step over an ELL table: gather each node's
+    dependencies, take the row max, fold hub overflow through a small
+    scatter-max, and keep the dummy slot (last row) at 0.  Shared by the
+    hybrid default (propagate_core) and the full-ELL layout so the
+    bit-compatibility the layout tests assert cannot drift between copies."""
+    vals = jnp.maximum(h[idx], decay * u[idx]) * mask
+    u_new = vals.max(axis=1)
+    ovf = jnp.maximum(h[ovf_other], decay * u[ovf_other])
+    u_new = u_new.at[ovf_seg].max(ovf)
+    # dummy slot may have been written by padded overflow lanes
+    u_new = u_new.at[-1].set(0.0)
+    return jnp.maximum(u, u_new)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("steps", "decay", "explain_strength", "impact_bonus"),
@@ -142,13 +157,9 @@ def propagate_ell(
     h = _noisy_or(features, hard_w)
 
     def up_step(u, _):
-        vals = jnp.maximum(h[up_idx], decay * u[up_idx]) * up_mask
-        u_new = vals.max(axis=1)
-        ovf = jnp.maximum(h[up_ovf_other], decay * u[up_ovf_other])
-        u_new = u_new.at[up_ovf_seg].max(ovf)
-        # dummy slot may have been written by padded overflow lanes
-        u_new = u_new.at[-1].set(0.0)
-        return jnp.maximum(u, u_new), None
+        return ell_up_step(
+            u, h, decay, up_idx, up_mask, up_ovf_seg, up_ovf_other
+        ), None
 
     u, _ = jax.lax.scan(up_step, jnp.zeros_like(a), None, length=steps)
 

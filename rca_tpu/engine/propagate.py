@@ -56,8 +56,12 @@ class PropagationParams:
 def default_params(steps: int = 8) -> PropagationParams:
     aw = np.zeros(NUM_SERVICE_FEATURES, dtype=np.float32)
     aw[SvcF.CRASH] = 1.0
-    aw[SvcF.ERROR_RATE] = 0.7
-    aw[SvcF.LATENCY] = 0.5
+    # soft symptoms (error rate, latency) are weak evidence of being the
+    # ROOT — decoy services spike them without any downstream blast radius
+    # (correlated_noise mode); held-out eval across all six cascade modes
+    # picked 0.4/0.3 over the round-1 0.7/0.5 (PERF.md accuracy table)
+    aw[SvcF.ERROR_RATE] = 0.4
+    aw[SvcF.LATENCY] = 0.3
     aw[SvcF.RESTARTS] = 0.6
     aw[SvcF.EVENTS] = 0.4
     aw[SvcF.LOG_ERRORS] = 0.5
@@ -74,6 +78,11 @@ def default_params(steps: int = 8) -> PropagationParams:
     hw[SvcF.PENDING] = 0.6
     hw[SvcF.OOM] = 0.95
     hw[SvcF.RESTARTS] = 0.4
+    # a not-ready service is observably broken: counting it as (moderate)
+    # hard evidence keeps explain-away working when a root's crash channel
+    # is dropped (missing_signals mode) — without it the root can't
+    # suppress its blast radius and a high-impact victim outranks it
+    hw[SvcF.NOT_READY] = 0.5
     return PropagationParams(
         anomaly_weights=tuple(float(x) for x in aw),
         hard_weights=tuple(float(x) for x in hw),
@@ -173,16 +182,14 @@ def propagate_core(
     """
 
     if up_ell is not None:
+        from rca_tpu.engine.ell import ell_up_step
+
         up_idx, up_mask, up_ovf_seg, up_ovf_other = up_ell
 
         def up_step(u, _):
-            vals = jnp.maximum(h[up_idx], decay * u[up_idx]) * up_mask
-            u_new = vals.max(axis=1)
-            ovf = jnp.maximum(h[up_ovf_other], decay * u[up_ovf_other])
-            u_new = u_new.at[up_ovf_seg].max(ovf)
-            # padded overflow lanes self-loop on the dummy slot; keep it 0
-            u_new = u_new.at[-1].set(0.0)
-            return jnp.maximum(u, u_new), None
+            return ell_up_step(
+                u, h, decay, up_idx, up_mask, up_ovf_seg, up_ovf_other
+            ), None
     else:
 
         def up_step(u, _):

@@ -33,16 +33,40 @@ UP_WIDTH_CAP = 8  # dependencies per service are few; hub FAN-IN is not
 def build_up_ell(n_pad: int, dep_src, dep_dst):
     """Device arrays for the hybrid layout's upstream gather table:
     (idx, mask, ovf_seg, ovf_other), grouping each service's dependencies
-    (edges src→dst keyed by src) into an [n_pad, D≤8] table."""
+    (edges src→dst keyed by src) into an [n_pad, 8] table.
+
+    Contract: slot ``n_pad - 1`` is the engine's dummy row — callers pass
+    the RAW (unpadded) edge arrays and an n_pad that reserves it (asserted),
+    because the propagation step zeroes that slot each iteration.
+
+    Shapes are STABLE per (n_pad, overflow-tier): the width is always
+    ``UP_WIDTH_CAP`` (not the graph's max out-degree) and the overflow
+    length is a power-of-two tier with a floor of 8 — otherwise a degree
+    change inside the same node bucket would force a full XLA recompile in
+    the latency path (the same reason ``n_live`` is a traced scalar)."""
     from rca_tpu.engine.ell import build_ell_segments
 
-    seg = build_ell_segments(
-        np.asarray(dep_src), np.asarray(dep_dst), n_pad,
-        width_cap=UP_WIDTH_CAP,
-    )
+    src = np.asarray(dep_src)
+    dst = np.asarray(dep_dst)
+    if len(src):
+        assert int(src.max()) < n_pad - 1 and int(dst.max()) < n_pad - 1, (
+            "build_up_ell needs slot n_pad-1 free as the dummy row; pass "
+            "raw edges with n_pad = bucket(n_services + 1)"
+        )
+    seg = build_ell_segments(src, dst, n_pad, width_cap=UP_WIDTH_CAP)
+    dummy = n_pad - 1
+    idx = np.full((n_pad, UP_WIDTH_CAP), dummy, np.int32)
+    mask = np.zeros((n_pad, UP_WIDTH_CAP), np.float32)
+    idx[:, : seg.idx.shape[1]] = seg.idx
+    mask[:, : seg.mask.shape[1]] = seg.mask
+    o_pad = max(8, len(seg.ovf_seg))  # build_ell_segments pads to pow2
+    ovf_seg = np.full(o_pad, dummy, np.int32)
+    ovf_other = np.full(o_pad, dummy, np.int32)
+    ovf_seg[: len(seg.ovf_seg)] = seg.ovf_seg
+    ovf_other[: len(seg.ovf_other)] = seg.ovf_other
     return (
-        jnp.asarray(seg.idx), jnp.asarray(seg.mask),
-        jnp.asarray(seg.ovf_seg), jnp.asarray(seg.ovf_other),
+        jnp.asarray(idx), jnp.asarray(mask),
+        jnp.asarray(ovf_seg), jnp.asarray(ovf_other),
     )
 
 
@@ -67,7 +91,12 @@ def edge_layout() -> str:
     - ``ell``: both scans over width-capped gather tables + overflow
       (validated alternative for stacks where scatter lowers poorly;
       measured slower on v5e because hub fan-in forces a wide table)."""
-    return os.environ.get("RCA_EDGE_LAYOUT", "hybrid").lower()
+    layout = os.environ.get("RCA_EDGE_LAYOUT", "hybrid").lower()
+    if layout not in ("hybrid", "coo", "ell"):
+        raise ValueError(
+            f"RCA_EDGE_LAYOUT={layout!r}: expected hybrid, coo, or ell"
+        )
+    return layout
 
 
 @functools.partial(

@@ -239,14 +239,28 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                             hoverinfo="none", showlegend=False,
                         )
                     )
-                fig.add_trace(
-                    go.Scatter(
-                        x=[n["x"] for n in data["nodes"]],
-                        y=[n["y"] for n in data["nodes"]],
-                        text=[n["id"] for n in data["nodes"]],
-                        mode="markers+text", textposition="top center",
+                # one trace per node type -> colored legend (reference:
+                # components/visualization.py:647-764 node-type colors)
+                type_colors = {
+                    "service": "#1f77b4", "workload": "#2ca02c",
+                    "ingress": "#d62728", "configmap": "#9467bd",
+                    "secret": "#8c564b",
+                }
+                by_type = {}
+                for node in data["nodes"]:
+                    by_type.setdefault(node["type"] or "other", []).append(node)
+                for ntype, members in sorted(by_type.items()):
+                    fig.add_trace(
+                        go.Scatter(
+                            x=[n["x"] for n in members],
+                            y=[n["y"] for n in members],
+                            text=[n["id"] for n in members],
+                            name=ntype,
+                            mode="markers+text", textposition="top center",
+                            marker={"size": 10,
+                                    "color": type_colors.get(ntype, "#7f7f7f")},
+                        )
                     )
-                )
                 st.plotly_chart(fig, use_container_width=True)
             except ImportError:
                 st.json(data)
