@@ -91,7 +91,8 @@ def edge_layout() -> str:
     - ``ell``: both scans over width-capped gather tables + overflow
       (validated alternative for stacks where scatter lowers poorly;
       measured slower on v5e because hub fan-in forces a wide table)."""
-    layout = os.environ.get("RCA_EDGE_LAYOUT", "hybrid").lower()
+    # `or`: an empty env var conventionally means unset, not an error
+    layout = (os.environ.get("RCA_EDGE_LAYOUT") or "hybrid").lower()
     if layout not in ("hybrid", "coo", "ell"):
         raise ValueError(
             f"RCA_EDGE_LAYOUT={layout!r}: expected hybrid, coo, or ell"

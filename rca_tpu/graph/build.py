@@ -143,15 +143,18 @@ def _workloads(snapshot: ClusterSnapshot) -> List[Tuple[str, dict]]:
     return out
 
 
-def _dns_service_names(value: str, service_names: List[str], namespace: str):
+def _dns_service_names(value: str, svc_set: set, namespace: str):
     """Service DNS inference from env values (reference:
     agents/topology_agent.py:228-260): match a bare '<svc>' host or a
     qualified '<svc>.<ns>[.svc...]' host.  The namespace component must be
     THIS namespace — '<svc>.<other-ns>.svc' points at a different cluster
-    tenant and must not create a local dependency edge."""
+    tenant and must not create a local dependency edge.
+
+    ``svc_set`` is a prebuilt set: this runs once per container env var, so
+    building the set here made graph construction O(S²) — 17.6 s at 10k
+    services, vs ~1 s with the set hoisted to the per-graph caller."""
     hits = set()
     hosts = re.findall(r"[a-z0-9][a-z0-9.-]*", value.lower())
-    svc_set = set(service_names)
     for host in hosts:
         parts = host.split(".")
         if parts[0] in svc_set:
@@ -163,6 +166,7 @@ def _dns_service_names(value: str, service_names: List[str], namespace: str):
 def build_typed_graph(snapshot: ClusterSnapshot) -> TypedGraph:
     b = _Builder()
     service_names = snapshot.service_names()
+    svc_set = set(service_names)
     for name in service_names:
         b.node(NodeType.SERVICE, name)
     cm_names = {c.get("metadata", {}).get("name", "") for c in snapshot.configmaps}
@@ -200,7 +204,7 @@ def build_typed_graph(snapshot: ClusterSnapshot) -> TypedGraph:
 
         _scan_containers(
             b, widx, wname, tspec.get("containers", []) or [],
-            cm_names, sec_names, service_names, snapshot.namespace,
+            cm_names, sec_names, svc_set, snapshot.namespace,
         )
 
     # Pods restate their workload's template; scanning them too catches
@@ -217,7 +221,7 @@ def build_typed_graph(snapshot: ClusterSnapshot) -> TypedGraph:
             _volume_edges(b, widx, app, vol, cm_names, sec_names)
         _scan_containers(
             b, widx, app, pspec.get("containers", []) or [],
-            cm_names, sec_names, service_names, snapshot.namespace,
+            cm_names, sec_names, svc_set, snapshot.namespace,
         )
 
     # ROUTES: ingress backends (missing backends recorded, reference:
@@ -230,7 +234,7 @@ def build_typed_graph(snapshot: ClusterSnapshot) -> TypedGraph:
                 svc = (((path.get("backend") or {}).get("service")) or {}).get("name")
                 if not svc:
                     continue
-                if svc in service_names:
+                if svc in svc_set:
                     b.edge(iidx, b.node(NodeType.SERVICE, svc), EdgeType.ROUTES)
                 else:
                     b.missing.append(
@@ -254,7 +258,7 @@ def _volume_edges(b: "_Builder", widx: int, wname: str, vol: dict,
 
 def _scan_containers(
     b: "_Builder", widx: int, wname: str, containers: list,
-    cm_names: set, sec_names: set, service_names: list, namespace: str,
+    cm_names: set, sec_names: set, svc_set: set, namespace: str,
 ) -> None:
     for c in containers:
         for ef in c.get("envFrom", []) or []:
@@ -279,7 +283,7 @@ def _scan_containers(
             value = env.get("value")
             if value:
                 for dep in _dns_service_names(
-                    str(value), service_names, namespace
+                    str(value), svc_set, namespace
                 ):
                     b.edge(widx, b.node(NodeType.SERVICE, dep),
                            EdgeType.DEPENDS_ON)

@@ -8,6 +8,7 @@ helpers shared by the engine, the trainer, and the driver's multi-chip dry
 run.
 """
 
+from rca_tpu.parallel.distributed import initialize_distributed
 from rca_tpu.parallel.mesh import make_mesh, make_multislice_mesh
 from rca_tpu.parallel.sharded import (
     ShardedGraph,
@@ -17,6 +18,7 @@ from rca_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "initialize_distributed",
     "make_mesh",
     "make_multislice_mesh",
     "ShardedGraph",

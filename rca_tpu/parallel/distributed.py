@@ -1,0 +1,110 @@
+"""Multi-host bootstrap: one call before building a cross-host mesh.
+
+The reference's "distributed backend" is HTTPS to the K8s API plus kubectl
+subprocesses (SURVEY.md §2.9 — no NCCL/MPI anywhere); the TPU-native
+equivalent is jax.distributed + XLA collectives, where every host runs the
+same program and the runtime wires ICI (intra-slice) and DCN (cross-slice /
+cross-host) underneath the mesh axes.  This module owns the one impure
+step — process bootstrap — so the rest of :mod:`rca_tpu.parallel` stays
+pure mesh/shard_map code.
+
+Usage on a TPU pod (each host)::
+
+    from rca_tpu.parallel import initialize_distributed, make_mesh
+    info = initialize_distributed()          # auto-detects on TPU pods
+    mesh = make_mesh([("dp", 4), ("sp", 2)]) # jax.devices() is now global
+
+On CPU/GPU clusters pass coordinator_address/num_processes/process_id
+explicitly (or set JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID).  Single-process runs are a no-op: the helper never makes
+a laptop run worse.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Idempotently initialize jax.distributed and report the topology.
+
+    Returns ``{process_index, process_count, local_device_count,
+    global_device_count, initialized}`` — ``initialized`` is False when the
+    run is single-process and no coordinator was configured (nothing to
+    do), True when the distributed runtime is (or already was) up.
+    """
+    global _initialized
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    # TPU pods auto-detect all three through the TPU metadata server; only
+    # skip when nothing indicates a multi-process run at all.
+    on_tpu_pod = bool(
+        os.environ.get("TPU_WORKER_HOSTNAMES")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+
+    # recognize a runtime someone else already brought up, so a second
+    # bootstrap (ours or theirs) never re-initializes and raises
+    try:
+        from jax._src import distributed as _jdist
+
+        runtime_up = _jdist.global_state.client is not None
+    except Exception:  # pragma: no cover - private-API drift
+        runtime_up = False
+    _initialized = _initialized or runtime_up
+
+    if not _initialized and (coordinator_address is not None or on_tpu_pod):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+
+    if not _initialized:
+        # STRICT no-op: querying jax.process_index() here would initialize
+        # the backend and permanently foreclose a later real
+        # jax.distributed.initialize in this process.  Device counts are
+        # filled only when the backend is already up (then querying is
+        # harmless), else left None.
+        try:
+            from jax._src import xla_bridge as _xb
+
+            backend_up = bool(getattr(_xb, "_backends", None))
+        except Exception:  # pragma: no cover - private-API drift
+            backend_up = False
+        return {
+            "initialized": False,
+            "process_index": 0,
+            "process_count": 1,
+            "local_device_count": (
+                jax.local_device_count() if backend_up else None
+            ),
+            "global_device_count": (
+                jax.device_count() if backend_up else None
+            ),
+        }
+
+    return {
+        "initialized": True,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

@@ -143,3 +143,23 @@ def test_multislice_mesh_and_propagate():
     vals, idx = sharded_topk(mesh, scores, 3, batch_axes=("slice", "dp"))
     assert np.asarray(idx).shape == (B, 3)
     assert int(np.asarray(idx)[0, 0]) == top
+
+
+def test_initialize_distributed_single_process_noop(monkeypatch):
+    """Without a coordinator or TPU-pod env, the bootstrap must be a no-op
+    that still reports the (single-process) topology, and calling it twice
+    must be safe (idempotent by design, reference comparison: the reference
+    had no distributed runtime at all, SURVEY.md §2.9)."""
+    from rca_tpu.parallel import initialize_distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "TPU_WORKER_HOSTNAMES",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    info1 = initialize_distributed()
+    info2 = initialize_distributed()
+    assert info1["initialized"] is False
+    assert info1["process_count"] == 1
+    assert info1["process_index"] == 0
+    assert info1["local_device_count"] == info1["global_device_count"] > 0
+    assert info2 == info1
