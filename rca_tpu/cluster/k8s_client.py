@@ -108,32 +108,118 @@ class K8sApiClient:
         self._errors: List[Dict[str, str]] = []
         self._kubectl = shutil.which("kubectl")
         self._kubeconfig = kubeconfig or os.environ.get("KUBECONFIG")
-        if HAVE_K8S_LIB:
-            try:
-                if self._kubeconfig:
-                    k8s_config.load_kube_config(
-                        config_file=self._kubeconfig, context=context
-                    )
-                else:
-                    try:
-                        k8s_config.load_kube_config(context=context)
-                    except Exception:
-                        k8s_config.load_incluster_config()
-                if not verify_ssl:
-                    cfg = k8s_api.Configuration.get_default_copy()
-                    cfg.verify_ssl = False
-                    k8s_api.Configuration.set_default(cfg)
-                self._core = k8s_api.CoreV1Api()
-                self._apps = k8s_api.AppsV1Api()
-                self._net = k8s_api.NetworkingV1Api()
-                self._batch = k8s_api.BatchV1Api()
-                self._autoscaling = k8s_api.AutoscalingV1Api()
-                self._api_client = k8s_api.ApiClient()
-                # connection probe (reference: utils/k8s_client.py:139)
-                self._core.list_namespace(limit=1)
-                self._connected = True
-            except Exception:
-                self._connected = False
+        self._context = context
+        self._verify_ssl = verify_ssl
+        self._connect()
+
+    def _connect(self) -> None:
+        """(Re)build API clients and probe the connection."""
+        self._connected = False
+        if not HAVE_K8S_LIB:
+            return
+        try:
+            if self._kubeconfig:
+                k8s_config.load_kube_config(
+                    config_file=self._kubeconfig, context=self._context
+                )
+            else:
+                try:
+                    k8s_config.load_kube_config(context=self._context)
+                except Exception:
+                    k8s_config.load_incluster_config()
+            if not self._verify_ssl:
+                cfg = k8s_api.Configuration.get_default_copy()
+                cfg.verify_ssl = False
+                k8s_api.Configuration.set_default(cfg)
+            self._core = k8s_api.CoreV1Api()
+            self._apps = k8s_api.AppsV1Api()
+            self._net = k8s_api.NetworkingV1Api()
+            self._batch = k8s_api.BatchV1Api()
+            self._autoscaling = k8s_api.AutoscalingV1Api()
+            self._api_client = k8s_api.ApiClient()
+            # connection probe (reference: utils/k8s_client.py:139)
+            self._core.list_namespace(limit=1)
+            self._connected = True
+        except Exception as exc:
+            self._record_error("connect", f"{type(exc).__name__}: {exc}")
+            self._connected = False
+
+    def reload_config(self) -> bool:
+        """Re-read the kubeconfig and reconnect (reference:
+        utils/k8s_client.py:181 reload_config)."""
+        self._connect()
+        return self._connected
+
+    def update_server_url(self, new_server_url: str) -> bool:
+        """Rewrite the CURRENT context's cluster ``server`` and reconnect —
+        the endpoint-repair flow for tunneled clusters whose public URL
+        rotates (reference: components/sidebar.py:7-47 rewrote every
+        cluster; scoping to the active context keeps a multi-cluster
+        kubeconfig's other entries intact).  Honors the colon-separated
+        multi-file ``KUBECONFIG`` form by repairing the file that defines
+        the target cluster, and leaves a ``<file>.bak`` of the original."""
+        raw = self._kubeconfig or os.path.expanduser("~/.kube/config")
+        paths = [p for p in raw.split(os.pathsep) if p]
+        try:
+            import yaml
+
+            # pass 1 — merged view, the way the kubernetes lib reads the
+            # multi-file form: resolve the active context, then the cluster
+            # it points at, across ALL files
+            configs = []
+            for path in paths:
+                if not os.path.exists(path):
+                    continue
+                with open(path) as f:
+                    configs.append((path, yaml.safe_load(f) or {}))
+            ctx_name = self._context or next(
+                (c.get("current-context") for _, c in configs
+                 if c.get("current-context")), None,
+            )
+            target = next(
+                ((ctx.get("context") or {}).get("cluster")
+                 for _, c in configs
+                 for ctx in c.get("contexts", []) or []
+                 if ctx.get("name") == ctx_name),
+                None,
+            )
+            if target is None:
+                all_clusters = [
+                    cl for _, c in configs
+                    for cl in c.get("clusters", []) or []
+                ]
+                if len(all_clusters) == 1:
+                    target = all_clusters[0].get("name")
+
+            # pass 2 — rewrite the one file that defines the target cluster
+            for path, cfg in configs:
+                updated = False
+                for cluster in cfg.get("clusters", []) or []:
+                    if cluster.get("name") != target:
+                        continue
+                    inner = cluster.get("cluster")
+                    if isinstance(inner, dict) and "server" in inner:
+                        inner["server"] = new_server_url
+                        updated = True
+                if not updated:
+                    continue
+                original = open(path).read()
+                with open(path + ".bak", "w") as f:
+                    f.write(original)
+                with open(path, "w") as f:
+                    yaml.safe_dump(cfg, f, sort_keys=False)
+                return self.reload_config()
+            self._record_error(
+                "update_server_url",
+                f"no kubeconfig in {paths} defines the active context's "
+                "cluster (or has a server entry to rewrite)",
+            )
+            return False
+        except Exception as exc:
+            self._record_error(
+                "update_server_url", f"{type(exc).__name__}: {exc}"
+            )
+            return False
 
     # ---- helpers ---------------------------------------------------------
     def _sanitize(self, obj: Any) -> Any:

@@ -83,6 +83,24 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
             ("🟢 connected: " + client.get_cluster_info().get("name", ""))
             if connected else "🔴 no cluster — mock/offline mode"
         )
+        if not connected and hasattr(client, "update_server_url"):
+            # endpoint repair for tunneled clusters whose public URL rotated
+            # (reference: components/sidebar.py:160-189 ngrok repair flow)
+            with st.expander("Connection repair"):
+                new_url = st.text_input(
+                    "New API server URL", key="repair-url",
+                    placeholder="https://<tunnel-host>:443",
+                )
+                if st.button("Update kubeconfig & reconnect") and new_url:
+                    if client.update_server_url(new_url):
+                        st.success("Reconnected.")
+                        st.rerun()
+                    else:
+                        errs = client.get_cluster_info().get("errors", [])
+                        st.error(
+                            "Repair failed: "
+                            + (errs[-1]["error"] if errs else "unknown error")
+                        )
         namespaces = client.get_namespaces() or ["default"]
         namespace = st.selectbox("Namespace", namespaces)
         if st.button("New investigation"):
