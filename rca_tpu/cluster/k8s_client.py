@@ -203,9 +203,13 @@ class K8sApiClient:
                         updated = True
                 if not updated:
                     continue
-                original = open(path).read()
-                with open(path + ".bak", "w") as f:
-                    f.write(original)
+                # keep only the FIRST backup: a retry after a typo'd URL
+                # must not clobber the pristine original with the mangled
+                # intermediate
+                if not os.path.exists(path + ".bak"):
+                    original = open(path).read()
+                    with open(path + ".bak", "w") as f:
+                        f.write(original)
                 with open(path, "w") as f:
                     yaml.safe_dump(cfg, f, sort_keys=False)
                 return self.reload_config()

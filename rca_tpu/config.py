@@ -31,8 +31,16 @@ class RCAConfig:
     # Engine knobs
     propagation_steps: int = 8
     top_k_root_causes: int = 5
-    # Shape-bucket tiers for jit recompilation control (padded node counts)
-    shape_buckets: tuple = (64, 256, 1024, 4096, 16384, 65536)
+    # Shape-bucket tiers for jit recompilation control (padded node AND
+    # edge counts).  Explicit power-of-two tiers up to 4096; above, sizes
+    # round up to 8 sub-tiers per octave (bucket_for), because the
+    # down-scan scatter serializes over the PADDED edge count (~33 ns/lane
+    # on v5e, PERF.md): the round-1 4x tiers made a 10k-service graph pay
+    # a 65536-lane scatter for ~20k real edges (3.3x waste), and a plain
+    # pow2 ladder padded 50k's ~100k edges to 131072 (+31%, measured +20ms
+    # per inference).  Relative tiers cap waste at 12.5% at any scale for
+    # a bounded executable count (8 per octave).
+    shape_buckets: tuple = (64, 128, 256, 512, 1024, 2048, 4096)
 
     def __post_init__(self):
         if self.backend not in VALID_BACKENDS:
@@ -53,8 +61,16 @@ class RCAConfig:
 
 
 def bucket_for(n: int, buckets) -> int:
-    """Smallest shape bucket ≥ n (controls jit recompilation)."""
+    """Smallest shape bucket ≥ n (controls jit recompilation).
+
+    Within ``buckets``: the explicit tier list.  Beyond it: round up to the
+    next multiple of an eighth of n's power-of-two octave — relative
+    padding ≤ 12.5% with at most 8 executables per octave, vs the pow2
+    ladder's 2x worst case (which is real money when a scatter serializes
+    over every padded lane)."""
     for b in buckets:
         if n <= b:
             return b
-    return int(n)
+    n = int(n)
+    quantum = max(1 << (n.bit_length() - 1), 8) // 8
+    return ((n + quantum - 1) // quantum) * quantum

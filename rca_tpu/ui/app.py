@@ -83,7 +83,15 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
             ("🟢 connected: " + client.get_cluster_info().get("name", ""))
             if connected else "🔴 no cluster — mock/offline mode"
         )
-        if not connected and hasattr(client, "update_server_url"):
+        # gate on the REAL API probe, not is_connected(): the latter is
+        # True whenever kubectl is merely installed, which is exactly the
+        # degraded state the repair flow exists for
+        api_connected = bool(
+            client.get_cluster_info().get("connected", connected)
+        )
+        if st.session_state.pop("repair-ok", False):
+            st.success("Kubeconfig updated — reconnected.")
+        if not api_connected and hasattr(client, "update_server_url"):
             # endpoint repair for tunneled clusters whose public URL rotated
             # (reference: components/sidebar.py:160-189 ngrok repair flow)
             with st.expander("Connection repair"):
@@ -93,7 +101,8 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                 )
                 if st.button("Update kubeconfig & reconnect") and new_url:
                     if client.update_server_url(new_url):
-                        st.success("Reconnected.")
+                        # flag survives the rerun; success renders above
+                        st.session_state["repair-ok"] = True
                         st.rerun()
                     else:
                         errs = client.get_cluster_info().get("errors", [])

@@ -318,3 +318,23 @@ def test_reload_config_reports_connection_state(tmp_path):
     assert client.reload_config() is False
     # disconnected client stays usable: getters degrade to empty
     assert client.get_pods("default") == []
+
+
+def test_update_server_url_retry_preserves_first_backup(tmp_path):
+    """A second repair (e.g. after a typo'd URL) must not clobber the
+    pristine backup with the mangled intermediate."""
+    import yaml
+
+    path = tmp_path / "kc.yaml"
+    path.write_text(yaml.safe_dump({
+        "current-context": "dev",
+        "contexts": [{"name": "dev", "context": {"cluster": "c1"}}],
+        "clusters": [{"name": "c1",
+                      "cluster": {"server": "https://original:6443"}}],
+    }))
+    client = K8sApiClient(kubeconfig=str(path))
+    client.update_server_url("https://typo:443")
+    client.update_server_url("https://corrected:443")
+    backup = yaml.safe_load((tmp_path / "kc.yaml.bak").read_text())
+    assert backup["clusters"][0]["cluster"]["server"] == "https://original:6443"
+    assert "https://corrected:443" in path.read_text()
