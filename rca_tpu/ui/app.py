@@ -374,16 +374,21 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                     st.rerun()
 
         elif wiz["stage"] == 3:
-            report = coord.generate_root_cause_report(
-                {
-                    "component": wiz["component"],
-                    "accepted_hypothesis": wiz["hypothesis"],
-                    "steps": wiz["executed"],
-                    "finding": wiz["finding"],
-                }
-            )
-            st.markdown(report)
-            store.add_evidence(inv_id, "root_cause_report", report)
+            # generate + persist ONCE: streamlit reruns this block on every
+            # widget interaction, which would otherwise regenerate the
+            # report (an LLM call on non-offline backends) and rewrite the
+            # store file each time
+            if "report" not in wiz:
+                wiz["report"] = coord.generate_root_cause_report(
+                    {
+                        "component": wiz["component"],
+                        "accepted_hypothesis": wiz["hypothesis"],
+                        "steps": wiz["executed"],
+                        "finding": wiz["finding"],
+                    }
+                )
+                store.add_evidence(inv_id, "root_cause_report", wiz["report"])
+            st.markdown(wiz["report"])
             if st.button("Start a new investigation"):
                 st.session_state["wizard"] = {"stage": 0}
                 st.rerun()

@@ -141,3 +141,52 @@ def test_prompt_logger_llm_adapter(tmp_path):
     records = pl.read_all()
     assert records[0]["investigation_id"] == "inv1"
     assert records[0]["additional_context"]["provider"] == "offline"
+
+
+def test_recorded_investigation_fixture_resumes(tmp_path):
+    """Schema-stability oracle (reference kept logs/*.json as regression
+    fixtures, SURVEY.md §4 layer 4): a recorded investigation from an
+    earlier build must load in a fresh store and RESUME — list, render,
+    and continue with its accumulated findings feeding the next turn.  If
+    a schema change orphans old investigations, this is the test that
+    goes red."""
+    import os
+    import shutil
+
+    from rca_tpu.cluster.fixtures import five_service_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.coordinator import RCACoordinator
+
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "recorded_investigation.json"
+    )
+    root = tmp_path / "logs"
+    root.mkdir()
+    shutil.copy(fixture, root / "rec-0001-fixture.json")
+
+    store = InvestigationStore(root=str(root))
+    rows = store.list_investigations()
+    assert [r["id"] for r in rows] == ["rec-0001-fixture"]
+    inv = store.get_investigation("rec-0001-fixture")
+    # full recorded surface is intact
+    assert inv["title"] == "Database crash loop"
+    assert inv["namespace"] == "test-microservices"
+    assert len(inv["conversation"]) == 2
+    assert inv["conversation"][1]["content"]["response_data"]["points"]
+    assert inv["next_actions"] and inv["accumulated_findings"]
+    top = inv["agent_findings"]["comprehensive"]["root_causes"][0]
+    assert top["component"] == "database"
+
+    # resume: a follow-up turn consumes the recorded accumulated findings
+    coord = RCACoordinator(
+        MockClusterClient(five_service_world()), backend="deterministic"
+    )
+    out = coord.process_user_query(
+        "what should I fix first?", inv["namespace"],
+        previous_findings=inv["accumulated_findings"],
+    )
+    store.add_message("rec-0001-fixture", "user", "what should I fix first?")
+    store.add_message("rec-0001-fixture", "assistant",
+                      {"response_data": out["response_data"]})
+    resumed = store.get_investigation("rec-0001-fixture")
+    assert len(resumed["conversation"]) == 4
