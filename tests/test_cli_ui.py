@@ -247,3 +247,33 @@ def test_store_set_title(tmp_path):
     assert store.get_investigation(inv["id"])["title"] == (
         "database crash investigation"
     )
+
+
+def test_cli_bench_small(capsys):
+    rc, raw = run_cli(
+        capsys, "bench", "--services", "120", "--roots", "1", "--seed", "0"
+    )
+    assert rc == 0
+    out = json.loads(raw)
+    assert out["n_services"] == 120
+    assert out["latency_ms"] > 0
+    assert isinstance(out["top1_hit"], bool)
+    assert len(out["ranked"]) == 5
+
+
+def test_cli_train_tiny(capsys, tmp_path):
+    ckpt = str(tmp_path / "w")
+    rc, raw = run_cli(
+        capsys, "train", "--services", "48", "--cases", "4", "--iters", "3",
+        "--seed", "0", "--out", ckpt,
+    )
+    assert rc == 0
+    out = json.loads(raw)
+    assert out["final_loss"] > 0 and out["initial_loss"] > 0
+    assert out["checkpoint"] == ckpt
+    # the checkpoint round-trips into an engine
+    from rca_tpu.engine import GraphEngine
+    from rca_tpu.engine.train import load_params
+
+    engine = GraphEngine(params=load_params(ckpt))
+    assert 0.0 < engine.params.decay < 1.0

@@ -190,3 +190,13 @@ def test_recorded_investigation_fixture_resumes(tmp_path):
                       {"response_data": out["response_data"]})
     resumed = store.get_investigation("rec-0001-fixture")
     assert len(resumed["conversation"]) == 4
+
+
+def test_delete_and_update_status(store):
+    inv = store.create_investigation("temp", namespace="x")
+    iid = inv["id"]
+    store.update_status(iid, "resolved")
+    assert store.get_investigation(iid)["status"] == "resolved"
+    assert store.delete_investigation(iid) is True
+    assert store.get_investigation(iid) is None
+    assert store.delete_investigation(iid) is False  # already gone
