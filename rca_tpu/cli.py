@@ -6,6 +6,8 @@
 - ``chat``      one chat turn (structured response + suggestions)
 - ``suggest``   execute one suggestion action
 - ``bench``     engine latency on a synthetic cascade
+- ``train``     fit propagation weights; save an orbax checkpoint
+- ``stream``    poll-driven live streaming analysis (1 Hz loop)
 - ``investigations``  list / show persisted investigations
 - ``ui``        launch the Streamlit app (when streamlit is installed)
 
@@ -150,6 +152,33 @@ def cmd_train(args) -> int:
     return 0
 
 
+def cmd_stream(args) -> int:
+    """Poll-driven live streaming: one JSON line per tick (engine/live.py;
+    BASELINE.md row 4's 1 Hz loop, runnable against a fixture or a live
+    cluster)."""
+    import time as _time
+
+    from rca_tpu.engine import LiveStreamingSession
+
+    client, ns = _make_client(args.fixture, args.seed)
+    namespace = args.namespace or ns or "default"
+    live = LiveStreamingSession(client, namespace, k=args.top)
+    for i in range(args.ticks):
+        out = live.poll()
+        print(json.dumps({
+            "tick": out["tick"],
+            "latency_ms": round(out["latency_ms"], 3),
+            "capture_ms": out["capture_ms"],
+            "changed_rows": out["changed_rows"],
+            "upload_rows": out["upload_rows"],
+            "resynced": out["resynced"],
+            "ranked": out["ranked"],
+        }, default=str), flush=True)
+        if args.interval > 0 and i + 1 < args.ticks:
+            _time.sleep(args.interval)
+    return 0
+
+
 def cmd_investigations(args) -> int:
     from rca_tpu.store import InvestigationStore
 
@@ -232,6 +261,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--roots", type=int, default=3)
     sp.add_argument("--seed", type=int, default=0)
     sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser(
+        "stream", help="poll-driven live streaming analysis (1 Hz loop)"
+    )
+    sp.add_argument("--fixture", default=None,
+                    help="5svc | <N>svc | live (default: live)")
+    sp.add_argument("--namespace", default=None)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--ticks", type=int, default=5)
+    sp.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between polls (0 = as fast as possible)")
+    sp.add_argument("--top", type=int, default=5)
+    sp.set_defaults(fn=cmd_stream)
 
     sp = sub.add_parser("train", help="fit propagation weights on "
                         "synthetic cascades; save an orbax checkpoint")

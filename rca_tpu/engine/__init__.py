@@ -23,7 +23,9 @@ from rca_tpu.engine.propagate import (
     propagate,
     propagate_jit,
 )
+from rca_tpu.engine.live import LiveStreamingSession
 from rca_tpu.engine.runner import EngineResult, GraphEngine
+from rca_tpu.engine.streaming import StreamingSession
 
 __all__ = [
     "PropagationParams",
@@ -32,4 +34,6 @@ __all__ = [
     "propagate_jit",
     "EngineResult",
     "GraphEngine",
+    "StreamingSession",
+    "LiveStreamingSession",
 ]

@@ -1,0 +1,114 @@
+"""Live streaming: cluster client → feature deltas → fused device tick.
+
+Closes the loop BASELINE.md row 4 implies (10k services, 1 Hz metric
+ticks): :class:`StreamingSession` keeps the feature matrix device-resident
+and re-ranks in one fused dispatch, but expects the caller to hand it row
+updates.  :class:`LiveStreamingSession` is that caller — it polls a
+``ClusterClient``, re-extracts the vectorized features (host-side numpy,
+~0.4 s at 10k services), diffs against the previous matrix, and uploads
+ONLY the changed rows.  The reference has no streaming mode at all; its
+closest analog is re-running a full analysis per chat turn (reference:
+agents/mcp_coordinator.py:624-665 re-fetches everything serially).
+
+Topology changes (services added/removed, dependency edges changed) force
+a session rebuild — edges are device-pinned for the session, so a changed
+graph is a new session, counted in ``resyncs``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+from rca_tpu.engine.runner import GraphEngine
+from rca_tpu.engine.streaming import StreamingSession
+from rca_tpu.features.extract import extract_features
+from rca_tpu.graph.build import service_dependency_edges
+
+
+class LiveStreamingSession:
+    """Poll-driven streaming analysis over a live (or mock) cluster."""
+
+    def __init__(
+        self,
+        client,
+        namespace: str,
+        k: int = 5,
+        engine: Optional[GraphEngine] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.k = k
+        self.engine = engine or GraphEngine()
+        self.resyncs = -1  # first _resync is initialization, not a resync
+        self._resync()
+
+    # -- topology (re)build -------------------------------------------------
+    def _resync(self, snap=None, fs=None, edges=None) -> None:
+        """Rebuild from an ALREADY-captured snapshot when the caller has
+        one (poll() detected the change on it) — re-capturing here would
+        sweep the cluster twice per resync tick and rebuild from different
+        state than the change-detection examined."""
+        if snap is None:
+            snap = ClusterSnapshot.capture(self.client, self.namespace)
+        if fs is None:
+            fs = extract_features(snap)
+        src, dst = edges if edges is not None else service_dependency_edges(
+            snap, fs
+        )
+        self._names = list(fs.service_names)
+        self._edge_key = (src.tobytes(), dst.tobytes())
+        self._features = np.array(fs.service_features, np.float32)
+        self.session = StreamingSession(
+            self._names, src, dst,
+            num_features=self._features.shape[1],
+            engine=self.engine, k=self.k,
+        )
+        self.session.set_all(self._features)
+        self.resyncs += 1
+
+    # -- one poll+tick ------------------------------------------------------
+    def poll(self) -> Dict[str, Any]:
+        """Capture → diff → delta upload → fused tick.
+
+        Returns the tick result plus ``changed_rows`` (real changed services
+        before padding), ``resynced`` (topology changed → full rebuild this
+        poll), and ``capture_ms`` (host-side snapshot+extract time)."""
+        t0 = time.perf_counter()
+        snap = ClusterSnapshot.capture(self.client, self.namespace)
+        fs = extract_features(snap)
+        resynced = False
+        edges = None
+        if list(fs.service_names) != self._names:
+            resynced = True
+        else:
+            edges = service_dependency_edges(snap, fs)
+            if (edges[0].tobytes(), edges[1].tobytes()) != self._edge_key:
+                resynced = True
+        if resynced:
+            self._resync(snap=snap, fs=fs, edges=edges)
+            capture_ms = (time.perf_counter() - t0) * 1e3
+            out = self.session.tick()
+            out.update(
+                changed_rows=len(self._names), resynced=True,
+                capture_ms=round(capture_ms, 2), resyncs=self.resyncs,
+            )
+            return out
+
+        new = np.asarray(fs.service_features, np.float32)
+        changed = np.flatnonzero(np.any(new != self._features, axis=1))
+        if len(changed):
+            self.session.update_many(
+                {int(i): new[i] for i in changed}
+            )
+            self._features[changed] = new[changed]
+        capture_ms = (time.perf_counter() - t0) * 1e3
+        out = self.session.tick()
+        out.update(
+            changed_rows=int(len(changed)), resynced=False,
+            capture_ms=round(capture_ms, 2), resyncs=self.resyncs,
+        )
+        return out

@@ -95,6 +95,7 @@ class StreamingSession:
         self._kk = min(k + 8, self._n_pad)
         self.ticks = 0
         self.last_upload_rows = 0  # padded rows uploaded by the last flush
+        self._bulk_upload = 0  # set by set_all; reported by the next tick
 
     # -- host-side incremental state --------------------------------------
     def update(self, service_index: int, features: np.ndarray) -> None:
@@ -107,11 +108,15 @@ class StreamingSession:
             self.update(i, f)
 
     def set_all(self, features: np.ndarray) -> None:
-        """Full re-upload (session start or resync) — the one bulk path."""
+        """Full re-upload (session start or resync) — the one bulk path.
+        The next tick reports the full padded matrix in ``upload_rows`` so
+        bandwidth accounting sees the most expensive upload of the session
+        instead of a zero."""
         f = np.zeros((self._n_pad, self._num_features), np.float32)
         f[: len(features)] = features
         self._features = jnp.asarray(f)
         self._pending.clear()
+        self._bulk_upload = self._n_pad
 
     # -- tick ---------------------------------------------------------------
     def tick(self) -> Dict[str, object]:
@@ -136,9 +141,12 @@ class StreamingSession:
             # only drop the deltas once the dispatch is accepted — a raise
             # above (fresh-tier compile failure) must leave them retryable
             self._pending.clear()
-            self.last_upload_rows = u_pad
+            # count a set_all that preceded this tick as well
+            self.last_upload_rows = u_pad + self._bulk_upload
+            self._bulk_upload = 0
         else:
-            self.last_upload_rows = 0
+            self.last_upload_rows = self._bulk_upload
+            self._bulk_upload = 0
             stacked, vals, idx = _propagate_ranked(
                 self._features, self._edges,
                 self.engine._aw, self.engine._hw,

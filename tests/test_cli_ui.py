@@ -277,3 +277,17 @@ def test_cli_train_tiny(capsys, tmp_path):
 
     engine = GraphEngine(params=load_params(ckpt))
     assert 0.0 < engine.params.decay < 1.0
+
+
+def test_cli_stream_fixture(capsys):
+    code = main([
+        "stream", "--fixture", "50svc", "--ticks", "2", "--interval", "0",
+        "--top", "3",
+    ])
+    assert code == 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["tick"] == 1 and lines[1]["tick"] == 2
+    assert lines[1]["changed_rows"] == 0  # frozen fixture: steady state
+    assert lines[0]["ranked"][0]["component"].startswith("svc-")

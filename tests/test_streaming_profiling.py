@@ -54,7 +54,8 @@ def test_streaming_delta_uploads_proportional_and_exact():
     )
     sess.set_all(case.features)
     first = sess.tick()
-    assert first["upload_rows"] == 0  # set_all is the bulk path, not a delta
+    # the bulk set_all upload is accounted on its first tick
+    assert first["upload_rows"] == sess._n_pad
 
     # quiet tick: no host->device rows at all
     assert sess.tick()["upload_rows"] == 0
@@ -149,3 +150,106 @@ def test_wizard_stage_markdown():
     md = wizard_stage_markdown({"stage": 2})
     assert "▶️ Investigate" in md
     assert md.count("✅") == 2
+
+
+def test_live_streaming_session_tracks_world_changes():
+    """Cluster → feature diff → delta upload → fused tick: a healthy world
+    polls with zero changed rows; injecting a crash re-ranks the crashed
+    service to the top with only the changed rows uploaded; fixing it
+    drops it back."""
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.world import waiting_status
+    from rca_tpu.engine import LiveStreamingSession
+
+    world = synthetic_cascade_world(40, n_roots=1, seed=3,
+                                    namespace="stream")
+    client = MockClusterClient(world)
+    live = LiveStreamingSession(client, "stream", k=3)
+    root = world.ground_truth["fault_roots"][0]
+
+    out1 = live.poll()
+    assert out1["resynced"] is False
+    assert out1["changed_rows"] == 0  # frozen world: nothing changed
+    assert out1["ranked"][0]["component"] == root
+
+    # victim pod of a previously-healthy service starts crash-looping
+    victim_svc = next(
+        n for n in live._names if n != root and not n.startswith(root)
+    )
+    pod = next(
+        p for p in world.pods["stream"]
+        if p["metadata"]["labels"].get("app") == victim_svc
+    )
+    pod["status"]["phase"] = "Running"
+    pod["status"]["containerStatuses"] = [
+        waiting_status(victim_svc, "CrashLoopBackOff",
+                       restarts=9, last_exit_code=1)
+    ]
+    out2 = live.poll()
+    assert out2["resynced"] is False
+    assert 1 <= out2["changed_rows"] <= 3  # only the mutated service moved
+    assert out2["upload_rows"] >= out2["changed_rows"]
+    top2 = {r["component"] for r in out2["ranked"][:2]}
+    assert victim_svc in top2 and root in top2
+
+    # revert: the service heals, ranking recovers
+    pod["status"]["containerStatuses"] = [
+        {"name": victim_svc, "ready": True, "restartCount": 0,
+         "state": {"running": {}}}
+    ]
+    out3 = live.poll()
+    assert out3["ranked"][0]["component"] == root
+    assert victim_svc not in {r["component"] for r in out3["ranked"][:1]}
+
+
+def test_live_streaming_session_resyncs_on_topology_change():
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.world import make_deployment
+    from rca_tpu.engine import LiveStreamingSession
+
+    world = five_service_world()
+    client = MockClusterClient(world)
+    live = LiveStreamingSession(client, NS, k=3)
+    assert live.resyncs == 0
+    n0 = len(live._names)
+
+    # a brand-new service appears -> topology changed -> full rebuild
+    world.services[NS].append({
+        "metadata": {"name": "newsvc", "namespace": NS},
+        "spec": {"selector": {"app": "newsvc"},
+                 "ports": [{"port": 80}]},
+    })
+    world.deployments[NS].append(make_deployment("newsvc", NS, "newsvc"))
+    out = live.poll()
+    assert out["resynced"] is True
+    assert live.resyncs == 1
+    assert len(live._names) == n0 + 1
+    assert out["ranked"]  # still ranks after the rebuild
+
+
+def test_set_all_upload_accounted_on_next_tick():
+    """A resync's bulk upload must show up in upload_rows, not read as 0
+    (bandwidth accounting would otherwise miss the most expensive upload
+    of the session)."""
+    import numpy as np
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine.streaming import StreamingSession
+
+    sk = synthetic_cascade_arrays(30, n_roots=1, seed=0)
+    sess = StreamingSession(
+        [f"s{i}" for i in range(sk.n)], sk.dep_src, sk.dep_dst,
+        num_features=sk.features.shape[1], k=3,
+    )
+    sess.set_all(sk.features)
+    out = sess.tick()
+    assert out["upload_rows"] == sess._n_pad  # the bulk path, once
+    out = sess.tick()
+    assert out["upload_rows"] == 0  # steady state
+    # set_all followed by a delta before the tick: both counted
+    sess.set_all(sk.features)
+    sess.update(0, np.zeros(sk.features.shape[1], np.float32))
+    out = sess.tick()
+    assert out["upload_rows"] == sess._n_pad + 1
