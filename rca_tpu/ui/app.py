@@ -138,8 +138,8 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
     investigation = store.get_investigation(inv_id) or {}
 
     st.title("Kubernetes Root Cause Analysis")
-    tab_chat, tab_report, tab_topology, tab_wizard = st.tabs(
-        ["Chat", "Report", "Topology", "Investigate"]
+    tab_chat, tab_report, tab_topology, tab_wizard, tab_stream = st.tabs(
+        ["Chat", "Report", "Topology", "Investigate", "Stream"]
     )
 
     # ---- chat tab (reference: chatbot_interface.py) ----------------------
@@ -392,6 +392,74 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
             if st.button("Start a new investigation"):
                 st.session_state["wizard"] = {"stage": 0}
                 st.rerun()
+
+    # ---- live streaming tab (engine/live.py; no reference equivalent) ----
+    with tab_stream:
+        _render_stream_tab(st, client, namespace)
+
+
+def _render_stream_tab(st, client, namespace) -> None:
+    """Live streaming surface over engine/live.py: each poll diffs the
+    cluster against the device-resident features and re-ranks in one fused
+    dispatch (no reference equivalent — its closest analog re-ran a full
+    analysis per chat turn).
+
+    Auto-poll runs as a scoped ``st.fragment(run_every=...)`` so only this
+    tab's body re-executes on the timer — a top-level sleep+rerun loop
+    would block every widget in the app for the poll interval and hit the
+    cluster API from the sidebar on each cycle."""
+    auto = bool(st.session_state.get("stream-auto"))
+    if hasattr(st, "fragment"):
+        st.fragment(run_every="2s" if auto else None)(
+            lambda: _stream_tab_body(st, client, namespace)
+        )()
+    else:
+        _stream_tab_body(st, client, namespace)
+
+
+def _stream_tab_body(st, client, namespace) -> None:
+    from rca_tpu.engine import LiveStreamingSession
+
+    sess_key = f"live-stream-{namespace}"
+    if st.button("Start / reset stream"):
+        # one live session at a time: every stream pins a device-resident
+        # feature matrix + edge arrays, so drop any other namespace's
+        for key in [k for k in st.session_state
+                    if str(k).startswith("live-stream-")]:
+            del st.session_state[key]
+        st.session_state[sess_key] = {
+            "live": LiveStreamingSession(client, namespace, k=8),
+            "history": [],
+        }
+    state = st.session_state.get(sess_key)
+    if not state:
+        st.info("Start the stream to rank root causes continuously; each "
+                "poll uploads only the services whose signals changed.")
+        return
+    auto = st.checkbox("Auto-poll every 2 s", value=False, key="stream-auto")
+    if st.button("Poll now") or auto:
+        out = state["live"].poll()
+        state["history"].append({
+            "tick": out["tick"],
+            "latency_ms": round(out["latency_ms"], 1),
+            "capture_ms": out["capture_ms"],
+            "changed_rows": out["changed_rows"],
+            "upload_rows": out["upload_rows"],
+            "resynced": out["resynced"],
+            "top": (out["ranked"][0]["component"]
+                    if out["ranked"] else "—"),
+        })
+        state["history"] = state["history"][-50:]
+        st.markdown(
+            f"**Top root causes** (tick {out['tick']}, "
+            f"{out['changed_rows']} changed, "
+            f"{'resynced, ' if out['resynced'] else ''}"
+            f"{out['latency_ms']:.0f} ms)"
+        )
+        st.dataframe(out["ranked"])
+    if state["history"]:
+        st.caption("Tick history (newest last)")
+        st.dataframe(state["history"])
 
 
 if __name__ == "__main__":  # pragma: no cover

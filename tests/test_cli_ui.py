@@ -291,3 +291,72 @@ def test_cli_stream_fixture(capsys):
     assert lines[0]["tick"] == 1 and lines[1]["tick"] == 2
     assert lines[1]["changed_rows"] == 0  # frozen fixture: steady state
     assert lines[0]["ranked"][0]["component"].startswith("svc-")
+
+
+def test_stream_tab_renders_with_fake_streamlit():
+    """The Stream tab's logic is streamlit-free enough to drive with a
+    scripted stand-in: start resets the session, a poll renders the ranked
+    table, and history accumulates."""
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.ui.app import _render_stream_tab
+
+    class FakeSt:
+        def __init__(self, buttons):
+            self.session_state = {}
+            self._buttons = buttons  # label -> bool
+            self.dataframes = []
+            self.markdowns = []
+            self.infos = []
+
+        def button(self, label):
+            return self._buttons.get(label, False)
+
+        def checkbox(self, label, value=False, key=None):
+            return False
+
+        def dataframe(self, data):
+            self.dataframes.append(data)
+
+        def markdown(self, text):
+            self.markdowns.append(text)
+
+        def caption(self, text):
+            pass
+
+        def info(self, text):
+            self.infos.append(text)
+
+        def rerun(self):
+            raise AssertionError("rerun must not fire without auto-poll")
+
+    client = MockClusterClient(five_service_world())
+
+    # no session yet -> the tab explains itself and renders nothing else
+    st = FakeSt({})
+    _render_stream_tab(st, client, NS)
+    assert st.infos and not st.dataframes
+
+    # start + poll in one pass: ranked table + history render
+    st = FakeSt({"Start / reset stream": True, "Poll now": True})
+    _render_stream_tab(st, client, NS)
+    assert len(st.dataframes) == 2  # ranked + history
+    ranked = st.dataframes[0]
+    assert ranked[0]["component"] == "database"
+    history = st.dataframes[1]
+    assert history[0]["tick"] == 1 and history[0]["top"] == "database"
+
+    # second poll reuses the session and extends history
+    state_key = f"live-stream-{NS}"
+    st2 = FakeSt({"Poll now": True})
+    st2.session_state = st.session_state
+    _render_stream_tab(st2, client, NS)
+    assert st2.session_state[state_key]["history"][-1]["tick"] == 2
+
+    # starting a stream for another namespace evicts the old session (each
+    # one pins device-resident buffers)
+    st3 = FakeSt({"Start / reset stream": True})
+    st3.session_state = st2.session_state
+    _render_stream_tab(st3, client, "other-ns")
+    assert state_key not in st3.session_state
+    assert "live-stream-other-ns" in st3.session_state
