@@ -59,10 +59,12 @@ class ClusterSnapshot:
         only the first 5 pods' logs (reference: mcp_coordinator.py:396-409)
         and could miss the faulty pod entirely.
         """
+        from rca_tpu.cluster.sanitize import sanitize_objects
+
         # drain stale errors so this snapshot reports only ITS failures
         if hasattr(client, "collect_errors"):
             client.collect_errors()
-        pods = client.get_pods(namespace)
+        pods = sanitize_objects(client.get_pods(namespace))
         logs: Dict[str, Dict[str, str]] = {}
         pods_for_logs = _prioritize_pods_for_logs(pods, max_log_pods)
         for pod in pods_for_logs:
@@ -91,27 +93,28 @@ class ClusterSnapshot:
             except Exception:
                 traces = {}
 
+        san = sanitize_objects
         return cls(
             namespace=namespace,
             captured_at=client.get_current_time(),
             pods=pods,
-            deployments=client.get_deployments(namespace),
-            statefulsets=client.get_statefulsets(namespace),
-            daemonsets=client.get_daemonsets(namespace),
-            cronjobs=client.get_cronjobs(namespace),
-            services=client.get_services(namespace),
-            endpoints=client.get_endpoints(namespace),
-            ingresses=client.get_ingresses(namespace),
-            network_policies=client.get_network_policies(namespace),
-            configmaps=client.get_configmaps(namespace),
-            secrets=client.get_secrets(namespace),
-            pvcs=client.get_pvcs(namespace),
-            resource_quotas=client.get_resource_quotas(namespace),
-            hpas=client.get_hpas(namespace),
-            nodes=client.get_nodes(),
-            node_metrics=client.get_node_metrics(),
-            pod_metrics=client.get_pod_metrics(namespace),
-            events=client.get_events(namespace),
+            deployments=san(client.get_deployments(namespace)),
+            statefulsets=san(client.get_statefulsets(namespace)),
+            daemonsets=san(client.get_daemonsets(namespace)),
+            cronjobs=san(client.get_cronjobs(namespace)),
+            services=san(client.get_services(namespace)),
+            endpoints=san(client.get_endpoints(namespace)),
+            ingresses=san(client.get_ingresses(namespace)),
+            network_policies=san(client.get_network_policies(namespace)),
+            configmaps=san(client.get_configmaps(namespace)),
+            secrets=san(client.get_secrets(namespace)),
+            pvcs=san(client.get_pvcs(namespace)),
+            resource_quotas=san(client.get_resource_quotas(namespace)),
+            hpas=san(client.get_hpas(namespace)),
+            nodes=san(client.get_nodes()),
+            node_metrics=client.get_node_metrics() or {},
+            pod_metrics=client.get_pod_metrics(namespace) or {},
+            events=san(client.get_events(namespace)),
             logs=logs,
             traces=traces,
             errors=(

@@ -407,8 +407,12 @@ def _render_stream_tab(st, client, namespace) -> None:
     Auto-poll runs as a scoped ``st.fragment(run_every=...)`` so only this
     tab's body re-executes on the timer — a top-level sleep+rerun loop
     would block every widget in the app for the poll interval and hit the
-    cluster API from the sidebar on each cycle."""
-    auto = bool(st.session_state.get("stream-auto"))
+    cluster API from the sidebar on each cycle.  The checkbox that arms
+    the timer lives OUTSIDE the fragment: toggling it must trigger a full
+    rerun so the fragment is re-registered with the new ``run_every``
+    (from inside, the toggle would only rerun the fragment body and the
+    old timer would stay armed)."""
+    auto = st.checkbox("Auto-poll every 2 s", value=False, key="stream-auto")
     if hasattr(st, "fragment"):
         st.fragment(run_every="2s" if auto else None)(
             lambda: _stream_tab_body(st, client, namespace)
@@ -436,8 +440,7 @@ def _stream_tab_body(st, client, namespace) -> None:
         st.info("Start the stream to rank root causes continuously; each "
                 "poll uploads only the services whose signals changed.")
         return
-    auto = st.checkbox("Auto-poll every 2 s", value=False, key="stream-auto")
-    if st.button("Poll now") or auto:
+    if st.button("Poll now") or st.session_state.get("stream-auto"):
         out = state["live"].poll()
         state["history"].append({
             "tick": out["tick"],

@@ -253,3 +253,26 @@ def test_set_all_upload_accounted_on_next_tick():
     sess.update(0, np.zeros(sk.features.shape[1], np.float32))
     out = sess.tick()
     assert out["upload_rows"] == sess._n_pad + 1
+
+
+def test_live_streaming_edge_only_change_caught_by_periodic_check():
+    """Same service set, new dependency edge: caught within
+    topology_check_every polls (the cheap name check can't see it)."""
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine import LiveStreamingSession
+
+    world = five_service_world()
+    client = MockClusterClient(world)
+    live = LiveStreamingSession(client, NS, k=3, topology_check_every=2)
+
+    # add a dependency edge without changing the service set: frontend's
+    # traces now report a call into resource-service
+    world.traces["dependencies"][NS]["frontend"] = list(
+        world.traces["dependencies"][NS].get("frontend", [])
+    ) + ["resource-service"]
+    out1 = live.poll()  # poll 1: no edge check scheduled
+    assert out1["resynced"] is False
+    out2 = live.poll()  # poll 2: periodic edge check fires
+    assert out2["resynced"] is True
+    assert live.resyncs == 1
