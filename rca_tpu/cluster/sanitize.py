@@ -101,15 +101,22 @@ def sanitize_object(obj: Any, parent_key: str = "") -> Any:
             }
         out = None  # allocated only when something changes
         for k, v in obj.items():
-            nv = sanitize_object(v, k)
+            # "status" is a DICT at object top level (pod.status) but a
+            # STRING inside condition entries ({type, status: "True"});
+            # strip the key context there so neither the None branch nor
+            # the dict coercion below wipes a legitimate string
+            child_key = (
+                "" if (parent_key == "conditions" and k == "status") else k
+            )
+            nv = sanitize_object(v, child_key)
             if nv is None:
-                if k in _INT_KEYS:
+                if child_key in _INT_KEYS:
                     nv = 0
-                elif k in _STR_KEYS:
+                elif child_key in _STR_KEYS:
                     nv = ""
-            elif k in _DICT_KEYS and nv.__class__ is not dict:
+            elif child_key in _DICT_KEYS and nv.__class__ is not dict:
                 nv = {}
-            elif k in _LIST_KEYS and nv.__class__ is not list:
+            elif child_key in _LIST_KEYS and nv.__class__ is not list:
                 nv = []
             if nv is not v:
                 if out is None:
@@ -156,13 +163,25 @@ def sanitize_object(obj: Any, parent_key: str = "") -> Any:
     return obj
 
 
+def _native_sanitize():
+    """The C extension twin (rca_tpu/native/sanitizec.c), or None.  Same
+    walk in C: ~20x faster on the 1.2M-node sanitize of a 10k-pod
+    snapshot.  Exact parity is enforced by tests/test_native.py; the
+    Python implementation above is the spec."""
+    from rca_tpu.native import load_sanitize
+
+    return load_sanitize()
+
+
 def sanitize_objects(items: List[dict]) -> List[dict]:
     """Normalize a collection; drops entries that are not dicts at all."""
+    native = _native_sanitize()
+    san = native.sanitize_object if native is not None else sanitize_object
     out = []
     for item in items or []:
         if not isinstance(item, dict):
             continue
-        clean = sanitize_object(item)
+        clean = san(item)
         # every top-level object gets a metadata dict with a name
         md = clean.get("metadata")
         if not isinstance(md, dict):

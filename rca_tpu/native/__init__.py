@@ -106,10 +106,14 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 
 
-def _build_library() -> Optional[Path]:
-    """Compile logscan.cpp into a cached .so; None when no toolchain."""
+def _compile_cached(source: Path, out_prefix: str,
+                    extra_flags: List[str]) -> Optional[Path]:
+    """Shared lazy-compile pipeline: hash-tagged cache under
+    RCA_NATIVE_CACHE, pid-suffixed tmp + atomic rename, g++; None when the
+    source or toolchain is unavailable.  Used by both the ctypes log
+    scanner and the sanitize CPython extension."""
     try:
-        src = _SOURCE.read_bytes()
+        src = source.read_bytes()
     except OSError:
         return None
     tag = hashlib.sha256(src).hexdigest()[:16]
@@ -118,20 +122,29 @@ def _build_library() -> Optional[Path]:
                        os.path.join(tempfile.gettempdir(), "rca_tpu_native"))
     )
     cache_dir.mkdir(parents=True, exist_ok=True)
-    out = cache_dir / f"liblogscan-{tag}.so"
+    out = cache_dir / f"{out_prefix}-{tag}.so"
     if out.exists():
         return out
     tmp = out.with_suffix(f".{os.getpid()}.tmp.so")
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-           str(_SOURCE), "-o", str(tmp)]
+    cmd = (["g++", "-O2", "-shared", "-fPIC"] + extra_flags
+           + [str(source), "-o", str(tmp)])
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
         return None
     if proc.returncode != 0:
+        try:
+            tmp.unlink(missing_ok=True)
+        except OSError:
+            pass
         return None
     os.replace(tmp, out)
     return out
+
+
+def _build_library() -> Optional[Path]:
+    """Compile logscan.cpp into a cached .so; None when no toolchain."""
+    return _compile_cached(_SOURCE, "liblogscan", ["-std=c++17"])
 
 
 def load_native() -> Optional[ctypes.CDLL]:
@@ -179,3 +192,54 @@ def scan_text_native(text: str) -> Optional[np.ndarray]:
     if rc != 0:
         return None
     return np.asarray(list(counts), dtype=np.int32)
+
+
+# ---- native sanitizer (CPython extension; see sanitizec.c) ---------------
+
+_SAN_SOURCE = Path(__file__).with_name("sanitizec.c")
+_san_mod = None
+_san_load_attempted = False
+
+
+def _build_sanitize_ext() -> Optional[Path]:
+    """Compile sanitizec.c into a cached extension .so; None w/o toolchain."""
+    import sysconfig
+
+    include = sysconfig.get_paths().get("include")
+    if not include or not os.path.exists(os.path.join(include, "Python.h")):
+        return None
+    return _compile_cached(
+        _SAN_SOURCE, "sanitizec", ["-x", "c", f"-I{include}"]
+    )
+
+
+def load_sanitize():
+    """The native sanitize extension module, or None (disabled/unbuildable).
+
+    Extension modules must be loaded through importlib's machinery (they
+    export PyInit_*, not a C ABI), so this is not a ctypes load like the
+    log scanner's."""
+    global _san_mod, _san_load_attempted
+    if _san_load_attempted:
+        return _san_mod
+    _san_load_attempted = True
+    if os.environ.get("RCA_NATIVE_SANITIZE", "auto") == "0":
+        return None
+    path = _build_sanitize_ext()
+    if path is None:
+        return None
+    try:
+        import importlib.machinery
+        import importlib.util
+
+        # the module name MUST match the C PyInit_<name> symbol
+        loader = importlib.machinery.ExtensionFileLoader(
+            "sanitizec", str(path)
+        )
+        spec = importlib.util.spec_from_loader("sanitizec", loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        _san_mod = mod
+    except Exception:
+        _san_mod = None
+    return _san_mod

@@ -108,3 +108,66 @@ def test_native_is_faster_on_bulk_logs():
     python_s = time.perf_counter() - t0
     # conservative bound to avoid flakiness; typical speedup is ~5-15x
     assert native_s < python_s, (native_s, python_s)
+
+
+def test_native_sanitize_exact_parity_with_python():
+    """The C sanitizer must produce DEEP-EQUAL output to the Python spec on
+    fuzzed K8s objects (the Python implementation is the contract; any
+    divergence is a bug in sanitizec.c)."""
+    import copy
+    import random
+
+    import pytest
+
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.sanitize import sanitize_object
+    from rca_tpu.native import load_sanitize
+
+    native = load_sanitize()
+    if native is None:
+        pytest.skip("no toolchain / native sanitize disabled")
+
+    def mangle(obj, rng):
+        if isinstance(obj, dict):
+            for k in list(obj):
+                r = rng.random()
+                if r < 0.1:
+                    del obj[k]
+                elif r < 0.18:
+                    obj[k] = None
+                elif r < 0.2:
+                    obj[k] = 123  # wrong scalar type
+                else:
+                    mangle(obj[k], rng)
+        elif isinstance(obj, list):
+            for i, item in enumerate(obj):
+                if rng.random() < 0.06:
+                    obj[i] = None
+                else:
+                    mangle(item, rng)
+
+    world = five_service_world()
+    objects = (
+        world.pods[NS] + world.services[NS] + world.deployments[NS]
+        + world.events[NS] + world.endpoints[NS] + world.hpas[NS]
+        + world.ingresses[NS] + world.network_policies[NS]
+    )
+    checked = 0
+    for seed in range(30):
+        rng = random.Random(seed)
+        for obj in copy.deepcopy(objects):
+            mangle(obj, rng)
+            py = sanitize_object(copy.deepcopy(obj))
+            c = native.sanitize_object(copy.deepcopy(obj))
+            assert c == py, f"seed {seed}: divergence on {obj!r:.300}"
+            checked += 1
+    assert checked > 500
+
+    # copy-on-write parity: a well-formed object passes through unchanged
+    good = {
+        "metadata": {"name": "x", "labels": {"app": "x"}},
+        "spec": {"containers": [{"name": "c", "env": [
+            {"name": "A", "value": "1"},
+        ]}]},
+    }
+    assert native.sanitize_object(good) is good

@@ -98,10 +98,39 @@ def test_sanitize_invariants():
     )
     assert svc["metadata"]["labels"] == {"app": "", "tier": "3"}
 
-    # well-formed objects pass through unchanged
+    # well-formed objects pass through unchanged — INCLUDING condition
+    # entries, whose "status" is a STRING ('True'/'False'), not the
+    # object-level status dict (a context-free coercion wiped these to {}
+    # and made every healthy node read as NotReady)
     good = {
         "metadata": {"name": "x", "labels": {"app": "x"}},
         "spec": {"containers": [{"name": "c", "image": "busybox"}]},
-        "status": {"phase": "Running", "containerStatuses": []},
+        "status": {
+            "phase": "Running", "containerStatuses": [],
+            "conditions": [
+                {"type": "Ready", "status": "True"},
+                {"type": "MemoryPressure", "status": "False"},
+            ],
+        },
     }
     assert sanitize_objects([good]) == [good]
+    # and a null condition status stays None (unknown), never becomes {}
+    cond = sanitize_object(
+        {"status": {"conditions": [{"type": "Ready", "status": None}]}}
+    )
+    assert cond["status"]["conditions"][0]["status"] is None
+
+
+def test_healthy_world_capture_uncorrupted():
+    """End-to-end guard for the conditions-status regression: capturing
+    the healthy fixture must keep node conditions verbatim and produce NO
+    node-condition findings from the events agent."""
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+
+    world = five_service_world()
+    snap = ClusterSnapshot.capture(MockClusterClient(world), NS)
+    for node in snap.nodes:
+        for cond in node.get("status", {}).get("conditions", []):
+            assert isinstance(cond.get("status"), (str, type(None))), cond
