@@ -3,7 +3,9 @@
 ``python -m rca_tpu <command>``:
 
 - ``analyze``   one agent or the comprehensive pipeline → findings JSON
-- ``chat``      one chat turn (structured response + suggestions)
+- ``chat``      one chat turn (structured response + suggestions);
+                ``--investigation`` persists the conversation
+- ``report``    comprehensive analysis as a markdown report
 - ``suggest``   execute one suggestion action
 - ``bench``     engine latency on a synthetic cascade
 - ``train``     fit propagation weights; save an orbax checkpoint
@@ -85,11 +87,63 @@ def cmd_analyze(args) -> int:
 
 
 def cmd_chat(args) -> int:
+    """One chat turn; with --investigation the turn is a persisted part of
+    that conversation — prior accumulated findings feed the prompt, and
+    the messages/suggestions/findings land back in the store (reference:
+    components/chatbot_interface.py persisted every turn; the CLI makes
+    that scriptable)."""
     coord, namespace = _coordinator(args)
-    out = coord.process_user_query(args.query, namespace)
+    store = inv = None
+    if args.investigation:
+        from rca_tpu.store import InvestigationStore
+
+        store = InvestigationStore(root=args.log_dir)
+        inv = store.get_investigation(args.investigation)
+        if inv is None and args.investigation != "new":
+            print(json.dumps(
+                {"error": f"no investigation {args.investigation}"}
+            ))
+            return 1
+        if inv is None:
+            inv = store.create_investigation(
+                args.query[:60], namespace=namespace
+            )
+    out = coord.process_user_query(
+        args.query, namespace,
+        previous_findings=(inv or {}).get("accumulated_findings"),
+    )
+    if store is not None:
+        iid = inv["id"]
+        first_turn = len(inv.get("conversation", [])) == 0
+        store.record_chat_turn(iid, args.query, out)
+        if first_turn:
+            store.set_title(
+                iid, coord.generate_summary_from_query(args.query, out)
+            )
+        out["investigation_id"] = iid
     if not args.full:
         out.pop("cluster_state", None)
     print(json.dumps(out, indent=None if args.compact else 2, default=str))
+    return 0
+
+
+def cmd_report(args) -> int:
+    """Comprehensive analysis rendered as the markdown report (reference:
+    components/report.py; scriptable here, e.g. for CI artifacts)."""
+    coord, namespace = _coordinator(args)
+    record = coord.run_analysis("comprehensive", namespace)
+    if record["status"] != "completed":
+        print(json.dumps({"error": record.get("error", "analysis failed")}))
+        return 1
+    from rca_tpu.ui.render import report_markdown
+
+    md = report_markdown(record["results"])
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md)
+        print(json.dumps({"written": args.out, "bytes": len(md)}))
+    else:
+        print(md)
     return 0
 
 
@@ -248,7 +302,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("chat", help="one chat turn")
     common(sp)
     sp.add_argument("query")
+    sp.add_argument("--investigation", default=None,
+                    help="persist the turn into this investigation id "
+                    "('new' creates one); prior findings feed the prompt")
     sp.set_defaults(fn=cmd_chat)
+
+    sp = sub.add_parser(
+        "report", help="comprehensive analysis as a markdown report"
+    )
+    common(sp)
+    sp.add_argument("--out", default=None,
+                    help="write the report here instead of stdout")
+    sp.set_defaults(fn=cmd_report)
 
     sp = sub.add_parser("suggest", help="execute one suggestion action")
     common(sp)

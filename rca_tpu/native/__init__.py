@@ -112,11 +112,18 @@ def _compile_cached(source: Path, out_prefix: str,
     RCA_NATIVE_CACHE, pid-suffixed tmp + atomic rename, g++; None when the
     source or toolchain is unavailable.  Used by both the ctypes log
     scanner and the sanitize CPython extension."""
+    import sysconfig
+
     try:
         src = source.read_bytes()
     except OSError:
         return None
-    tag = hashlib.sha256(src).hexdigest()[:16]
+    # the tag must bind the artifact to THIS interpreter's ABI: a CPython
+    # extension built under another Python would be dlopen'd from the
+    # shared cache and crash, not fall back (the ctypes logscan .so is
+    # ABI-independent but rides the same scheme harmlessly)
+    abi = sysconfig.get_config_var("SOABI") or "unknown-abi"
+    tag = hashlib.sha256(src + abi.encode()).hexdigest()[:16]
     cache_dir = Path(
         os.environ.get("RCA_NATIVE_CACHE",
                        os.path.join(tempfile.gettempdir(), "rca_tpu_native"))
@@ -130,16 +137,17 @@ def _compile_cached(source: Path, out_prefix: str,
            + [str(source), "-o", str(tmp)])
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if proc.returncode != 0:
+            return None
+        os.replace(tmp, out)
+        return out
     except (OSError, subprocess.TimeoutExpired):
         return None
-    if proc.returncode != 0:
+    finally:
         try:
-            tmp.unlink(missing_ok=True)
+            tmp.unlink(missing_ok=True)  # no-op when os.replace moved it
         except OSError:
             pass
-        return None
-    os.replace(tmp, out)
-    return out
 
 
 def _build_library() -> Optional[Path]:

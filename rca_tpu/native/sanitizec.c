@@ -74,7 +74,9 @@ static int in_set(const char *key, const char **set) {
  * (a lone-surrogate key sets a UnicodeEncodeError that MUST be cleared,
  * or the extension returns a value with an exception pending) */
 static const char *key_utf8(PyObject *k) {
-    if (!PyUnicode_CheckExact(k)) return NULL;
+    /* subclass-of-str keys must classify like the spec (frozenset
+     * membership is hash/eq based), so Check, not CheckExact */
+    if (!PyUnicode_Check(k)) return NULL;
     const char *s = PyUnicode_AsUTF8(k);
     if (s == NULL) PyErr_Clear();
     return s;

@@ -219,3 +219,21 @@ class InvestigationStore:
             )
 
         return self._update(investigation_id, mutate)
+
+    def record_chat_turn(
+        self, investigation_id: str, query: str, out: Dict[str, Any]
+    ) -> None:
+        """Persist one ``process_user_query`` turn — the single protocol
+        for what a turn writes (user + assistant messages, next actions,
+        accumulated findings), shared by the UI chat tab and the CLI's
+        ``chat --investigation`` so the two cannot drift."""
+        self.add_message(investigation_id, "user", query)
+        self.add_message(
+            investigation_id, "assistant",
+            {"response_data": out.get("response_data", {}),
+             "summary": out.get("summary", "")},
+        )
+        self.set_next_actions(investigation_id, out.get("suggestions", []))
+        self.add_accumulated_findings(
+            investigation_id, out.get("key_findings", [])
+        )

@@ -180,17 +180,10 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
             store.add_accumulated_findings(inv_id, out["key_findings"])
             st.rerun()
         elif query:
-            store.add_message(inv_id, "user", query)
             out = coord.process_user_query(
                 query, namespace, investigation.get("accumulated_findings")
             )
-            store.add_message(
-                inv_id, "assistant",
-                {"response_data": out["response_data"],
-                 "summary": out["summary"]},
-            )
-            store.set_next_actions(inv_id, out["suggestions"])
-            store.add_accumulated_findings(inv_id, out["key_findings"])
+            store.record_chat_turn(inv_id, query, out)
             if len(investigation.get("conversation", [])) == 0:
                 title = coord.generate_summary_from_query(query, out)
                 store.set_title(inv_id, title)

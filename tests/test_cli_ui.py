@@ -360,3 +360,58 @@ def test_stream_tab_renders_with_fake_streamlit():
     _render_stream_tab(st3, client, "other-ns")
     assert state_key not in st3.session_state
     assert "live-stream-other-ns" in st3.session_state
+
+
+def test_cli_chat_persists_into_investigation(capsys, tmp_path):
+    """A scriptable conversational loop: turn 1 creates the investigation,
+    turn 2 resumes it with the accumulated findings feeding the prompt."""
+    code, out = run_cli(
+        capsys, "chat", "--fixture", "5svc", "--compact",
+        "--log-dir", str(tmp_path), "--investigation", "new",
+        "what is broken?",
+    )
+    assert code == 0
+    turn1 = json.loads(out)
+    iid = turn1["investigation_id"]
+
+    code, out = run_cli(
+        capsys, "chat", "--fixture", "5svc", "--compact",
+        "--log-dir", str(tmp_path), "--investigation", iid,
+        "what should I fix first?",
+    )
+    assert code == 0
+    assert json.loads(out)["investigation_id"] == iid
+
+    from rca_tpu.store import InvestigationStore
+
+    inv = InvestigationStore(root=str(tmp_path)).get_investigation(iid)
+    assert len(inv["conversation"]) == 4
+    assert inv["accumulated_findings"]
+    assert inv["next_actions"]
+
+    # unknown id fails loudly
+    code, out = run_cli(
+        capsys, "chat", "--fixture", "5svc", "--compact",
+        "--log-dir", str(tmp_path), "--investigation", "nope", "hi",
+    )
+    assert code == 1 and "no investigation" in out
+
+
+def test_cli_report_markdown(capsys, tmp_path):
+    out_file = tmp_path / "report.md"
+    code, out = run_cli(
+        capsys, "report", "--fixture", "5svc", "--log-dir", str(tmp_path),
+        "--out", str(out_file),
+    )
+    assert code == 0
+    assert json.loads(out)["written"] == str(out_file)
+    md = out_file.read_text()
+    assert "Root Cause Analysis Report" in md
+    assert "database" in md
+
+    # stdout mode
+    code, out = run_cli(
+        capsys, "report", "--fixture", "5svc", "--log-dir", str(tmp_path),
+    )
+    assert code == 0
+    assert "Root Cause Analysis Report" in out
