@@ -88,3 +88,51 @@ def test_empty_graph():
     )
     assert res.score.max() == 0.0
     assert len(res.ranked) <= 4
+
+
+def test_propagation_permutation_equivariance():
+    """Relabeling services must relabel scores identically: scores[perm] of
+    the permuted problem == original scores.  Catches subtle indexing bugs
+    in any edge layout (gather/scatter index mixups survive value-level
+    tests because most entries look plausible)."""
+    import numpy as np
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine import GraphEngine
+
+    case = synthetic_cascade_arrays(150, n_roots=2, seed=9)
+    engine = GraphEngine()
+    base = engine.analyze_arrays(case.features, case.dep_src, case.dep_dst)
+
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(case.n)            # new_index = perm_pos of old
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(case.n)
+
+    f2 = case.features[perm]                  # row i now holds old perm[i]
+    src2 = inv[case.dep_src]
+    dst2 = inv[case.dep_dst]
+    out = engine.analyze_arrays(f2, src2, dst2)
+
+    np.testing.assert_allclose(out.score, base.score[perm], atol=1e-6)
+    np.testing.assert_allclose(out.impact, base.impact[perm], atol=1e-5)
+    np.testing.assert_allclose(out.upstream, base.upstream[perm], atol=1e-6)
+
+
+def test_propagation_monotone_in_crash_signal():
+    """Raising a service's crash evidence must not LOWER its own score
+    (sanity of the scoring surface; guards weight-retune regressions)."""
+    import numpy as np
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine import GraphEngine
+    from rca_tpu.features.schema import SvcF
+
+    case = synthetic_cascade_arrays(120, n_roots=1, seed=4)
+    engine = GraphEngine()
+    victim = (int(case.roots[0]) + 17) % case.n
+    base = engine.analyze_arrays(case.features, case.dep_src, case.dep_dst)
+    bumped = case.features.copy()
+    bumped[victim, SvcF.CRASH] = min(1.0, bumped[victim, SvcF.CRASH] + 0.5)
+    out = engine.analyze_arrays(bumped, case.dep_src, case.dep_dst)
+    assert out.score[victim] >= base.score[victim] - 1e-6

@@ -134,3 +134,29 @@ def test_healthy_world_capture_uncorrupted():
     for node in snap.nodes:
         for cond in node.get("status", {}).get("conditions", []):
             assert isinstance(cond.get("status"), (str, type(None))), cond
+
+
+def test_sanitize_idempotent():
+    """sanitize(sanitize(x)) == sanitize(x): the output must already satisfy
+    every invariant, for Python and native alike."""
+    import copy
+
+    from rca_tpu.native import load_sanitize
+
+    mangled = {
+        "metadata": None,
+        "spec": {"containers": [None, {"name": None, "env": [
+            {"name": None}, None,
+        ]}], "template": {"metadata": None}},
+        "status": {"phase": None, "conditions": [{"type": None,
+                                                  "status": None}]},
+        "labels-like": {"a": None},
+    }
+    once = sanitize_object(copy.deepcopy(mangled))
+    twice = sanitize_object(copy.deepcopy(once))
+    assert twice == once
+    native = load_sanitize()
+    if native is not None:
+        n_once = native.sanitize_object(copy.deepcopy(mangled))
+        assert native.sanitize_object(copy.deepcopy(n_once)) == n_once
+        assert n_once == once
