@@ -73,6 +73,13 @@ _STR_KEYS = frozenset({
 })
 
 
+def _empty_metadata() -> dict:
+    """A fresh metadata satisfying the module invariant (name + labels) —
+    the single Python-side spelling; sanitizec.c's empty_metadata() is its
+    twin."""
+    return {"name": "", "labels": {}}
+
+
 def sanitize_object(obj: Any, parent_key: str = "") -> Any:
     """Recursively normalize one K8s object (see module docstring).
 
@@ -82,7 +89,7 @@ def sanitize_object(obj: Any, parent_key: str = "") -> Any:
     rebuild-everything version measured ~1.6 s at 10k pods."""
     if obj is None:
         if parent_key == "metadata":
-            return {"name": "", "labels": {}}
+            return _empty_metadata()
         if parent_key in _DICT_KEYS:
             return {}
         if parent_key in _LIST_KEYS:
@@ -115,7 +122,9 @@ def sanitize_object(obj: Any, parent_key: str = "") -> Any:
                 elif child_key in _STR_KEYS:
                     nv = ""
             elif child_key in _DICT_KEYS and nv.__class__ is not dict:
-                nv = {}
+                # same repair as the None branch: a replaced metadata must
+                # still satisfy the name/labels invariant
+                nv = _empty_metadata() if child_key == "metadata" else {}
             elif child_key in _LIST_KEYS and nv.__class__ is not list:
                 nv = []
             if nv is not v:
@@ -186,7 +195,7 @@ def sanitize_objects(items: List[dict]) -> List[dict]:
         md = clean.get("metadata")
         if not isinstance(md, dict):
             clean = dict(clean) if clean is item else clean
-            clean["metadata"] = {"name": "", "labels": {}}
+            clean["metadata"] = _empty_metadata()
         elif "name" not in md or not isinstance(md.get("labels"), dict):
             clean = dict(clean) if clean is item else clean
             md = dict(md)

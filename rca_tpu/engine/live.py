@@ -114,6 +114,10 @@ class LiveStreamingSession:
             out.update(
                 changed_rows=len(self._names), resynced=True,
                 capture_ms=round(capture_ms, 2), resyncs=self.resyncs,
+                # session-lifetime counter: the inner StreamingSession is
+                # replaced on resync, so its "tick" restarts at 1 and the
+                # CLI/UI sequence would go non-monotonic
+                tick=self._polls,
             )
             return out
 
@@ -129,5 +133,6 @@ class LiveStreamingSession:
         out.update(
             changed_rows=int(len(changed)), resynced=False,
             capture_ms=round(capture_ms, 2), resyncs=self.resyncs,
+            tick=self._polls,
         )
         return out

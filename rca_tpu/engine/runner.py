@@ -48,8 +48,11 @@ def build_up_ell(n_pad: int, dep_src, dep_dst):
 
     src = np.asarray(dep_src)
     dst = np.asarray(dep_dst)
-    if len(src):
-        assert int(src.max()) < n_pad - 1 and int(dst.max()) < n_pad - 1, (
+    if len(src) and (int(src.max()) >= n_pad - 1 or int(dst.max()) >= n_pad - 1):
+        # ValueError, not assert: under `python -O` an assert vanishes and
+        # an edge on the dummy slot silently corrupts the up-scan (the step
+        # zeroes that slot every iteration)
+        raise ValueError(
             "build_up_ell needs slot n_pad-1 free as the dummy row; pass "
             "raw edges with n_pad = bucket(n_services + 1)"
         )

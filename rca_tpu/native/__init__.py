@@ -106,12 +106,43 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 
 
+def _default_cache_dir() -> Path:
+    """User-private cache location: ~/.cache/rca_tpu when HOME resolves,
+    else a uid-suffixed tempdir.  A world-shared path (the old
+    /tmp/rca_tpu_native) would let any local user pre-seed a .so whose
+    hash tag is computable from the public source, and load_sanitize()
+    imports that file as a full CPython extension — arbitrary code
+    execution.  The dir is created 0700 and re-verified before any load."""
+    try:
+        home = Path.home()  # raises RuntimeError in HOME-less containers
+        if home != Path("/") and os.access(str(home), os.W_OK):
+            return home / ".cache" / "rca_tpu"
+    except (RuntimeError, OSError):
+        pass
+    return Path(tempfile.gettempdir()) / f"rca_tpu_native-{os.getuid()}"
+
+
+def _owned_and_private(path: Path, is_dir: bool) -> bool:
+    """True when *path* is owned by us and not writable by group/other —
+    the precondition for trusting a cached artifact enough to dlopen it."""
+    try:
+        st = os.stat(path, follow_symlinks=False)
+    except OSError:
+        return False
+    if st.st_uid != os.getuid():
+        return False
+    if is_dir and not os.path.isdir(path):
+        return False
+    return (st.st_mode & 0o022) == 0
+
+
 def _compile_cached(source: Path, out_prefix: str,
                     extra_flags: List[str]) -> Optional[Path]:
-    """Shared lazy-compile pipeline: hash-tagged cache under
-    RCA_NATIVE_CACHE, pid-suffixed tmp + atomic rename, g++; None when the
-    source or toolchain is unavailable.  Used by both the ctypes log
-    scanner and the sanitize CPython extension."""
+    """Shared lazy-compile pipeline: hash-tagged cache in a user-private
+    0700 dir (RCA_NATIVE_CACHE overrides the location, not the ownership
+    checks), unpredictable-suffix tmp + atomic rename, g++; None when the source,
+    toolchain, or a trustworthy cache dir is unavailable.  Used by both
+    the ctypes log scanner and the sanitize CPython extension."""
     import sysconfig
 
     try:
@@ -124,21 +155,87 @@ def _compile_cached(source: Path, out_prefix: str,
     # ABI-independent but rides the same scheme harmlessly)
     abi = sysconfig.get_config_var("SOABI") or "unknown-abi"
     tag = hashlib.sha256(src + abi.encode()).hexdigest()[:16]
-    cache_dir = Path(
-        os.environ.get("RCA_NATIVE_CACHE",
-                       os.path.join(tempfile.gettempdir(), "rca_tpu_native"))
-    )
-    cache_dir.mkdir(parents=True, exist_ok=True)
+    env_dir = os.environ.get("RCA_NATIVE_CACHE")
+    if env_dir:
+        # an explicitly-configured path may be the user's own symlink to a
+        # private scratch dir; check the TARGET's ownership, not the
+        # link's lstat-mode-0777
+        cache_dir = Path(env_dir).resolve()
+    else:
+        cache_dir = _default_cache_dir()
+        if cache_dir.is_symlink():
+            # the /tmp fallback name is predictable and /tmp is
+            # world-writable: a pre-seeded symlink would redirect the
+            # chmod+compile into an attacker-chosen victim-owned dir
+            return None
+    try:
+        # mkdir(parents=True) gives INTERMEDIATE dirs the umask default,
+        # which under umask 002 would leave a freshly-created ~/.cache
+        # group-writable and void the leaf ownership check — create every
+        # missing component 0700 ourselves
+        for part in (*reversed(cache_dir.parents), cache_dir):
+            if not part.exists():
+                part.mkdir(mode=0o700, exist_ok=True)
+    except OSError:
+        return None
+    if not _owned_and_private(cache_dir, is_dir=True):
+        # DEFAULT dir + our uid: our own artifact of a looser-umask era —
+        # repair like the stale-.so branch below.  An env-configured dir
+        # may be deliberately shared (mode 2775 team cache): never mutate
+        # its permissions; anyone else's dir stays untrusted.  Either
+        # rejection must be observable, not a silent permanent fallback
+        # to the slow Python paths.
+        repairable = False
+        if not env_dir:
+            try:
+                repairable = os.stat(
+                    cache_dir, follow_symlinks=False
+                ).st_uid == os.getuid()
+            except OSError:
+                return None
+        if not repairable:
+            import warnings
+            warnings.warn(
+                f"native cache dir {cache_dir} is not exclusively owned "
+                "by this user; native log scanner/sanitizer disabled "
+                "(point RCA_NATIVE_CACHE at a private, user-owned path)",
+                RuntimeWarning, stacklevel=2,
+            )
+            return None
+        try:
+            os.chmod(cache_dir, 0o700)
+        except OSError:
+            return None
+        if not _owned_and_private(cache_dir, is_dir=True):
+            return None
     out = cache_dir / f"{out_prefix}-{tag}.so"
     if out.exists():
-        return out
-    tmp = out.with_suffix(f".{os.getpid()}.tmp.so")
+        if _owned_and_private(out, is_dir=False):
+            return out
+        # the dir passed the ownership check, so nobody else could have
+        # written this — it's our own stale artifact from a looser umask
+        # era; rebuild rather than silently losing the native path forever
+        try:
+            # missing_ok: a concurrent process may have won the same repair
+            out.unlink(missing_ok=True)
+        except OSError:
+            return None
+    # unpredictable tmp name: a pid suffix could be pre-planted as a
+    # symlink while the dir was still loose, and g++ -o writes THROUGH a
+    # symlink (O_TRUNC on the victim file)
+    import secrets
+    tmp = out.with_suffix(f".{secrets.token_hex(8)}.tmp.so")
     cmd = (["g++", "-O2", "-shared", "-fPIC"] + extra_flags
            + [str(source), "-o", str(tmp)])
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
         if proc.returncode != 0:
             return None
+        # g++ output inherits the umask; under umask 0002 that leaves the
+        # group-write bit set and every LATER process would reject the
+        # cached artifact via _owned_and_private and silently lose the
+        # native path — normalize so fresh artifacts pass their own check
+        os.chmod(tmp, 0o600)
         os.replace(tmp, out)
         return out
     except (OSError, subprocess.TimeoutExpired):

@@ -177,7 +177,12 @@ static PyObject *sanitize_dict(PyObject *obj, const char *parent_key) {
                 Py_DECREF(nv); nv = PyUnicode_FromString("");
             }
         } else if (in_set(ku, DICT_KEYS) && !PyDict_CheckExact(nv)) {
-            Py_DECREF(nv); nv = PyDict_New();
+            /* a replaced metadata must still satisfy the name/labels
+             * invariant — same repair as the None branch (spec:
+             * sanitize.py metadata coercion) */
+            Py_DECREF(nv);
+            nv = (ku && strcmp(ku, "metadata") == 0) ? empty_metadata()
+                                                     : PyDict_New();
         } else if (in_set(ku, LIST_KEYS) && !PyList_CheckExact(nv)) {
             Py_DECREF(nv); nv = PyList_New(0);
         }

@@ -163,6 +163,16 @@ def test_native_sanitize_exact_parity_with_python():
             checked += 1
     assert checked > 500
 
+    # wrong-typed metadata must repair to {name,labels} in BOTH
+    # implementations (the fuzz above hits this probabilistically; this
+    # pins it deterministically)
+    for bad in ("x", 123, ["y"]):
+        obj = {"template": {"metadata": bad}}
+        py = sanitize_object(copy.deepcopy(obj))
+        c = native.sanitize_object(copy.deepcopy(obj))
+        assert py["template"]["metadata"] == {"name": "", "labels": {}}
+        assert c == py
+
     # copy-on-write parity: a well-formed object passes through unchanged
     good = {
         "metadata": {"name": "x", "labels": {"app": "x"}},

@@ -1,0 +1,127 @@
+"""Trust logic of the native .so compile cache (advisor finding, round 2).
+
+The cache must never load — or write through — anything another local
+user could have planted: a world-shared /tmp dir, a pre-seeded
+hash-predictable .so, a symlinked fallback path.  Self-owned artifacts
+from a looser-umask era are REPAIRED (chmod/rebuild), never a permanent
+silent fallback to the slow Python paths.
+
+Uses a trivial one-function source so each cold compile costs
+milliseconds — the logscan/sanitize codegen itself is covered by
+tests/test_native.py.
+"""
+
+import os
+
+import pytest
+
+from rca_tpu import native
+
+TINY_SRC = "extern \"C\" int rca_cache_probe(void) { return 7; }\n"
+
+
+@pytest.fixture()
+def tiny_source(tmp_path):
+    src = tmp_path / "probe.cpp"
+    src.write_text(TINY_SRC)
+    return src
+
+
+def _compile(src):
+    return native._compile_cached(src, "probe", ["-std=c++17"])
+
+
+def test_loose_self_owned_default_dir_is_repaired(tmp_path, monkeypatch,
+                                                  tiny_source):
+    # group/other-writable DEFAULT cache dir we own -> chmod 0700 closes
+    # the write window before any compile; never a permanent silent
+    # fallback.  (Only the default dir: the tool created it, so it is not
+    # a deliberately-shared location.)
+    loose = tmp_path / "loose"
+    loose.mkdir()
+    os.chmod(loose, 0o777)
+    monkeypatch.delenv("RCA_NATIVE_CACHE", raising=False)
+    monkeypatch.setattr(native, "_default_cache_dir", lambda: loose)
+    out = _compile(tiny_source)
+    if out is None:
+        pytest.skip("no toolchain")
+    assert (os.stat(loose).st_mode & 0o777) == 0o700
+
+
+def test_loose_env_configured_dir_is_rejected_not_mutated(
+        tmp_path, monkeypatch, tiny_source):
+    # an env-configured loose dir may be a deliberately group-shared team
+    # cache (e.g. mode 2775): warn + reject, never chmod it out from
+    # under its other users
+    shared = tmp_path / "shared"
+    shared.mkdir()
+    os.chmod(shared, 0o775)
+    monkeypatch.setenv("RCA_NATIVE_CACHE", str(shared))
+    with pytest.warns(RuntimeWarning, match="not exclusively owned"):
+        assert _compile(tiny_source) is None
+    assert (os.stat(shared).st_mode & 0o777) == 0o775  # untouched
+
+
+def test_explicit_symlink_cache_is_followed(tmp_path, monkeypatch,
+                                            tiny_source):
+    # a user-configured symlink to a private dir is legitimate (resolved
+    # before the ownership checks, not lstat'ed)
+    target = tmp_path / "real-cache"
+    link = tmp_path / "link-cache"
+    link.symlink_to(target)
+    monkeypatch.setenv("RCA_NATIVE_CACHE", str(link))
+    out = _compile(tiny_source)
+    if out is None:
+        pytest.skip("no toolchain")
+    assert str(out).startswith(str(target))
+
+
+def test_private_dir_and_stale_artifact_repair(tmp_path, monkeypatch,
+                                               tiny_source):
+    tight = tmp_path / "tight"
+    monkeypatch.setenv("RCA_NATIVE_CACHE", str(tight))
+    out = _compile(tiny_source)
+    if out is None:
+        pytest.skip("no toolchain")
+    st = os.stat(tight)
+    assert st.st_uid == os.getuid()
+    assert (st.st_mode & 0o022) == 0
+    assert (os.stat(out).st_mode & 0o777) == 0o600
+    # a loose artifact inside a dir we own exclusively is our own stale
+    # file (nobody else could have written it) — repaired by rebuild
+    os.chmod(out, 0o666)
+    out2 = _compile(tiny_source)
+    assert out2 is not None
+    assert (os.stat(out2).st_mode & 0o777) == 0o600
+    # a foreign-looking .so at the final name is unlinked and rebuilt,
+    # and a symlink there never gets written THROUGH (unlink removes the
+    # link, not its target)
+    victim = tmp_path / "victim.txt"
+    victim.write_text("precious")
+    out2.unlink()
+    out2.symlink_to(victim)
+    out3 = _compile(tiny_source)
+    assert out3 is not None and not out3.is_symlink()
+    assert victim.read_text() == "precious"
+
+
+def test_default_fallback_never_follows_preseeded_symlink(
+        tmp_path, monkeypatch, tiny_source):
+    # the /tmp fallback name is predictable and /tmp is world-writable: a
+    # pre-seeded symlink must be rejected outright, not chmod'd/written to
+    victim_dir = tmp_path / "victim-dir"
+    victim_dir.mkdir()
+    os.chmod(victim_dir, 0o770)  # deliberately group-shared
+    fake_default = tmp_path / "preseeded-link"
+    fake_default.symlink_to(victim_dir)
+    monkeypatch.delenv("RCA_NATIVE_CACHE", raising=False)
+    monkeypatch.setattr(native, "_default_cache_dir", lambda: fake_default)
+    assert _compile(tiny_source) is None
+    assert (os.stat(victim_dir).st_mode & 0o777) == 0o770  # untouched
+
+
+def test_default_cache_dir_is_user_scoped():
+    d = native._default_cache_dir()
+    if str(os.getuid()) in d.name:  # tempdir fallback (HOME-less env)
+        return
+    assert str(d).startswith(str(native.Path.home()))

@@ -82,6 +82,11 @@ def test_sanitize_invariants():
     # nested metadata: null carries the full invariant
     tmpl = sanitize_object({"template": {"metadata": None, "spec": {}}})
     assert tmpl["template"]["metadata"] == {"name": "", "labels": {}}
+    # ... and so does a WRONG-TYPED metadata (string/int) — the dict
+    # coercion must emit the repaired form, not a bare {}
+    for bad in ("x", 123, ["y"]):
+        wrong = sanitize_object({"template": {"metadata": bad}})
+        assert wrong["template"]["metadata"] == {"name": "", "labels": {}}
     assert len(clean) == 1  # non-dict entries dropped
     p = clean[0]
     assert p["metadata"] == {"name": "", "labels": {}}

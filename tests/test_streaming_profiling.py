@@ -227,6 +227,12 @@ def test_live_streaming_session_resyncs_on_topology_change():
     assert live.resyncs == 1
     assert len(live._names) == n0 + 1
     assert out["ranked"]  # still ranks after the rebuild
+    # tick counter is session-lifetime: monotonic ACROSS the resync (the
+    # inner StreamingSession restarts at 1; the CLI/UI sequence must not)
+    assert out["tick"] == 1
+    out2 = live.poll()
+    assert out2["resynced"] is False
+    assert out2["tick"] == 2
 
 
 def test_set_all_upload_accounted_on_next_tick():
