@@ -60,6 +60,13 @@ class AgentResult:
     reasoning_steps: List[dict] = dataclasses.field(default_factory=list)
     summary: str = ""
     data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # findings are "as of the snapshot": one timestamp for the whole
+    # analysis, taken from ClusterSnapshot.captured_at.  Per-finding
+    # wall-clock stamps made two pipeline runs over the SAME world state
+    # byte-differ whenever they straddled a second boundary — the ~1/16
+    # parity-gate flake of round 2 (frozen mock time now makes the gate
+    # deterministic; live captures get one consistent capture stamp).
+    as_of: Optional[str] = None
 
     def add_finding(
         self,
@@ -70,12 +77,13 @@ class AgentResult:
         recommendation: str,
         **extra: Any,
     ) -> dict:
+        extra.setdefault("timestamp", self.as_of)
         f = make_finding(component, issue, severity, evidence, recommendation, **extra)
         self.findings.append(f)
         return f
 
     def add_step(self, observation: str, conclusion: str) -> dict:
-        s = make_reasoning_step(observation, conclusion)
+        s = make_reasoning_step(observation, conclusion, timestamp=self.as_of)
         self.reasoning_steps.append(s)
         return s
 

@@ -42,7 +42,7 @@ class EventsAgent(Agent):
     agent_type = "events"
 
     def analyze(self, ctx: AnalysisContext) -> AgentResult:
-        r = AgentResult(self.agent_type)
+        r = AgentResult(self.agent_type, as_of=ctx.snapshot.captured_at)
         snap = ctx.snapshot
         warnings = [e for e in snap.events if e.get("type") != "Normal"]
 

@@ -141,7 +141,7 @@ class LLMAgent(Agent):
     def analyze(
         self, ctx: AnalysisContext, cluster_client=None
     ) -> AgentResult:
-        r = AgentResult(self.agent_type)
+        r = AgentResult(self.agent_type, as_of=ctx.snapshot.captured_at)
         tools = self._tools_for(ctx, cluster_client or self.cluster_client)
         context = self._context_blob(ctx)
         system_prompt = _SYSTEM_TEMPLATE.format(

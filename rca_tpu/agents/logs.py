@@ -43,7 +43,7 @@ class LogsAgent(Agent):
     agent_type = "logs"
 
     def analyze(self, ctx: AnalysisContext) -> AgentResult:
-        r = AgentResult(self.agent_type)
+        r = AgentResult(self.agent_type, as_of=ctx.snapshot.captured_at)
         fs = ctx.features
         snap = ctx.snapshot
         pf = fs.pod_features

@@ -24,7 +24,7 @@ class MetricsAgent(Agent):
     agent_type = "metrics"
 
     def analyze(self, ctx: AnalysisContext) -> AgentResult:
-        r = AgentResult(self.agent_type)
+        r = AgentResult(self.agent_type, as_of=ctx.snapshot.captured_at)
         fs = ctx.features
         snap = ctx.snapshot
 

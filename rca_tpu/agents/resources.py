@@ -38,7 +38,7 @@ class ResourceAgent(Agent):
     agent_type = "resources"
 
     def analyze(self, ctx: AnalysisContext) -> AgentResult:
-        r = AgentResult(self.agent_type)
+        r = AgentResult(self.agent_type, as_of=ctx.snapshot.captured_at)
         snap = ctx.snapshot
         fs = ctx.features
         r.add_step(

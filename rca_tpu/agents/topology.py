@@ -32,7 +32,7 @@ class TopologyAgent(Agent):
     agent_type = "topology"
 
     def analyze(self, ctx: AnalysisContext) -> AgentResult:
-        r = AgentResult(self.agent_type)
+        r = AgentResult(self.agent_type, as_of=ctx.snapshot.captured_at)
         snap = ctx.snapshot
         fs = ctx.features
         graph = ctx.graph

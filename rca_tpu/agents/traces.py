@@ -28,7 +28,7 @@ class TracesAgent(Agent):
     agent_type = "traces"
 
     def analyze(self, ctx: AnalysisContext) -> AgentResult:
-        r = AgentResult(self.agent_type)
+        r = AgentResult(self.agent_type, as_of=ctx.snapshot.captured_at)
         snap = ctx.snapshot
         fs = ctx.features
         traces = snap.traces or {}

@@ -121,10 +121,15 @@ def correlate_jax(
     Components that do not map onto a graph service (nodes, namespaces,
     HPAs…) are appended after the engine-ranked services, ordered by the
     deterministic severity rank.
-    """
-    from rca_tpu.engine import GraphEngine
 
-    engine = engine or GraphEngine()
+    The engine is auto-selected per call (SURVEY §2.9: the sharded
+    multi-device engine lives BEHIND this analyze boundary): sharded when
+    ``RCA_SHARD`` asks for it or more than one device is visible,
+    single-device otherwise; the result records which one ran.
+    """
+    from rca_tpu.engine import make_engine
+
+    engine = engine or make_engine()
     fs = ctx.features
     src, dst = ctx.dep_edges
     result = engine.analyze_features(fs, src, dst, k=max(top_k, 5))
@@ -173,6 +178,7 @@ def correlate_jax(
         "root_causes": top,
         "groups": groups,
         "backend": "jax",
+        "engine": getattr(result, "engine", "single"),
         "summary": summary,
         "engine_latency_ms": result.latency_ms,
     }
@@ -260,6 +266,23 @@ def correlate_findings(
                     agent_results, ctx, top_k=top_k, engine=engine
                 )
             except Exception as exc:  # noqa: BLE001 - degrade, but say so
+                # a misconfigured RCA_SHARD (wrong device count, malformed
+                # spec) is an OPERATOR error that must fail loudly, not
+                # silently demote every analysis to the deterministic
+                # correlator.  Lazy import: on a host where jax itself
+                # cannot import, the ImportError stays INSIDE the degrade
+                # path (this module is deliberately jax-free).
+                try:
+                    from rca_tpu.engine.sharded_runner import (
+                        ShardConfigError,
+                    )
+                except Exception:  # noqa: BLE001 - any import failure
+                    # (ImportError, jax version-mismatch RuntimeError,
+                    # plugin init errors) means the loud-path class can't
+                    # exist, so everything degrades
+                    ShardConfigError = ()
+                if isinstance(exc, ShardConfigError):
+                    raise
                 fallback_reason = f"{type(exc).__name__}: {exc}"
                 backend = "deterministic"
     if backend == "llm":

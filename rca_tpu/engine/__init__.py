@@ -25,6 +25,7 @@ from rca_tpu.engine.propagate import (
 )
 from rca_tpu.engine.live import LiveStreamingSession
 from rca_tpu.engine.runner import EngineResult, GraphEngine
+from rca_tpu.engine.sharded_runner import ShardedGraphEngine, make_engine
 from rca_tpu.engine.streaming import StreamingSession
 
 __all__ = [
@@ -34,6 +35,8 @@ __all__ = [
     "propagate_jit",
     "EngineResult",
     "GraphEngine",
+    "ShardedGraphEngine",
+    "make_engine",
     "StreamingSession",
     "LiveStreamingSession",
 ]

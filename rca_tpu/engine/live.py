@@ -58,6 +58,8 @@ class LiveStreamingSession:
         self.client = client
         self.namespace = namespace
         self.k = k
+        # single-device by design: see StreamingSession.__init__ — the
+        # donated-buffer delta-scatter session has no sharded twin yet
         self.engine = engine or GraphEngine()
         self.topology_check_every = max(1, int(topology_check_every))
         self._polls = 0

@@ -177,13 +177,132 @@ class EngineResult:
     latency_ms: float             # device compute wall time (post-compile)
     n_services: int
     n_edges: int
+    engine: str = "single"        # which engine ran: single | sharded(...)
 
     def top_components(self, k: Optional[int] = None) -> List[str]:
         items = self.ranked if k is None else self.ranked[:k]
         return [r["component"] for r in items]
 
 
-class GraphEngine:
+def render_result(
+    stacked: np.ndarray,          # [4, >=n] host arrays: a, u, m, score
+    vals: np.ndarray,             # [kk] top-k values (may include pad slots)
+    idx: np.ndarray,              # [kk] top-k indices
+    names: Optional[Sequence[str]],
+    n: int,
+    k: int,
+    latency_ms: float,
+    n_edges: int,
+    engine: str,
+) -> EngineResult:
+    """Shared host-side rendering: identical findings regardless of which
+    engine (single-device or sharded) produced the device arrays."""
+    a, u, m, score = (np.asarray(stacked[i][:n]) for i in range(4))
+    names = list(names) if names is not None else [f"svc-{i}" for i in range(n)]
+    ranked = []
+    for j, i in enumerate(np.asarray(idx).tolist()):
+        if i >= n or len(ranked) >= k:
+            continue
+        ranked.append(
+            {
+                "component": names[i],
+                "score": float(vals[j]),
+                "anomaly": float(a[i]),
+                "explained_by_upstream": float(u[i]),
+                "downstream_impact": float(m[i]),
+            }
+        )
+    return EngineResult(
+        service_names=names,
+        ranked=ranked,
+        anomaly=a,
+        upstream=u,
+        impact=m,
+        score=score,
+        latency_ms=latency_ms,
+        n_services=n,
+        n_edges=n_edges,
+        engine=engine,
+    )
+
+
+def resolve_params(
+    config: RCAConfig, params: Optional[PropagationParams]
+) -> PropagationParams:
+    """Shared weight resolution for BOTH engines (single-device and
+    sharded): explicit params > RCA_WEIGHTS checkpoint > defaults.  One
+    definition so a checkpoint-loading change cannot land in only one
+    engine and silently break their score parity."""
+    if params is None:
+        ckpt = os.environ.get("RCA_WEIGHTS")
+        if ckpt:
+            from rca_tpu.engine.train import load_params
+
+            params = load_params(ckpt)
+    return params or default_params(config.propagation_steps)
+
+
+def timed_fetch(run, timed: bool):
+    """Shared fetch-synced execution for BOTH engines: ``run`` returns
+    (stacked_diagnostics, topk_vals, topk_idx) device values.
+
+    Timing syncs through device_get of the top-k pair, NOT
+    block_until_ready: on tunneled backends (axon) block_until_ready
+    returns once the dispatch is enqueued, so dispatch-only timing
+    under-measures by the whole device execution + fetch RTT.  The fetched
+    top-k is tiny — the fetch cost is the tunnel round trip, which a real
+    deployment pays per inference anyway.  In the untimed path ONE bulk
+    fetch brings everything back (a second device_get pays a second RTT).
+    """
+    if timed:
+        jax.device_get(run()[1:])  # warm the compile cache
+        reps = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            stacked, vals, idx = run()
+            vals, idx = jax.device_get((vals, idx))
+            reps.append((time.perf_counter() - t0) * 1e3)
+        latency_ms = float(np.median(reps))
+        stacked = jax.device_get(stacked)
+    else:
+        t0 = time.perf_counter()
+        stacked, vals, idx = jax.device_get(run())
+        latency_ms = (time.perf_counter() - t0) * 1e3
+    return stacked, vals, idx, latency_ms
+
+
+class EngineAPI:
+    """The shared analyze call surface: every engine implements
+    ``analyze_arrays``; these entry points exist ONCE so the two engines
+    cannot drift apart (the drop-in contract the analyze boundary and the
+    parity gates rely on)."""
+
+    def analyze_arrays(self, features, dep_src, dep_dst, names=None,
+                       k=None, timed=False) -> "EngineResult":
+        raise NotImplementedError
+
+    def analyze_case(self, case, k: Optional[int] = None, timed: bool = False):
+        """Analyze a :class:`rca_tpu.cluster.generator.CascadeArrays`."""
+        return self.analyze_arrays(
+            case.features, case.dep_src, case.dep_dst, case.names,
+            k=k, timed=timed,
+        )
+
+    def analyze_snapshot(self, snapshot, k: Optional[int] = None) -> "EngineResult":
+        fs = extract_features(snapshot)
+        src, dst = service_dependency_edges(snapshot, fs)
+        return self.analyze_features(fs, src, dst, k=k)
+
+    def analyze_features(
+        self, fs: "FeatureSet", src: np.ndarray, dst: np.ndarray,
+        k: Optional[int] = None,
+    ) -> "EngineResult":
+        return self.analyze_arrays(
+            fs.service_features, src, dst, fs.service_names, k=k
+        )
+
+
+class GraphEngine(EngineAPI):
     """Bucketed, compile-cached causal propagation."""
 
     def __init__(
@@ -192,13 +311,7 @@ class GraphEngine:
         params: Optional[PropagationParams] = None,
     ):
         self.config = config or RCAConfig()
-        if params is None:
-            ckpt = os.environ.get("RCA_WEIGHTS")
-            if ckpt:
-                from rca_tpu.engine.train import load_params
-
-                params = load_params(ckpt)
-        self.params = params or default_params(self.config.propagation_steps)
+        self.params = resolve_params(self.config, params)
         self._aw, self._hw = self.params.weight_arrays()
 
     # -- shaping -----------------------------------------------------------
@@ -280,71 +393,8 @@ class GraphEngine:
                     use_pallas, n_live, up_ell,
                 )
 
-        # Timing syncs through device_get of the top-k pair, NOT
-        # block_until_ready: on tunneled backends (axon) block_until_ready
-        # returns once the dispatch is enqueued, so dispatch-only timing
-        # under-measures by the whole device execution + fetch RTT.  The
-        # fetched top-k is 2*(k+8) floats — the fetch cost is the tunnel
-        # round trip, which a real deployment pays per inference anyway.
-        if timed:
-            jax.device_get(run()[1:])  # warm the compile cache
-            reps = []
-            for _ in range(10):
-                t0 = time.perf_counter()
-                stacked, vals, idx = run()
-                vals, idx = jax.device_get((vals, idx))
-                reps.append((time.perf_counter() - t0) * 1e3)
-            latency_ms = float(np.median(reps))
-            stacked = jax.device_get(stacked)
-        else:
-            # ONE bulk fetch: the diagnostics are small ([4, S_pad] ≈ 32 KB
-            # at 2k) and a second device_get would pay a second tunnel RTT
-            t0 = time.perf_counter()
-            stacked, vals, idx = jax.device_get(run())
-            latency_ms = (time.perf_counter() - t0) * 1e3
-        a, u, m, score = (stacked[i][:n] for i in range(4))
-        names = list(names) if names is not None else [f"svc-{i}" for i in range(n)]
-        ranked = []
-        for j, i in enumerate(idx.tolist()):
-            if i >= n or len(ranked) >= k:
-                continue
-            ranked.append(
-                {
-                    "component": names[i],
-                    "score": float(vals[j]),
-                    "anomaly": float(a[i]),
-                    "explained_by_upstream": float(u[i]),
-                    "downstream_impact": float(m[i]),
-                }
-            )
-        return EngineResult(
-            service_names=names,
-            ranked=ranked,
-            anomaly=a,
-            upstream=u,
-            impact=m,
-            score=score,
-            latency_ms=latency_ms,
-            n_services=n,
-            n_edges=int(len(dep_src)),
-        )
-
-    # -- convenience entry points ------------------------------------------
-    def analyze_case(self, case, k: Optional[int] = None, timed: bool = False):
-        """Analyze a :class:`rca_tpu.cluster.generator.CascadeArrays`."""
-        return self.analyze_arrays(
-            case.features, case.dep_src, case.dep_dst, case.names, k=k, timed=timed
-        )
-
-    def analyze_snapshot(self, snapshot, k: Optional[int] = None) -> EngineResult:
-        fs = extract_features(snapshot)
-        src, dst = service_dependency_edges(snapshot, fs)
-        return self.analyze_features(fs, src, dst, k=k)
-
-    def analyze_features(
-        self, fs: FeatureSet, src: np.ndarray, dst: np.ndarray,
-        k: Optional[int] = None,
-    ) -> EngineResult:
-        return self.analyze_arrays(
-            fs.service_features, src, dst, fs.service_names, k=k
+        stacked, vals, idx, latency_ms = timed_fetch(run, timed)
+        return render_result(
+            stacked, vals, idx, names, n, k, latency_ms,
+            int(len(dep_src)), engine="single",
         )

@@ -1,0 +1,212 @@
+"""ShardedGraphEngine: the multi-device engine behind the analyze boundary.
+
+SURVEY.md §2.9 requires the node-sharded propagation to "live behind
+``BaseAgent.analyze()``", not be a parallel API only tests can reach.  This
+module closes that gap: :class:`ShardedGraphEngine` exposes the exact
+:class:`rca_tpu.engine.runner.GraphEngine` interface (``analyze_arrays`` /
+``analyze_features`` / ``analyze_snapshot`` / ``analyze_case``) but executes
+through :mod:`rca_tpu.parallel.sharded` — nodes sharded over the mesh's
+'sp' axis with all_gather / psum_scatter collectives riding ICI, the
+cross-shard top-k merged on device.  :func:`make_engine` is the auto
+selector the correlation path calls: sharded when ``RCA_SHARD`` asks for it
+or more than one device is visible, single-device otherwise.
+
+Shape discipline matches the dense engine: the node axis pads to the same
+``RCAConfig.shape_buckets`` tier (then up to a multiple of sp) and the
+per-shard edge rows pad to a bucketed length, so jit compiles once per
+(mesh, tier) — not once per graph.
+
+The reference has no analog (it is serial Python end to end, reference:
+agents/mcp_coordinator.py:624-665); scores are parity-locked to the dense
+engine by tests/test_parallel.py and the coordinator parity gates running
+under ``RCA_SHARD`` on the virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from rca_tpu.config import RCAConfig, bucket_for
+from rca_tpu.engine.propagate import PropagationParams
+from rca_tpu.engine.runner import (
+    EngineAPI,
+    EngineResult,
+    render_result,
+    resolve_params,
+    timed_fetch,
+)
+
+
+class ShardConfigError(ValueError):
+    """A misconfigured RCA_SHARD (malformed spec, impossible device
+    count): an OPERATOR error the correlation path surfaces loudly, unlike
+    runtime engine failures which degrade to the deterministic backend."""
+
+
+def parse_shard_spec(spec: str, n_devices: int) -> Dict[str, int]:
+    """``"sp=4,dp=2"`` → {"sp": 4, "dp": 2}; ``"auto"``/``"1"`` put every
+    device on the node axis (dp=1 — the analyze path ranks ONE snapshot, so
+    hypothesis parallelism would only tile redundant work)."""
+    spec = (spec or "").strip().lower()
+    if spec in ("", "auto", "1", "on", "true"):
+        return {"sp": n_devices, "dp": 1}
+    axes: Dict[str, int] = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        # isdecimal, not isdigit: isdigit admits superscripts that int()
+        # then rejects with a plain ValueError the fail-loudly handler
+        # would not match; and either alone admits 0, which dies far away
+        # (empty mesh / divide-by-sp) instead of here with a clear message
+        if key not in ("sp", "dp") or not val.strip().isdecimal() \
+                or int(val) < 1:
+            raise ShardConfigError(
+                f"RCA_SHARD={spec!r}: expected 'auto' or "
+                "'sp=<positive n>[,dp=<positive n>]'"
+            )
+        axes[key] = int(val)
+    axes.setdefault("sp", max(1, n_devices // axes.get("dp", 1)))
+    axes.setdefault("dp", 1)
+    return axes
+
+
+class ShardedGraphEngine(EngineAPI):
+    """Multi-device twin of :class:`GraphEngine` (same call surface)."""
+
+    def __init__(
+        self,
+        config: Optional[RCAConfig] = None,
+        params: Optional[PropagationParams] = None,
+        mesh=None,
+        spec: Optional[str] = None,
+    ):
+        from rca_tpu.parallel.mesh import make_mesh
+
+        self.config = config or RCAConfig()
+        self.params = resolve_params(self.config, params)
+        if mesh is None:
+            devices = jax.devices()
+            if spec is None:
+                # single source for the env token semantics: off-tokens
+                # (0/off/single/...) mean "the CALLER asked for sharding
+                # anyway, use the auto layout" — constructing this class
+                # IS the request, so they must not crash the parse
+                _, env_spec = shard_requested()
+                spec = env_spec or "auto"
+            axes = parse_shard_spec(spec, len(devices))
+            need = axes["sp"] * axes["dp"]
+            if need > len(devices):
+                raise ShardConfigError(
+                    f"RCA_SHARD wants {need} devices "
+                    f"(sp={axes['sp']},dp={axes['dp']}), have {len(devices)}"
+                )
+            # sp innermost so node-shard collectives ride ICI neighbors
+            mesh = make_mesh(
+                [("dp", axes["dp"]), ("sp", axes["sp"])], devices[:need]
+            )
+        self.mesh = mesh
+        self.sp = int(self.mesh.shape["sp"])
+        self.dp = int(self.mesh.shape["dp"])
+        self.engine_tag = f"sharded(dp={self.dp},sp={self.sp})"
+        # the analyze path ranks ONE snapshot — the dp axis is for batch
+        # workloads (training, hypothesis sweeps) that a single snapshot
+        # cannot fill.  Execute on a dp=1 sub-mesh (the first sp-row of
+        # devices) instead of tiling dp redundant copies of the features
+        # through the upload and the propagation lanes.
+        if self.dp == 1:
+            self._exec_mesh = self.mesh
+        else:
+            from rca_tpu.parallel.mesh import make_mesh as _mm
+
+            self._exec_mesh = _mm(
+                [("dp", 1), ("sp", self.sp)],
+                list(np.asarray(self.mesh.devices).reshape(-1)[: self.sp]),
+            )
+
+    # -- core --------------------------------------------------------------
+    def _shard(self, n: int, dep_src: np.ndarray, dep_dst: np.ndarray):
+        from rca_tpu.parallel.sharded import shard_graph
+
+        buckets = self.config.shape_buckets
+        # same node tier as the dense engine (dummy-slot convention
+        # included, for identical bucket boundaries), then up to a
+        # multiple of sp inside shard_graph
+        n_pad_to = bucket_for(n + 1, buckets)
+        return shard_graph(
+            n, np.asarray(dep_src, np.int32), np.asarray(dep_dst, np.int32),
+            self.sp, n_pad_to=n_pad_to,
+            e_pad_fn=lambda e: bucket_for(e, buckets),
+        )
+
+    def analyze_arrays(
+        self,
+        features: np.ndarray,
+        dep_src: np.ndarray,
+        dep_dst: np.ndarray,
+        names: Optional[Sequence[str]] = None,
+        k: Optional[int] = None,
+        timed: bool = False,
+    ) -> EngineResult:
+        from rca_tpu.parallel.sharded import sharded_topk, stage_sharded
+
+        n = features.shape[0]
+        k = k or min(self.config.top_k_root_causes, n)
+        graph = self._shard(n, dep_src, dep_dst)
+        f = np.zeros((graph.n_pad, features.shape[1]), np.float32)
+        f[:n] = features
+        batch = f[None]  # B=1 on the dp=1 execution mesh
+        kk = min(k + 8, graph.n_pad)
+        # upload ONCE, outside the (possibly repeated) timed invocations —
+        # same methodology as the dense engine, so the two latency_ms
+        # figures stay comparable
+        mesh = self._exec_mesh
+        invoke = stage_sharded(mesh, batch, graph, self.params)
+
+        def run():
+            stack = invoke()
+            vals, idx = sharded_topk(mesh, stack[:, 3], kk)
+            # squeeze the B=1 axis on DEVICE so the fetch carries one copy
+            return stack[0], vals[0], idx[0]
+
+        stack, vals, idx, latency_ms = timed_fetch(run, timed)
+        return render_result(
+            stack, np.asarray(vals), np.asarray(idx),
+            names, n, k, latency_ms, int(len(dep_src)),
+            engine=self.engine_tag,
+        )
+
+
+def shard_requested() -> Tuple[bool, Optional[str]]:
+    """(use sharded engine?, spec) from ``RCA_SHARD`` + visible devices.
+
+    ``RCA_SHARD`` unset/empty: shard automatically when more than one
+    device is visible (SURVEY §2.9: multi-device execution is the default
+    posture on multi-chip hosts, behind the same analyze boundary).
+    ``RCA_SHARD=0/off/single`` forces the single-device engine;
+    anything else ("auto", "sp=4,dp=2") forces sharding with that layout.
+    """
+    spec = os.environ.get("RCA_SHARD", "").strip().lower()
+    if spec in ("0", "off", "single", "none", "false"):
+        return False, None
+    if spec:
+        return True, spec
+    return len(jax.devices()) > 1, "auto"
+
+
+def make_engine(
+    config: Optional[RCAConfig] = None,
+    params: Optional[PropagationParams] = None,
+):
+    """The engine the analyze path should use RIGHT NOW (env + devices)."""
+    from rca_tpu.engine.runner import GraphEngine
+
+    use_sharded, spec = shard_requested()
+    if use_sharded:
+        return ShardedGraphEngine(config=config, params=params, spec=spec)
+    return GraphEngine(config=config, params=params)

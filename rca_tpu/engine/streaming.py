@@ -69,6 +69,13 @@ class StreamingSession:
         engine: Optional[GraphEngine] = None,
         k: int = 5,
     ):
+        # deliberately the SINGLE-device engine even when RCA_SHARD is set:
+        # a streaming session's whole design is a device-resident feature
+        # buffer updated by donated-argument scatters, which has no sharded
+        # twin yet — a sharded session would need a per-shard delta scatter
+        # and a sharded resident buffer (future work, not a one-line swap;
+        # make_engine() returns engines without the _aw/_hw weight handles
+        # this class scatters with)
         self.engine = engine or GraphEngine()
         self.names = list(names)
         self.k = k

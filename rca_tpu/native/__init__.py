@@ -169,13 +169,22 @@ def _compile_cached(source: Path, out_prefix: str,
             # chmod+compile into an attacker-chosen victim-owned dir
             return None
     try:
-        # mkdir(parents=True) gives INTERMEDIATE dirs the umask default,
-        # which under umask 002 would leave a freshly-created ~/.cache
-        # group-writable and void the leaf ownership check — create every
-        # missing component 0700 ourselves
-        for part in (*reversed(cache_dir.parents), cache_dir):
-            if not part.exists():
-                part.mkdir(mode=0o700, exist_ok=True)
+        if env_dir:
+            # an explicitly-configured location may sit under deliberately
+            # shared parents: those follow the site's umask so teammates
+            # keep traversal rights.  The LEAF is still created 0700 (a
+            # fresh leaf is ours; an existing one is ownership-checked,
+            # never chmod'ed, below)
+            cache_dir.parent.mkdir(parents=True, exist_ok=True)
+            cache_dir.mkdir(mode=0o700, exist_ok=True)
+        else:
+            # default location: mkdir(parents=True) gives INTERMEDIATE
+            # dirs the umask default, which under umask 002 would leave a
+            # freshly-created ~/.cache group-writable and void the leaf
+            # ownership check — create every missing component 0700
+            for part in (*reversed(cache_dir.parents), cache_dir):
+                if not part.exists():
+                    part.mkdir(mode=0o700, exist_ok=True)
     except OSError:
         return None
     if not _owned_and_private(cache_dir, is_dir=True):

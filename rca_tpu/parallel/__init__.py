@@ -14,6 +14,7 @@ from rca_tpu.parallel.sharded import (
     ShardedGraph,
     shard_graph,
     sharded_propagate,
+    sharded_propagate_full,
     sharded_topk,
 )
 
@@ -24,5 +25,6 @@ __all__ = [
     "ShardedGraph",
     "shard_graph",
     "sharded_propagate",
+    "sharded_propagate_full",
     "sharded_topk",
 ]

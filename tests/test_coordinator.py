@@ -187,6 +187,52 @@ def test_parity_gate_50svc_findings_json_identical(fifty_svc_client):
         assert comp in jax_ranked or svc in jax_ranked or comp in jax_corr["groups"]
 
 
+def test_parity_gate_sharded_engine_behind_analyze(
+    fifty_svc_client, monkeypatch
+):
+    """SURVEY §2.9: the sharded multi-device engine lives BEHIND the
+    analyze boundary.  With RCA_SHARD=sp=4,dp=2 the UNCHANGED coordinator
+    pipeline must route correlation through ShardedGraphEngine on the
+    virtual 8-device mesh (the result records which engine ran) and
+    produce byte-identical groups and the same ranked components as the
+    single-device engine."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    ns = "synthetic"
+    monkeypatch.delenv("RCA_SHARD", raising=False)
+    monkeypatch.setenv("RCA_SHARD", "off")
+    rec_single = RCACoordinator(
+        fifty_svc_client, backend="jax"
+    ).run_analysis("comprehensive", ns)
+    monkeypatch.setenv("RCA_SHARD", "sp=4,dp=2")
+    rec_shard = RCACoordinator(
+        fifty_svc_client, backend="jax"
+    ).run_analysis("comprehensive", ns)
+    s_corr = rec_shard["results"]["correlated"]
+    d_corr = rec_single["results"]["correlated"]
+    assert d_corr["backend"] == "jax" and s_corr["backend"] == "jax", (
+        f"degraded: single={d_corr.get('fallback_reason')} "
+        f"sharded={s_corr.get('fallback_reason')}"
+    )
+    assert d_corr["engine"] == "single"
+    assert s_corr["engine"] == "sharded(dp=2,sp=4)"
+    assert (
+        json.dumps(d_corr["groups"], sort_keys=True, default=str)
+        == json.dumps(s_corr["groups"], sort_keys=True, default=str)
+    )
+    assert (
+        [r["component"] for r in s_corr["root_causes"]]
+        == [r["component"] for r in d_corr["root_causes"]]
+    )
+    # per-service scores and diagnostics agree within fp tolerance
+    for rs, rd in zip(s_corr["root_causes"], d_corr["root_causes"]):
+        assert abs(rs["score"] - rd["score"]) < 1e-4
+    roots = set(fifty_svc_client.world.ground_truth["fault_roots"])
+    assert s_corr["root_causes"][0]["component"] in roots
+
+
 def test_correlate_backend_fallback(ctx):
     # no ctx -> jax backend degrades to deterministic AND says so
     out = correlate_findings(

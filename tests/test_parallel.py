@@ -167,3 +167,91 @@ def test_initialize_distributed_single_process_noop(monkeypatch):
     local, global_ = info1["local_device_count"], info1["global_device_count"]
     assert (local is None and global_ is None) or (local == global_ > 0)
     assert info2 == info1
+
+
+@pytest.mark.parametrize(
+    "n,spec",
+    [(50, "sp=4,dp=2"), (300, "auto"), (63, "sp=8"), (5, "sp=2,dp=1")],
+)
+def test_sharded_engine_matches_dense_engine(n, spec):
+    """ShardedGraphEngine is the dense engine's drop-in twin: identical
+    scores AND diagnostics (anomaly/upstream/impact) and identical ranked
+    components on the same case — the property the analyze boundary relies
+    on when make_engine auto-selects it."""
+    from rca_tpu.engine import ShardedGraphEngine
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    case = synthetic_cascade_arrays(n, n_roots=min(2, max(1, n // 30)), seed=3)
+    dense = GraphEngine().analyze_case(case, k=5)
+    sh = ShardedGraphEngine(spec=spec).analyze_case(case, k=5)
+    np.testing.assert_allclose(sh.score, dense.score, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sh.anomaly, dense.anomaly, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sh.upstream, dense.upstream, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sh.impact, dense.impact, rtol=1e-5, atol=1e-6)
+    assert [r["component"] for r in sh.ranked] == \
+        [r["component"] for r in dense.ranked]
+    assert sh.engine.startswith("sharded(") and dense.engine == "single"
+
+
+def test_make_engine_selection(monkeypatch):
+    """RCA_SHARD drives the analyze-boundary engine choice at call time."""
+    from rca_tpu.engine import GraphEngine as GE
+    from rca_tpu.engine import ShardedGraphEngine, make_engine
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    monkeypatch.setenv("RCA_SHARD", "sp=4,dp=2")
+    e = make_engine()
+    assert isinstance(e, ShardedGraphEngine)
+    assert (e.dp, e.sp) == (2, 4)
+    monkeypatch.setenv("RCA_SHARD", "off")
+    assert isinstance(make_engine(), GE)
+    # unset: auto-shard because >1 device is visible
+    monkeypatch.delenv("RCA_SHARD")
+    assert isinstance(make_engine(), ShardedGraphEngine)
+    # malformed spec fails loudly, not silently single-device
+    monkeypatch.setenv("RCA_SHARD", "sp=banana")
+    with pytest.raises(ValueError):
+        make_engine()
+
+
+def test_sharded_engine_shape_bucket_reuse():
+    """Two graphs in the same shape bucket must produce the SAME padded
+    shapes (the compile-cache contract the dense engine honors)."""
+    from rca_tpu.engine import ShardedGraphEngine
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    eng = ShardedGraphEngine(spec="sp=4,dp=2")
+    c1 = synthetic_cascade_arrays(40, 1, seed=0)
+    c2 = synthetic_cascade_arrays(55, 1, seed=1)
+    g1 = eng._shard(40, c1.dep_src, c1.dep_dst)
+    g2 = eng._shard(55, c2.dep_src, c2.dep_dst)
+    assert g1.n_pad == g2.n_pad
+    assert g1.src_local.shape == g2.src_local.shape
+
+
+def test_shard_spec_rejects_zero_and_misconfig_is_loud(monkeypatch):
+    """sp=0/dp=0 fail at the parse site with a clear message, and a
+    misconfigured RCA_SHARD raises out of the correlation path instead of
+    silently demoting every analysis to the deterministic correlator."""
+    from rca_tpu.agents import AnalysisContext
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+    from rca_tpu.coordinator import correlate_findings
+    from rca_tpu.engine.sharded_runner import parse_shard_spec
+
+    for bad in ("sp=0", "dp=0,sp=4", "sp=-1"):
+        with pytest.raises(ValueError, match="RCA_SHARD"):
+            parse_shard_spec(bad, 8)
+
+    monkeypatch.setenv("RCA_SHARD", f"sp={len(jax.devices()) * 64}")
+    ctx = AnalysisContext(
+        ClusterSnapshot.capture(
+            MockClusterClient(five_service_world()), NS
+        )
+    )
+    with pytest.raises(ValueError, match="devices"):
+        correlate_findings({}, ctx=ctx, backend="jax")
