@@ -24,6 +24,25 @@ from rca_tpu.cluster.generator import synthetic_cascade_world  # noqa: E402
 from rca_tpu.cluster.mock_client import MockClusterClient  # noqa: E402
 
 
+def import_setup_tool():
+    """Import tools/setup_test_cluster.py (not a package; path-local).
+    Remove the EXACT entry afterwards — the tool itself appends the repo
+    root to sys.path at import, so a blind pop(0) could strip the wrong
+    path."""
+    import sys as _sys
+
+    tools = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    )
+    _sys.path.insert(0, tools)
+    try:
+        import setup_test_cluster as stc
+    finally:
+        _sys.path.remove(tools)
+    return stc
+
+
 @pytest.fixture()
 def five_svc_client() -> MockClusterClient:
     return MockClusterClient(five_service_world())

@@ -153,3 +153,61 @@ def test_analyzer_finds_injected_faults_on_live_cluster(kind_cluster):
     assert any(name in top for name in ("database", "api-gateway")), (
         f"top root cause {top!r} is not one of the crashing workloads"
     )
+
+
+from tests.conftest import import_setup_tool as _setup_tool  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def oom_chain_cluster():
+    """Deploy the BASELINE row-3 oom-chain-200 profile (its own kind
+    cluster — the profile needs worker nodes the five-service cluster
+    does not have) and wait for the OOMKill loop via the tool's canonical
+    wait protocol."""
+    from rca_tpu.cluster.oomchain import OOM_NS, OOM_ROOT
+
+    stc = _setup_tool()
+    rc = subprocess.call(
+        [sys.executable, SETUP, "--profile", "oom-chain-200"]
+    )
+    if rc != 0:
+        pytest.fail(f"setup_test_cluster.py --profile oom-chain-200 "
+                    f"exited {rc}")
+    # the root warms ~20s, then the 150Mi fill OOMs against 128Mi; the
+    # shared wait protocol insists on the OOMKilled reason, then settles
+    # so the cascade propagates a few 5s probe cycles down the tree
+    if not stc.wait_for_fault(OOM_NS, OOM_ROOT,
+                              require_reason="OOMKilled"):
+        pytest.fail("root never OOMKilled within the deadline")
+    yield OOM_NS
+    if not os.environ.get("RCA_KIND_KEEP"):
+        subprocess.call(
+            [sys.executable, SETUP, "--profile", "oom-chain-200",
+             "--delete"]
+        )
+
+
+def test_oom_chain_200_measurement(oom_chain_cluster):
+    """BASELINE.md row 3 measured live: end-to-end analyze latency +
+    hit@1 on the 200-pod OOMKill chain, recorded through the SAME
+    run_measurement hook the CLI's --measure uses (one recording format,
+    no drift) as KIND_r03.json."""
+    import json
+
+    from rca_tpu.cluster.oomchain import OOM_ROOT
+
+    stc = _setup_tool()
+    # distinct path: the committed KIND_r03.json is the hermetic-mock
+    # placeholder BASELINE.md quotes; a live run must not silently
+    # overwrite it
+    out_path = os.path.join(REPO, "KIND_r03_live.json")
+    # the fixture already waited for the OOMKill + cascade settle
+    rc = stc.run_measurement(
+        oom_chain_cluster, OOM_ROOT, out_path,
+        "oom_chain_200_analyze", OOM_ROOT, wait=False,
+    )
+    assert rc == 0
+    result = json.load(open(out_path))
+    assert result["environment"] == "live-kind"
+    assert result["backend"] == "jax", result["fallback_reason"]
+    assert result["hit1"] is True, result["top5"]

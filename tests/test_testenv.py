@@ -3,11 +3,14 @@ kind-cluster manifest generator (dry-run, hermetic)."""
 
 import inspect
 import json
+import os
 import subprocess
 import sys
 
 from rca_tpu.cluster import CLUSTER_CLIENT_METHODS, MockClusterClient
 from rca_tpu.cluster.k8s_client import K8sApiClient
+
+from tests.conftest import import_setup_tool as _stc  # noqa: E402
 
 
 def test_signature_conformance_mock_vs_real():
@@ -27,12 +30,7 @@ def test_signature_conformance_mock_vs_real():
 
 
 def test_setup_cluster_dry_run_manifests():
-    sys.path.insert(0, "tools")
-    try:
-        import setup_test_cluster as stc
-    finally:
-        sys.path.pop(0)
-
+    stc = _stc()
     manifests = stc.build_manifests()
     by_kind = {}
     for m in manifests:
@@ -85,11 +83,7 @@ def test_setup_cluster_dry_run_cli():
 def test_mock_and_manifests_agree_on_fault_roots():
     """The hermetic fixture and the live-cluster manifests model the same
     faulted world — analyzers can be validated against either."""
-    sys.path.insert(0, "tools")
-    try:
-        import setup_test_cluster as stc
-    finally:
-        sys.path.pop(0)
+    stc = _stc()
     from rca_tpu.cluster.fixtures import five_service_world
 
     world = five_service_world()
@@ -99,3 +93,103 @@ def test_mock_and_manifests_agree_on_fault_roots():
         if e["component"] != "backend-network-policy"
     }
     assert fixture_faults == manifest_comps
+
+
+def test_oom_chain_manifests_shape():
+    """BASELINE.md row 3 dry-run: ~200 pods, one OOMKill root whose fill
+    EXCEEDS its memory limit, a connected dependency tree via PARENT_URL
+    env DNS, and worker nodes so kubelet's 110-pod cap cannot bite."""
+    from rca_tpu.cluster.oomchain import OOM_NS, OOM_ROOT, oom_chain_topology
+
+    stc = _stc()
+    manifests = stc.build_oom_chain_manifests(200)
+    by_kind = {}
+    for m in manifests:
+        by_kind.setdefault(m["kind"], []).append(m)
+    deployments = {d["metadata"]["name"]: d for d in by_kind["Deployment"]}
+    services, parent, replicas = oom_chain_topology(200)
+
+    assert set(deployments) == set(services)
+    assert {s["metadata"]["name"] for s in by_kind["Service"]} == set(services)
+    total_pods = sum(
+        d["spec"]["replicas"] for d in deployments.values()
+    )
+    assert 190 <= total_pods <= 200
+    assert total_pods == sum(replicas.values())
+
+    root = deployments[OOM_ROOT]["spec"]["template"]["spec"]
+    cmd = " ".join(root["containers"][0]["command"])
+    # the fill must EXCEED the limit (real OOMKill, not just pressure),
+    # the hog must be PID 1 so the kill lands on the container, and the
+    # root must SERVE during its warm window — otherwise the cascade
+    # exists from deploy time instead of being OOM-driven
+    assert "count=150" in cmd and "exec dd" in cmd
+    assert "httpd" in cmd
+    assert root["containers"][0]["resources"]["limits"]["memory"] == "128Mi"
+    assert root["volumes"][0]["emptyDir"] == {"medium": "Memory"}
+
+    # every victim's PARENT_URL names its topology parent; the tree is
+    # connected to the root
+    for svc, par in parent.items():
+        env = {
+            e["name"]: e["value"]
+            for e in deployments[svc]["spec"]["template"]["spec"]
+            ["containers"][0].get("env", [])
+        }
+        assert f"//{par}.{OOM_NS}." in env["PARENT_URL"], (svc, env)
+    reached = {OOM_ROOT}
+    frontier = [OOM_ROOT]
+    children = {}
+    for svc, par in parent.items():
+        children.setdefault(par, []).append(svc)
+    while frontier:
+        nxt = children.get(frontier.pop(), [])
+        reached.update(nxt)
+        frontier.extend(nxt)
+    assert reached == set(services)
+
+    # kind topology: the 200-pod profile gets worker nodes
+    cfg = stc.kind_config("oom-chain-200")
+    roles = [n["role"] for n in cfg["nodes"]]
+    assert roles.count("worker") >= 2
+    assert stc.kind_config("five-service")["nodes"][0]["role"] == \
+        "control-plane"
+
+
+def test_oom_chain_mock_twin_measurement():
+    """The hermetic twin of the row-3 config: 200 pods, the engine ranks
+    the OOMKilled root above all 66 symptomatic victims, through the SAME
+    measurement hook the live kind path records (KIND_r*.json shape)."""
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.oomchain import (
+        OOM_NS,
+        OOM_ROOT,
+        measure_analyze,
+        oom_chain_world,
+    )
+
+    world = oom_chain_world(200)
+    assert world.ground_truth["n_pods"] == 200
+    out = measure_analyze(MockClusterClient(world), OOM_NS, OOM_ROOT)
+    assert out["status"] == "completed"
+    assert out["backend"] == "jax", out["fallback_reason"]
+    assert out["hit1"] is True, out["top5"]
+    assert out["latency_warm_ms"] > 0
+    assert out["latency_first_run_ms"] >= out["latency_warm_ms"] * 0.5
+    # deterministic oracle agrees on the root service
+    det = measure_analyze(
+        MockClusterClient(world), OOM_NS, OOM_ROOT, backend="deterministic"
+    )
+    assert det["status"] == "completed"
+    assert any(OOM_ROOT in c for c in det["top5"]), det["top5"]
+
+
+def test_oom_chain_dry_run_cli():
+    out = subprocess.run(
+        [sys.executable, "tools/setup_test_cluster.py",
+         "--profile", "oom-chain-200", "--dry-run"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0
+    assert "cache" in out.stdout and "svc-000" in out.stdout
+    assert "OOMKilled" in out.stderr  # oracle on stderr

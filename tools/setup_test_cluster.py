@@ -1,39 +1,73 @@
 #!/usr/bin/env python3
-"""Create a kind cluster with 5 intentionally-faulted microservices.
+"""Create a kind cluster with intentionally-faulted microservices.
 
-Behavioral parity with the reference's live test environment (reference:
-setup_test_cluster.py — backend busybox CPU spin-loop :160-162, database
-``sleep 30; exit 1`` restart loop :209, api-gateway exiting on a missing
-required env var :256, resource-service writing ~90MiB into a memory-backed
-emptyDir against a 128Mi limit :303-310, a NetworkPolicy admitting traffic
-only from a nonexistent app :329-346; kind-config.yaml:1-12) — with the
-manifests generated programmatically and a ``--dry-run`` mode that prints
-them without needing Docker, so the generator itself is testable hermetically.
+Two profiles:
+
+``five-service`` (default) — behavioral parity with the reference's live
+test environment (reference: setup_test_cluster.py — backend busybox CPU
+spin-loop :160-162, database ``sleep 30; exit 1`` restart loop :209,
+api-gateway exiting on a missing required env var :256, resource-service
+writing ~90MiB into a memory-backed emptyDir against a 128Mi limit
+:303-310, a NetworkPolicy admitting traffic only from a nonexistent app
+:329-346; kind-config.yaml:1-12).
+
+``oom-chain-200`` — BASELINE.md row 3: ~200 pods in a dependency tree
+whose root ("cache") fills a memory-backed emptyDir PAST its 128Mi limit
+(the reference's :303-310 trick, pushed over the edge) so the kernel
+OOM-kills it into a restart loop; every victim serves via busybox httpd
+but kills its own server while its parent is unreachable, so the outage
+genuinely cascades tier by tier.  Topology comes from
+``rca_tpu.cluster.oomchain`` — the same source as the hermetic mock twin,
+so the live cluster and the mock world cannot drift apart.
+
+Manifests are generated programmatically; ``--dry-run`` prints them
+without needing Docker, so the generator itself is testable hermetically;
+``--measure`` runs the BASELINE row-3 measurement (end-to-end analyze
+latency + hit@1) against the live cluster and writes ``KIND_rNN.json``.
 
 Usage:
-    python tools/setup_test_cluster.py                 # create + deploy
-    python tools/setup_test_cluster.py --dry-run       # print manifests
-    python tools/setup_test_cluster.py --delete        # tear down
+    python tools/setup_test_cluster.py                     # create + deploy
+    python tools/setup_test_cluster.py --profile oom-chain-200
+    python tools/setup_test_cluster.py --dry-run           # print manifests
+    python tools/setup_test_cluster.py --profile oom-chain-200 --measure
+    python tools/setup_test_cluster.py --delete            # tear down
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import shutil
 import subprocess
 import sys
 import tempfile
 from typing import Any, Dict, List
 
+# the oom-chain topology lives in the package so the mock twin shares it;
+# APPEND (not insert-at-0) so callers that temporarily push tools/ onto
+# sys.path and pop(0) afterwards don't pop the wrong entry
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.append(_REPO_ROOT)
+
 CLUSTER_NAME = "rca-tpu-test"
 NAMESPACE = "test-microservices"
+PROFILES = ("five-service", "oom-chain-200")
 
-KIND_CONFIG: Dict[str, Any] = {
-    "kind": "Cluster",
-    "apiVersion": "kind.x-k8s.io/v1alpha4",
-    "name": CLUSTER_NAME,
-    "nodes": [
+
+def cluster_name(profile: str = "five-service") -> str:
+    """Per-profile cluster name: the two profiles need incompatible node
+    topologies (1 node vs 3), so they must not share a kind cluster — a
+    reused 1-node cluster would strand ~90 of the 200 pods Pending behind
+    kubelet's 110-pod cap."""
+    return CLUSTER_NAME if profile == "five-service" else "rca-tpu-oom"
+
+
+def kind_config(profile: str = "five-service") -> Dict[str, Any]:
+    """Cluster topology per profile: the 200-pod profile needs worker
+    nodes (kubelet defaults to max 110 pods per node)."""
+    nodes: List[Dict[str, Any]] = [
         {
             "role": "control-plane",
             "extraPortMappings": [
@@ -41,8 +75,17 @@ KIND_CONFIG: Dict[str, Any] = {
                  "protocol": "TCP"},
             ],
         }
-    ],
-}
+    ]
+    if profile == "oom-chain-200":
+        nodes += [{"role": "worker"}, {"role": "worker"}]
+    return {
+        "kind": "Cluster",
+        "apiVersion": "kind.x-k8s.io/v1alpha4",
+        "name": cluster_name(profile),
+        "nodes": nodes,
+    }
+
+
 
 
 def _workload(
@@ -55,6 +98,7 @@ def _workload(
     limits: Dict[str, str] | None = None,
     volumes: List[dict] | None = None,
     volume_mounts: List[dict] | None = None,
+    namespace: str = NAMESPACE,
 ) -> Dict[str, Any]:
     container: Dict[str, Any] = {
         "name": name,
@@ -77,7 +121,7 @@ def _workload(
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
-        "metadata": {"name": name, "namespace": NAMESPACE,
+        "metadata": {"name": name, "namespace": namespace,
                      "labels": {"app": name}},
         "spec": {
             "replicas": replicas,
@@ -90,11 +134,12 @@ def _workload(
     }
 
 
-def _service(name: str, port: int = 80) -> Dict[str, Any]:
+def _service(name: str, port: int = 80,
+             namespace: str = NAMESPACE) -> Dict[str, Any]:
     return {
         "apiVersion": "v1",
         "kind": "Service",
-        "metadata": {"name": name, "namespace": NAMESPACE},
+        "metadata": {"name": name, "namespace": namespace},
         "spec": {
             "selector": {"app": name},
             "ports": [{"port": port, "targetPort": port}],
@@ -190,6 +235,97 @@ def build_manifests() -> List[Dict[str, Any]]:
     return manifests
 
 
+def build_oom_chain_manifests(n_pods: int = 200) -> List[Dict[str, Any]]:
+    """BASELINE.md row 3: the ~200-pod OOMKill cascade.
+
+    Root: PID 1 is the memory hog (``exec dd`` of 150MiB into a
+    memory-backed emptyDir against a 128Mi limit), so the cgroup OOM kill
+    lands on the container itself — status OOMKilled / exit 137 / restart
+    loop, not a silently-killed child process.  Victims: serve ``ok`` via
+    busybox httpd, probe their parent every 5s, and KILL their own httpd
+    while the parent is unreachable (restarting it when the parent
+    returns) — the outage cascades tier by tier down the dependency tree
+    and every victim logs connection-refused errors against its parent.
+    """
+    from rca_tpu.cluster.oomchain import OOM_NS, OOM_ROOT, oom_chain_topology
+
+    services, parent, replicas = oom_chain_topology(n_pods)
+    manifests: List[Dict[str, Any]] = [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": OOM_NS}},
+    ]
+    # the root SERVES during its warm window (httpd daemonizes into the
+    # background) so its children are healthy until the OOM kill lands —
+    # otherwise the cascade would exist from deploy time and be
+    # indistinguishable from a service with no endpoints.  `exec dd`
+    # makes the memory hog PID 1: when the cgroup OOMs, dd dies (directly,
+    # or after the killer first takes the tiny httpd and the still-filling
+    # dd immediately re-triggers), the container exits 137/OOMKilled, and
+    # each CrashLoopBackOff restart brings httpd back for another warm
+    # window — the outage oscillates with the OOMKill loop, genuinely
+    # OOM-driven.
+    manifests.append(
+        _workload(
+            OOM_ROOT,
+            ["sh", "-c",
+             "mkdir -p /www; echo ok > /www/index.html; "
+             "httpd -p 80 -h /www; "
+             "echo 'INFO: cache warming...'; sleep 20; "
+             "echo 'INFO: loading 150MiB working set'; "
+             "exec dd if=/dev/zero of=/scratch/fill bs=1M count=150"],
+            replicas=replicas[OOM_ROOT],
+            requests={"cpu": "50m", "memory": "64Mi"},
+            limits={"cpu": "100m", "memory": "128Mi"},
+            volumes=[{"name": "scratch", "emptyDir": {"medium": "Memory"}}],
+            volume_mounts=[{"name": "scratch", "mountPath": "/scratch"}],
+            namespace=OOM_NS,
+        )
+    )
+    victim_script = (
+        "mkdir -p /www; echo ok > /www/index.html; "
+        "httpd -p 80 -h /www; "
+        "while true; do "
+        'if wget -q -T 2 -O /dev/null "$PARENT_URL"; then '
+        "pidof httpd >/dev/null || httpd -p 80 -h /www; "
+        "else "
+        'echo "ERROR: connection refused to $PARENT_URL (ECONNREFUSED)"; '
+        "killall httpd 2>/dev/null; "
+        "fi; sleep 5; done"
+    )
+    for svc in services:
+        if svc == OOM_ROOT:
+            continue
+        up = parent[svc]
+        manifests.append(
+            _workload(
+                svc,
+                ["sh", "-c", victim_script],
+                replicas=replicas[svc],
+                env=[{"name": "PARENT_URL",
+                      "value": f"http://{up}.{OOM_NS}.svc.cluster.local:80"}],
+                requests={"cpu": "10m", "memory": "16Mi"},
+                limits={"cpu": "100m", "memory": "64Mi"},
+                namespace=OOM_NS,
+            )
+        )
+    for svc in services:
+        manifests.append(_service(svc, namespace=OOM_NS))
+    return manifests
+
+
+def oom_chain_expected_findings() -> List[Dict[str, str]]:
+    from rca_tpu.cluster.oomchain import OOM_ROOT
+
+    return [
+        {"component": OOM_ROOT,
+         "expect": "OOMKilled restart loop: 150MiB memory-backed volume "
+                   "fill against a 128Mi limit (exit 137)"},
+        {"component": "svc-000",
+         "expect": "connection-refused probe errors against the cache "
+                   "parent (first cascade tier)"},
+    ]
+
+
 def expected_findings() -> List[Dict[str, str]]:
     """What an analyzer must surface on this environment (the regression
     oracle; reference: setup_test_cluster.py:382-398)."""
@@ -216,55 +352,203 @@ def _to_yaml(docs: List[Dict[str, Any]]) -> str:
         return "\n".join(json.dumps(d) for d in docs)
 
 
+def profile_parts(profile: str, n_pods: int = 200) -> Dict[str, Any]:
+    """Everything profile-specific in one place."""
+    if profile == "oom-chain-200":
+        from rca_tpu.cluster.oomchain import OOM_NS, OOM_ROOT
+
+        return {
+            "manifests": build_oom_chain_manifests(n_pods),
+            "namespace": OOM_NS,
+            "oracle": oom_chain_expected_findings(),
+            "root_app": OOM_ROOT,
+            "require_reason": "OOMKilled",
+            "metric": "oom_chain_200_analyze",
+            # _live: KIND_r03.json is the committed hermetic-mock
+            # placeholder BASELINE.md quotes — a live measurement must
+            # never silently overwrite it
+            "out": "KIND_r03_live.json",
+        }
+    return {
+        "manifests": build_manifests(),
+        "namespace": NAMESPACE,
+        "oracle": expected_findings(),
+        "root_app": "database",
+        "require_reason": None,
+        "metric": "five_service_analyze",
+        "out": "KIND_five_service.json",
+    }
+
+
+def wait_for_fault(namespace: str, root_app: str,
+                   deadline_s: int = 600,
+                   require_reason: str | None = None,
+                   settle_s: int = 60) -> bool:
+    """Block until the profile's crashing root has restarted at least
+    once (both profiles' roots crash-loop: the five-service database
+    exits 1, the oom-chain cache is OOMKilled — pass
+    ``require_reason="OOMKilled"`` to insist on the kill reason), then
+    settle ``settle_s`` so the cascade/metrics manifest.  Measuring a
+    just-applied namespace would record a healthy cluster as the row-3
+    baseline.  This is the ONE wait protocol — the opt-in kind test and
+    ``--measure`` both use it, so their criteria cannot drift."""
+    import time
+
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            out = subprocess.run(
+                ["kubectl", "get", "pods", "-n", namespace,
+                 "-l", f"app={root_app}",
+                 "-o", "jsonpath={range .items[*]}"
+                 "{.status.containerStatuses[0].restartCount} "
+                 "{.status.containerStatuses[0].lastState.terminated"
+                 ".reason}\n{end}"],
+                capture_output=True, text=True,
+                # without this, a hung API server (plausible under
+                # 200-pod memory pressure) makes deadline_s meaningless
+                timeout=60,
+            ).stdout
+        except subprocess.TimeoutExpired:
+            out = ""
+        for line in out.splitlines():
+            parts = line.split()
+            if not parts or not parts[0].isdigit() or int(parts[0]) < 1:
+                continue
+            if require_reason and (
+                len(parts) < 2 or parts[1] != require_reason
+            ):
+                continue
+            time.sleep(settle_s)
+            return True
+        time.sleep(15)
+    return False
+
+
+def run_measurement(namespace: str, expected_root: str, out_path: str,
+                    metric: str, root_app: str,
+                    wait: bool = True,
+                    require_reason: str | None = None) -> int:
+    """BASELINE.md row-3 hook: end-to-end analyze latency + hit@1 against
+    the LIVE cluster, recorded as one JSON file for the judge."""
+    from rca_tpu.cluster.k8s_client import K8sApiClient
+    from rca_tpu.cluster.oomchain import measure_analyze
+
+    client = K8sApiClient()
+    if not client.is_connected():
+        print("no reachable cluster for --measure", file=sys.stderr)
+        return 1
+    if wait and not wait_for_fault(
+        namespace, root_app, require_reason=require_reason
+    ):
+        print(f"fault never manifested on {root_app} in {namespace}; "
+              "not recording a healthy-cluster measurement",
+              file=sys.stderr)
+        return 1
+    result = measure_analyze(client, namespace, expected_root)
+    result["metric"] = metric
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return 0 if result["status"] == "completed" else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile", choices=PROFILES, default="five-service")
+    ap.add_argument("--pods", type=int, default=200,
+                    help="pod budget for the oom-chain profile")
     ap.add_argument("--dry-run", action="store_true",
                     help="print manifests and expected findings; no cluster")
     ap.add_argument("--delete", action="store_true",
                     help="delete the kind cluster")
+    ap.add_argument("--measure", action="store_true",
+                    help="run the BASELINE row-3 measurement against the "
+                    "live cluster (after deploy, or alone on an existing "
+                    "cluster) and write --out")
+    ap.add_argument("--out", default=None,
+                    help="measurement output path (with --measure); "
+                    "defaults to the profile's KIND_*.json")
+    ap.add_argument("--measure-only", action="store_true",
+                    help="skip deploy; only measure an existing cluster")
     args = ap.parse_args(argv)
 
+    name = cluster_name(args.profile)
     if args.delete:
         return subprocess.call(
-            ["kind", "delete", "cluster", "--name", CLUSTER_NAME]
+            ["kind", "delete", "cluster", "--name", name]
         )
 
-    manifests = build_manifests()
+    p = profile_parts(args.profile, args.pods)
+    # anchor the default to the repo root (where BASELINE.md points the
+    # reader), not the caller's cwd
+    out_path = args.out or os.path.join(_REPO_ROOT, p["out"])
     if args.dry_run:
-        print(_to_yaml([KIND_CONFIG]))
+        print(_to_yaml([kind_config(args.profile)]))
         print("---")
-        print(_to_yaml(manifests))
+        print(_to_yaml(p["manifests"]))
         print("--- expected findings ---", file=sys.stderr)
-        print(json.dumps(expected_findings(), indent=2), file=sys.stderr)
+        print(json.dumps(p["oracle"], indent=2), file=sys.stderr)
         return 0
+
+    if args.measure_only:
+        return run_measurement(
+            p["namespace"], p["oracle"][0]["component"], out_path,
+            p["metric"], p["root_app"],
+            require_reason=p["require_reason"],
+        )
 
     if shutil.which("kind") is None or shutil.which("kubectl") is None:
         print("kind/kubectl not found — run with --dry-run to inspect "
               "manifests", file=sys.stderr)
         return 1
+    profile_cfg = kind_config(args.profile)
     with tempfile.NamedTemporaryFile("w", suffix=".yaml",
                                      delete=False) as f:
-        f.write(_to_yaml([KIND_CONFIG]))
+        f.write(_to_yaml([profile_cfg]))
         kind_cfg = f.name
     existing = subprocess.run(
         ["kind", "get", "clusters"], capture_output=True, text=True
     ).stdout.split()
-    if CLUSTER_NAME not in existing:
+    if name not in existing:
         rc = subprocess.call(
             ["kind", "create", "cluster", "--config", kind_cfg]
         )
         if rc:
             return rc
+    else:
+        # a reused cluster must satisfy the profile's node topology: the
+        # 200-pod profile on a 1-node cluster leaves ~90 pods Pending
+        # behind kubelet's 110-pod cap and records a broken cascade
+        have = len(subprocess.run(
+            ["kind", "get", "nodes", "--name", name],
+            capture_output=True, text=True,
+        ).stdout.split())
+        need = len(profile_cfg["nodes"])
+        if have < need:
+            print(f"existing cluster {name} has {have} node(s); "
+                  f"profile {args.profile} needs {need}. Run --delete "
+                  "first to recreate with the right topology.",
+                  file=sys.stderr)
+            return 1
     with tempfile.NamedTemporaryFile("w", suffix=".yaml",
                                      delete=False) as f:
-        f.write(_to_yaml(manifests))
+        f.write(_to_yaml(p["manifests"]))
         manifest_path = f.name
     rc = subprocess.call(["kubectl", "apply", "-f", manifest_path])
     if rc == 0:
         print(json.dumps(
-            {"cluster": CLUSTER_NAME, "namespace": NAMESPACE,
-             "expected_findings": expected_findings()}, indent=2,
+            {"cluster": name, "namespace": p["namespace"],
+             "profile": args.profile,
+             "expected_findings": p["oracle"]},
+            indent=2,
         ))
+        if args.measure:
+            return run_measurement(
+                p["namespace"], p["oracle"][0]["component"], out_path,
+                p["metric"], p["root_app"],
+                require_reason=p["require_reason"],
+            )
     return rc
 
 
