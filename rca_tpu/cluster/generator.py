@@ -64,6 +64,11 @@ class CascadeArrays:
     roots: np.ndarray  # int32 [n_roots] ground-truth fault roots
     anomaly: np.ndarray  # float32 [n] scalar anomaly per service
     names: Optional[List[str]] = None
+    # diagnosis metadata (autopsy tooling, not consumed by the engine):
+    # decoy service indices (correlated modes) and hop distance from the
+    # nearest root along dependent edges (INT32_MAX = unaffected)
+    decoys: Optional[np.ndarray] = None
+    hops: Optional[np.ndarray] = None
 
 
 def _build_dag(n: int, rng: np.random.Generator, max_deps: int = 3):
@@ -253,6 +258,7 @@ def synthetic_cascade_arrays(
         feats[aff_idx, F_ERROR_RATE] = 0.7 * aff_decay * jitter
         feats[aff_idx, F_LATENCY] = 0.8 * aff_decay * jitter
 
+    decoys = None
     if correlated:
         # decoy services: loud but inert (no blast radius) — error/latency
         # spikes from e.g. a bad canary; ~2% of services, never roots or
@@ -284,6 +290,8 @@ def synthetic_cascade_arrays(
         roots=np.sort(roots),
         anomaly=anomaly.astype(np.float32),
         names=names,
+        decoys=None if decoys is None else np.sort(decoys).astype(np.int32),
+        hops=hops.astype(np.int64),
     )
 
 

@@ -165,12 +165,19 @@ def propagate_ell(
 
     a_ex = background_excess(a, n_live)
 
+    # dependent count for the degree-normalized impact mean: table lanes
+    # from the mask, hub residue through the same overflow scatter (padded
+    # overflow lanes point at the dummy node and only inflate its count)
+    deg = dn_mask.sum(axis=1).at[dn_ovf_seg].add(1.0)
+    inv_deg = 1.0 / jnp.maximum(deg, 1.0)
+
     def imp_step(m, _):
         vals = (a_ex[dn_idx] + decay * m[dn_idx]) * dn_mask
         m_new = vals.sum(axis=1)
         # padded overflow lanes point at the dummy node whose a=m=0
         ovf = a_ex[dn_ovf_other] + decay * m[dn_ovf_other]
         m_new = m_new.at[dn_ovf_seg].add(ovf)
+        m_new = m_new * inv_deg
         m_new = m_new.at[-1].set(0.0)
         return m_new, None
 

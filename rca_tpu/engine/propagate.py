@@ -10,8 +10,16 @@ Math (S services, E dependency edges (s → d) meaning "s depends on d"):
     a  = 1 - ∏_c (1 - w_c f_c)            anomaly evidence (noisy-OR)
     h  = 1 - ∏_c (1 - v_c f_c)            hard "I am broken" evidence
     u_s = max_{(s,d)} max(h_d, γ·u_d)     upstream explanation (K steps)
-    m_d = Σ_{(s,d)} (a_s + γ·m_s)         downstream impact     (K steps)
-    score = (a + β·tanh(m/4)) · (1 - μ·u)
+    m_d = (1/deg_d) Σ_{(s,d)} (ā_s + γ·m_s)   downstream impact (K steps)
+    score = a · (1 + β·tanh(m)) · (1 - μ·u·(1-h))
+
+where ā is the anomaly excess over the cascade-wide background and deg_d
+is d's dependent count.  The impact mean is DEGREE-NORMALIZED (formula v3):
+"how symptomatic is my average dependent" is fan-in invariant, where the
+raw sum grows with fan-in and let any hub service accumulate a saturating
+impact bonus from correlated background alone (the round-2 adversarial
+misses — every winner was an early-DAG hub with m in the tens; see
+tools/accuracy_report.py and PERF.md).
 
 A root cause is a service with strong hard evidence, no broken upstream
 dependency, and many symptomatic dependents — exactly the ranking the
@@ -33,8 +41,10 @@ from rca_tpu.features.schema import NUM_SERVICE_FEATURES, SvcF
 
 # Bumped whenever the scoring semantics change (weights fitted against one
 # objective surface mis-rank under another): v2 = multiplicative impact
-# bonus on background-excess accumulation (v1 was additive on raw anomaly).
-SCORE_FORMULA_VERSION = 2
+# bonus on background-excess accumulation (v1 was additive on raw anomaly);
+# v3 = degree-normalized impact mean (v2's raw sum scaled with fan-in, so
+# hub services saturated the bonus on correlated background alone).
+SCORE_FORMULA_VERSION = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,7 +54,11 @@ class PropagationParams:
     steps: int = 8               # propagation iterations (graph diameter cap)
     decay: float = 0.7           # γ per-hop decay
     explain_strength: float = 0.85  # μ suppression by an anomalous upstream
-    impact_bonus: float = 0.5    # β downstream-impact bonus
+    # β downstream-impact bonus.  v3 formula: m is a degree-normalized mean
+    # (bounded), so β can be strong without hub risk — 1.6 picked by sweep
+    # on tuning band 3000:3040, validated on disjoint bands 1000/2000:+60
+    # (tools/accuracy_report.py; the v2 raw-sum formula capped β at 0.5)
+    impact_bonus: float = 1.6
 
     def weight_arrays(self):
         return (
@@ -125,10 +139,13 @@ def combine_score(a, h, u, m, explain_strength, impact_bonus):
     right even when a dependency is also broken (concurrent-root cascades).
     The impact bonus is MULTIPLICATIVE on the node's own evidence: a
     symptomatic blast radius amplifies existing evidence of being broken; it
-    cannot make a healthy hub look like a root cause on fan-out alone."""
+    cannot make a healthy hub look like a root cause on fan-out alone.
+    ``m`` arrives DEGREE-NORMALIZED (mean dependent symptom level, roughly
+    0..1/(1-γ)), so tanh(m) uses its steep region where real cascades live
+    — no /4 temper as in the v2 raw-sum formula."""
     return (
         a
-        * (1.0 + impact_bonus * jnp.tanh(m / 4.0))
+        * (1.0 + impact_bonus * jnp.tanh(m))
         * (1.0 - explain_strength * u * (1.0 - h))
     )
 
@@ -201,9 +218,14 @@ def propagate_core(
 
     a_ex = background_excess(a, n_live)
 
+    # dependent count per service for the impact MEAN (padded edges point
+    # at the dummy slot, so live degrees come from real edges only)
+    deg = jnp.zeros_like(a).at[dep_dst].add(1.0)
+    inv_deg = 1.0 / jnp.maximum(deg, 1.0)
+
     def imp_step(m, _):
         vals = a_ex[dep_src] + decay * m[dep_src]
-        return jnp.zeros_like(m).at[dep_dst].add(vals), None
+        return jnp.zeros_like(m).at[dep_dst].add(vals) * inv_deg, None
 
     m, _ = jax.lax.scan(imp_step, jnp.zeros_like(a), None, length=steps)
 

@@ -4,8 +4,10 @@ The engine's evidence weights (noisy-OR channel weights, decay, explain
 strength, impact bonus — :mod:`rca_tpu.engine.propagate`) default to
 hand-set values.  This module fits them on synthetic cascades with known
 roots: batched forward passes (vmap over cases), a listwise softmax
-cross-entropy on the root-cause ranking, adam on sigmoid-parameterized
-logits so every weight stays in (0, 1).  Checkpoints persist via orbax
+cross-entropy on the root-cause ranking, adam on unconstrained raw values
+(sigmoid keeps the (0,1) weights in range; softplus keeps the impact bonus
+positive but unbounded — its v3 default is 1.6).  Checkpoints persist via
+orbax
 (SURVEY.md §5 checkpoint row: model-weight checkpointing appears exactly
 when the engine gains learned weights).
 
@@ -52,14 +54,23 @@ def _logit(p: float) -> float:
     return float(np.log(p / (1 - p)))
 
 
+def _softplus_inv(y: float) -> float:
+    """Inverse of softplus; beta's domain is (0, ∞), NOT (0, 1) — the v3
+    formula's default impact bonus is 1.6, which a sigmoid parameterization
+    silently clamps to ~1.0 (round-3 review finding)."""
+    y = max(y, 1e-4)
+    return float(np.log(np.expm1(y)))
+
+
 def params_to_pytree(p: PropagationParams) -> Dict[str, jnp.ndarray]:
-    """Unconstrained logits; sigmoid recovers the (0,1) weights."""
+    """Unconstrained raw values; sigmoid recovers the (0,1) weights and
+    softplus recovers the positive-unbounded impact bonus."""
     return {
         "aw": jnp.asarray([_logit(x) for x in p.anomaly_weights]),
         "hw": jnp.asarray([_logit(x) for x in p.hard_weights]),
         "decay": jnp.asarray(_logit(p.decay)),
         "mu": jnp.asarray(_logit(p.explain_strength)),
-        "beta": jnp.asarray(_logit(p.impact_bonus)),
+        "beta": jnp.asarray(_softplus_inv(p.impact_bonus)),
     }
 
 
@@ -71,7 +82,7 @@ def pytree_to_params(tree: Dict, steps: int = 8) -> PropagationParams:
         steps=steps,
         decay=float(sig(tree["decay"])),
         explain_strength=float(sig(tree["mu"])),
-        impact_bonus=float(sig(tree["beta"])),
+        impact_bonus=float(jax.nn.softplus(jnp.asarray(tree["beta"]))),
     )
 
 
@@ -118,7 +129,8 @@ def _forward(tree, features, edges, steps: int):
     h = _noisy_or_w(features, sig(tree["hw"]))
     _, _, _, _, score = propagate_core(
         a, h, edges[0], edges[1], steps,
-        sig(tree["decay"]), sig(tree["mu"]), sig(tree["beta"]),
+        sig(tree["decay"]), sig(tree["mu"]),
+        jax.nn.softplus(tree["beta"]),
         n_live=features.shape[0] - 1,  # last slot is the edge-padding dummy
     )
     return score

@@ -111,6 +111,14 @@ def _propagate_block(
     # shard subtracts the same global background as the dense path
     a_ex_full = background_excess(a_full, n_live)
 
+    # dependent count per node in THIS shard's block, for the impact mean:
+    # local masked counts reduce-scattered exactly like the contributions
+    deg_blk = jax.lax.psum_scatter(
+        jnp.zeros_like(a_full).at[dst_global].add(mask),
+        "sp", scatter_dimension=0, tiled=True,
+    )
+    inv_deg_blk = 1.0 / jnp.maximum(deg_blk, 1.0)
+
     def imp_step(m_blk, _):
         m_full = jax.lax.all_gather(m_blk, "sp", tiled=True)
         vals = mask * (a_ex_full[src_global] + decay * m_full[src_global])
@@ -118,7 +126,7 @@ def _propagate_block(
         # reduce-scatter: every shard receives its reduced block only
         return jax.lax.psum_scatter(
             contrib_full, "sp", scatter_dimension=0, tiled=True
-        ), None
+        ) * inv_deg_blk, None
 
     m_blk, _ = jax.lax.scan(imp_step, jnp.zeros_like(a_blk), None, length=steps)
     # same hard-evidence-damped suppression + multiplicative impact as

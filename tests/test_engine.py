@@ -119,6 +119,42 @@ def test_propagation_permutation_equivariance():
     np.testing.assert_allclose(out.upstream, base.upstream[perm], atol=1e-6)
 
 
+def test_hub_fanin_invariance():
+    """Formula-v3 regression (the round-2 adversarial autopsy): a hub's
+    impact term must measure its MEAN dependent symptom level, not a sum
+    that grows with fan-in.  A mildly-noisy hub with many quiet-but-noisy
+    dependents must not outrank a genuinely faulty root whose few
+    dependents are heavily symptomatic — under the v2 raw-sum formula the
+    hub's accumulated background saturated tanh and won every time the
+    root's crash channel was dropped (tools/accuracy_report.py taxonomy:
+    every band-1000/2000 miss's winner was an early-DAG hub)."""
+    rng = np.random.default_rng(7)
+    n = 300
+    f = rng.uniform(0.0, 0.35, (n, NUM_SERVICE_FEATURES)).astype(np.float32)
+    f[:, SvcF.CRASH] = 0.0
+    # hub 0: everything else depends on it; its own signals are background
+    hub_src = np.arange(1, n, dtype=np.int32)
+    hub_dst = np.zeros(n - 1, np.int32)
+    # root 250: no crash channel (dropped), soft signals only — but its two
+    # dependents are saturated-symptomatic
+    root, v1, v2 = 250, 251, 252
+    f[root, SvcF.LOG_ERRORS] = 0.9
+    f[root, SvcF.EVENTS] = 0.85
+    f[root, SvcF.RESTARTS] = 0.6
+    for v in (v1, v2):
+        f[v, SvcF.ERROR_RATE] = 0.9
+        f[v, SvcF.LATENCY] = 0.95
+    src = np.concatenate([hub_src, np.array([v1, v2], np.int32)])
+    dst = np.concatenate([hub_dst, np.array([root, root], np.int32)])
+    res = GraphEngine().analyze_arrays(f, src, dst)
+    assert res.score[root] > res.score[0], (
+        f"hub (score {res.score[0]:.3f}, impact {res.impact[0]:.3f}) "
+        f"outranks root (score {res.score[root]:.3f})"
+    )
+    # and the hub's impact mean stays at background level
+    assert res.impact[0] < 0.5
+
+
 def test_propagation_monotone_in_crash_signal():
     """Raising a service's crash evidence must not LOWER its own score
     (sanity of the scoring surface; guards weight-retune regressions)."""

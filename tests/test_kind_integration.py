@@ -26,6 +26,11 @@ import time
 
 import pytest
 
+# the ONE import mechanism for tools/setup_test_cluster.py in tests (a
+# dotted `from tools.setup_test_cluster import ...` would create a second,
+# separate module object with duplicated import side effects)
+from tests.conftest import import_setup_tool as _setup_tool
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SETUP = os.path.join(REPO, "tools", "setup_test_cluster.py")
 
@@ -48,7 +53,7 @@ def _sh(*cmd: str, timeout: int = 600) -> subprocess.CompletedProcess:
 @pytest.fixture(scope="module")
 def kind_cluster():
     """Create (or reuse) the faulted kind cluster; tear down unless kept."""
-    from tools.setup_test_cluster import CLUSTER_NAME, NAMESPACE
+    NAMESPACE = _setup_tool().NAMESPACE
 
     rc = subprocess.call([sys.executable, SETUP])
     if rc != 0:
@@ -87,14 +92,19 @@ def kind_cluster():
     time.sleep(60)  # metrics-server scrape interval for the slow faults
     yield NAMESPACE
     if not os.environ.get("RCA_KIND_KEEP"):
-        subprocess.call([sys.executable, SETUP, "--delete"])
+        # scope the teardown to this fixture's cluster (bare --delete now
+        # removes EVERY profile's cluster, including a concurrently-running
+        # oom-chain one)
+        subprocess.call(
+            [sys.executable, SETUP, "--profile", "five-service", "--delete"]
+        )
 
 
 def test_analyzer_finds_injected_faults_on_live_cluster(kind_cluster):
     from rca_tpu.cluster.k8s_client import K8sApiClient
     from rca_tpu.coordinator import RCACoordinator
-    from tools.setup_test_cluster import expected_findings
 
+    expected_findings = _setup_tool().expected_findings
     client = K8sApiClient()
     assert client.is_connected(), "kind cluster not reachable via kubeconfig"
 
@@ -153,9 +163,6 @@ def test_analyzer_finds_injected_faults_on_live_cluster(kind_cluster):
     assert any(name in top for name in ("database", "api-gateway")), (
         f"top root cause {top!r} is not one of the crashing workloads"
     )
-
-
-from tests.conftest import import_setup_tool as _setup_tool  # noqa: E402
 
 
 @pytest.fixture(scope="module")

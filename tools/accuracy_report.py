@@ -1,0 +1,203 @@
+"""Per-seed accuracy autopsy for the cascade benchmark (VERDICT r2 item 3).
+
+``bench.py`` counts hit@1/hit@3 per cascade mode and discards the per-seed
+outcomes, so a sub-1.0 cell (adversarial 0.93 in BENCH_r02) carries no
+information about WHICH cascades fail or why.  This tool reruns a mode over
+an explicit seed band and, for every miss, dumps the full story:
+
+- the true root and the service that outranked it,
+- the winner's role in the cascade (decoy / victim at hop h / background —
+  the generator now records decoys and hop distances for exactly this),
+- both services' nonzero feature channels by name,
+- the score decomposition (a, h, u, m, score) for both, straight from the
+  engine's diagnostic stack,
+- the root's rank and the margin it lost by.
+
+Failures are then bucketed into a taxonomy (decoy_outranks_root /
+victim_outranks_root / root_suppressed_by_upstream / root_signal_dropped)
+so a scoring fix can target the dominant bucket and be validated on a
+DISJOINT seed band (``--seeds 2000:2060`` vs the bench's 1000:1015).
+
+Usage:
+    python tools/accuracy_report.py --mode adversarial --seeds 1000:1060
+    python tools/accuracy_report.py --mode all --json autopsy.json
+
+Runs fine on CPU (`JAX_PLATFORMS=cpu`); accuracy is backend-independent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.append(_REPO_ROOT)
+
+from rca_tpu.cluster.generator import CASCADE_MODES, synthetic_cascade_arrays
+from rca_tpu.engine import GraphEngine
+from rca_tpu.engine.propagate import PropagationParams
+from rca_tpu.features.schema import SERVICE_FEATURE_NAMES
+
+INF = np.iinfo(np.int32).max
+
+
+def _feature_row(feats: np.ndarray, i: int, thresh: float = 0.05) -> dict:
+    row = feats[i]
+    return {
+        SERVICE_FEATURE_NAMES[c]: round(float(row[c]), 3)
+        for c in range(len(row))
+        if row[c] >= thresh
+    }
+
+
+def _role(case, i: int) -> str:
+    """Classify a service's role in the generated cascade."""
+    if i in set(case.roots.tolist()):
+        return "root"
+    if case.decoys is not None and i in set(case.decoys.tolist()):
+        return "decoy"
+    if case.hops is not None and case.hops[i] < INF:
+        return f"victim_hop{int(case.hops[i])}"
+    return "background"
+
+
+def _classify(miss: dict) -> str:
+    """Failure taxonomy for one missed cascade."""
+    role = miss["winner"]["role"]
+    root = miss["root"]
+    if role == "decoy":
+        return "decoy_outranks_root"
+    if role.startswith("victim"):
+        return "victim_outranks_root"
+    # root lost to a background service: either its signal was dropped
+    # (missing_signals zeroed the hard channels) or explain-away ate it
+    if root["decomp"]["score"] < root["decomp"]["a"] * 0.7:
+        return "root_suppressed_by_upstream"
+    return "root_signal_dropped"
+
+
+def autopsy_mode(
+    mode: str,
+    seeds: range,
+    n: int = 500,
+    params: PropagationParams | None = None,
+    k: int = 5,
+) -> dict:
+    engine = GraphEngine(params=params)
+    n_roots = 3 if mode == "overlapping_roots" else 1
+    misses = []
+    hits1 = hits3 = 0
+    for seed in seeds:
+        case = synthetic_cascade_arrays(n, n_roots=n_roots, seed=seed, mode=mode)
+        res = engine.analyze_case(case, k=k)
+        roots = set(case.roots.tolist())
+        order = np.argsort(-res.score)
+        hit1 = int(order[0]) in roots
+        hits1 += hit1
+        hits3 += bool(roots & set(order[:3].tolist()))
+        if hit1:
+            continue
+        winner = int(order[0])
+        # the best-ranked true root (single-root modes: the root)
+        root_ranks = {r: int(np.nonzero(order == r)[0][0]) for r in roots}
+        best_root = min(root_ranks, key=root_ranks.get)
+
+        def decomp(i: int) -> dict:
+            return {
+                "a": round(float(res.anomaly[i]), 4),
+                "u": round(float(res.upstream[i]), 4),
+                "m": round(float(res.impact[i]), 4),
+                "score": round(float(res.score[i]), 4),
+            }
+
+        miss = {
+            "seed": seed,
+            "winner": {
+                "index": winner,
+                "role": _role(case, winner),
+                "features": _feature_row(case.features, winner),
+                "decomp": decomp(winner),
+            },
+            "root": {
+                "index": int(best_root),
+                "rank": root_ranks[best_root],
+                "n_dependents": int(np.sum(case.dep_dst == best_root)),
+                "features": _feature_row(case.features, best_root),
+                "decomp": decomp(best_root),
+            },
+            "margin": round(
+                float(res.score[winner] - res.score[best_root]), 4
+            ),
+        }
+        miss["failure_mode"] = _classify(miss)
+        misses.append(miss)
+    trials = len(seeds)
+    taxonomy = collections.Counter(m["failure_mode"] for m in misses)
+    return {
+        "mode": mode,
+        "n_services": n,
+        "seeds": f"{seeds.start}:{seeds.stop}",
+        "trials": trials,
+        "hit1": round(hits1 / trials, 4),
+        "hit3": round(hits3 / trials, 4),
+        "failure_taxonomy": dict(taxonomy),
+        "misses": misses,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--mode", default="adversarial",
+                    help="cascade mode, or 'all'")
+    ap.add_argument("--seeds", default="1000:1015",
+                    help="start:stop seed band (bench uses 1000:1015)")
+    ap.add_argument("--n", type=int, default=500)
+    ap.add_argument("--json", help="write the full report to this path")
+    ap.add_argument("--weights", help="orbax checkpoint dir (RCA_WEIGHTS)")
+    args = ap.parse_args(argv)
+
+    start, stop = (int(x) for x in args.seeds.split(":"))
+    seeds = range(start, stop)
+    params = None
+    if args.weights:
+        from rca_tpu.engine.train import load_params
+
+        params = load_params(args.weights)
+
+    modes = CASCADE_MODES if args.mode == "all" else (args.mode,)
+    reports = [autopsy_mode(m, seeds, n=args.n, params=params) for m in modes]
+
+    for rep in reports:
+        print(
+            f"{rep['mode']:>20}: hit@1 {rep['hit1']:.3f}  hit@3 "
+            f"{rep['hit3']:.3f}  ({len(rep['misses'])} misses over "
+            f"{rep['trials']} seeds)  taxonomy={rep['failure_taxonomy']}"
+        )
+        for m in rep["misses"]:
+            w, r = m["winner"], m["root"]
+            print(
+                f"    seed {m['seed']}: {m['failure_mode']} — winner "
+                f"#{w['index']} ({w['role']}) score={w['decomp']['score']} "
+                f"vs root #{r['index']} rank={r['rank']} "
+                f"score={r['decomp']['score']} (margin {m['margin']})"
+            )
+            print(f"      winner: a={w['decomp']['a']} u={w['decomp']['u']} "
+                  f"m={w['decomp']['m']}  feats={w['features']}")
+            print(f"      root:   a={r['decomp']['a']} u={r['decomp']['u']} "
+                  f"m={r['decomp']['m']} deps={r['n_dependents']} "
+                  f"feats={r['features']}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(reports, f, indent=1)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

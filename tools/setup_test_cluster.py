@@ -455,7 +455,9 @@ def run_measurement(namespace: str, expected_root: str, out_path: str,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--profile", choices=PROFILES, default="five-service")
+    ap.add_argument("--profile", choices=PROFILES, default=None,
+                    help="default five-service; with --delete, an explicit "
+                    "profile scopes the teardown to that cluster only")
     ap.add_argument("--pods", type=int, default=200,
                     help="pod budget for the oom-chain profile")
     ap.add_argument("--dry-run", action="store_true",
@@ -473,11 +475,25 @@ def main(argv=None) -> int:
                     help="skip deploy; only measure an existing cluster")
     args = ap.parse_args(argv)
 
-    name = cluster_name(args.profile)
     if args.delete:
-        return subprocess.call(
-            ["kind", "delete", "cluster", "--name", name]
+        # bare --delete tears down EVERY profile's cluster (the profiles
+        # use distinct kind clusters, so a user who created oom-chain-200
+        # and then ran the docstring's bare `--delete` would otherwise
+        # leave the 200-pod cluster running); an explicit --profile scopes
+        # the teardown to that one cluster
+        names = (
+            [cluster_name(args.profile)] if args.profile
+            else sorted({cluster_name(pr) for pr in PROFILES})
         )
+        rc = 0
+        for n in names:
+            rc = subprocess.call(
+                ["kind", "delete", "cluster", "--name", n]
+            ) or rc
+        return rc
+
+    args.profile = args.profile or "five-service"
+    name = cluster_name(args.profile)
 
     p = profile_parts(args.profile, args.pods)
     # anchor the default to the repo root (where BASELINE.md points the
