@@ -180,30 +180,52 @@ def cmd_bench(args) -> int:
 
 
 def cmd_train(args) -> int:
-    from rca_tpu.engine.train import TrainConfig, hit_at_1, save_params, train
+    from rca_tpu.engine.train import (
+        TrainConfig,
+        hit_at_1,
+        save_params,
+        shippability_report,
+        train,
+    )
 
     cfg = TrainConfig(
         n_services=args.services, n_cases=args.cases,
         iters=args.iters, lr=args.lr, seed=args.seed,
+        modes=tuple(args.modes.split(",")),
     )
     params, history = train(cfg)
     acc = hit_at_1(params, cfg)
+    # the ship gate (train.shippability_report): physically-sane params,
+    # >= defaults on held-out generator settings, fixtures unregressed —
+    # a checkpoint that fails it is refused unless --allow-unshippable.
+    # Only evaluated when a checkpoint is requested: the gate costs ~60
+    # adversarial analyses, too much for a no-output research iteration
+    report = shippability_report(params) if args.out else None
+    saved = None
     if args.out:
-        save_params(params, args.out)
+        if report["ships"] or args.allow_unshippable:
+            save_params(params, args.out)
+            saved = args.out
+        else:
+            print(
+                "refusing to save: shippability gate failed "
+                "(--allow-unshippable overrides)", file=sys.stderr,
+            )
     print(
         json.dumps(
             {
                 "final_loss": round(history[-1], 5),
                 "initial_loss": round(history[0], 5),
                 "holdout_hit_at_1": acc,
-                "checkpoint": args.out or None,
+                "checkpoint": saved,
                 "decay": round(params.decay, 4),
                 "explain_strength": round(params.explain_strength, 4),
                 "impact_bonus": round(params.impact_bonus, 4),
+                "shippability": report,
             }
         )
     )
-    return 0
+    return 0 if (report is None or report["ships"] or saved) else 1
 
 
 def cmd_stream(args) -> int:
@@ -347,8 +369,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--iters", type=int, default=150)
     sp.add_argument("--lr", type=float, default=0.05)
     sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--modes", default="standard,crashing_victims,"
+                    "correlated_noise,adversarial",
+                    help="comma-separated cascade modes for the dataset")
     sp.add_argument("--out", default=None,
                     help="checkpoint directory (loadable via RCA_WEIGHTS)")
+    sp.add_argument("--allow-unshippable", action="store_true",
+                    help="save the checkpoint even when the shippability "
+                    "gate fails (research use)")
     sp.set_defaults(fn=cmd_train)
 
     sp = sub.add_parser("investigations", help="list/show investigations")

@@ -133,6 +133,8 @@ def synthetic_cascade_arrays(
     decay: float = 0.75,
     noise: float = 0.05,
     mode: str = "standard",
+    max_deps: int = 3,
+    dropout_keep: float = 0.65,
 ) -> CascadeArrays:
     """Generate the raw-array cascade (any scale; used for bench + training).
 
@@ -157,11 +159,17 @@ def synthetic_cascade_arrays(
       victim symptoms stack and per-root evidence overlaps.
     - ``adversarial`` — crashing_victims + missing_signals +
       correlated_noise at once.
+
+    ``decay``/``noise``/``max_deps``/``dropout_keep`` are the generator's
+    domain knobs (symptom per-hop decay, background noise ceiling, DAG
+    fan-out, per-channel observation probability in the dropout modes) —
+    exposed so training can domain-randomize over them instead of
+    overfitting one fixed world (VERDICT r2 item 4).
     """
     if mode not in CASCADE_MODES:
         raise ValueError(f"unknown cascade mode {mode!r}; one of {CASCADE_MODES}")
     rng = np.random.default_rng(seed)
-    dep_src, dep_dst = _build_dag(n_services, rng)
+    dep_src, dep_dst = _build_dag(n_services, rng, max_deps=max_deps)
     adj = _dependents_adj(n_services, dep_src, dep_dst)
 
     # Prefer roots with real downstream impact (≥1 dependent when possible).
@@ -273,9 +281,9 @@ def synthetic_cascade_arrays(
 
     if mode in ("missing_signals", "adversarial"):
         # per-(service, channel) dropout of the fault signals: each channel
-        # is observed with probability 0.65 (background survives — missing
-        # data looks like *quiet*, not like zeroed noise)
-        keep = rng.random((n_services, NUM_FEATURES)) < 0.65
+        # is observed with probability ``dropout_keep`` (background survives
+        # — missing data looks like *quiet*, not like zeroed noise)
+        keep = rng.random((n_services, NUM_FEATURES)) < dropout_keep
         feats = np.where(keep, feats, background).astype(np.float32)
 
     anomaly = feats.max(axis=1)

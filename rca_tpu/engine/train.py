@@ -47,6 +47,20 @@ class TrainConfig:
     # cascade modes sampled round-robin across the dataset (hard modes give
     # the learned weights something the hand-set defaults don't already ace)
     modes: Tuple[str, ...] = ("standard",)
+    # Domain randomization (VERDICT r2 item 4): per-case generator
+    # hyperparameters sampled uniformly from these ranges, so the fit
+    # cannot exploit one fixed world (the round-2 failure: with every
+    # knob pinned, training learned decay≈0.02 — no multi-hop propagation
+    # — and dropped CRASH from hard evidence, artifacts usable only on the
+    # distribution they overfit).  ``None`` disables (the old behavior,
+    # kept for ablation).
+    dr_decay: Optional[Tuple[float, float]] = (0.55, 0.9)
+    dr_noise: Optional[Tuple[float, float]] = (0.02, 0.1)
+    dr_max_deps: Optional[Tuple[int, int]] = (2, 4)        # inclusive
+    dr_dropout_keep: Optional[Tuple[float, float]] = (0.5, 0.8)
+    # Physical-prior regularization strength (see _regularizer): anchors
+    # decay and the CRASH hard weight inside physically-meaningful ranges.
+    reg_strength: float = 1.0
 
 
 def _logit(p: float) -> float:
@@ -86,11 +100,28 @@ def pytree_to_params(tree: Dict, steps: int = 8) -> PropagationParams:
     )
 
 
+def sample_generator_kwargs(cfg: TrainConfig, rng: np.random.Generator) -> Dict:
+    """One draw of the domain-randomized generator hyperparameters."""
+    kw: Dict = {}
+    if cfg.dr_decay is not None:
+        kw["decay"] = float(rng.uniform(*cfg.dr_decay))
+    if cfg.dr_noise is not None:
+        kw["noise"] = float(rng.uniform(*cfg.dr_noise))
+    if cfg.dr_max_deps is not None:
+        kw["max_deps"] = int(rng.integers(cfg.dr_max_deps[0],
+                                          cfg.dr_max_deps[1] + 1))
+    if cfg.dr_dropout_keep is not None:
+        kw["dropout_keep"] = float(rng.uniform(*cfg.dr_dropout_keep))
+    return kw
+
+
 def make_dataset(
     cfg: TrainConfig, seed_offset: int = 0
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Fixed-shape batch of cascades: features [B,S,C], edges [B,2,E],
-    root multi-hot [B,S]."""
+    root multi-hot [B,S].  Each case draws its own generator
+    hyperparameters (domain randomization) unless the ``dr_*`` ranges are
+    disabled."""
     from rca_tpu.cluster.generator import synthetic_cascade_arrays
 
     S = cfg.n_services
@@ -102,6 +133,7 @@ def make_dataset(
                 S, n_roots=int(rng.integers(1, cfg.n_roots_max + 1)),
                 seed=cfg.seed + seed_offset + b,
                 mode=cfg.modes[b % len(cfg.modes)],
+                **sample_generator_kwargs(cfg, rng),
             )
         )
     e_max = max(len(c.dep_src) for c in cases)
@@ -136,15 +168,44 @@ def _forward(tree, features, edges, steps: int):
     return score
 
 
+def _regularizer(tree):
+    """Physical prior on the fitted parameters (VERDICT r2 item 4): the
+    round-2 fit exploited the fixed generator by collapsing decay to ~0.02
+    (symptoms stop propagating, so the graph term degenerates) and zeroing
+    CRASH out of hard evidence (explain-away dies).  Both are physically
+    absurd for real cascades — symptoms demonstrably travel multiple hops
+    and a crash-looping pod IS broken — so the loss hinges them into
+    meaningful ranges instead of pinning exact values:
+
+    - decay ≥ 0.4 (multi-hop propagation survives),
+    - hard CRASH weight ≥ 0.7 (a crash stays hard evidence),
+    - anomaly CRASH weight ≥ 0.6 (a crash stays root evidence).
+
+    Quadratic hinges: zero inside the allowed region, so a fit that beats
+    the defaults WITHIN physical ranges pays nothing."""
+    from rca_tpu.features.schema import SvcF
+
+    sig = jax.nn.sigmoid
+    decay = sig(tree["decay"])
+    hw_crash = sig(tree["hw"])[SvcF.CRASH]
+    aw_crash = sig(tree["aw"])[SvcF.CRASH]
+    return (
+        jnp.maximum(0.4 - decay, 0.0) ** 2
+        + jnp.maximum(0.7 - hw_crash, 0.0) ** 2
+        + jnp.maximum(0.6 - aw_crash, 0.0) ** 2
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("steps",))
-def _loss(tree, feats, edges, roots, steps: int):
-    """Listwise CE: every true root should sit atop the score softmax."""
+def _loss(tree, feats, edges, roots, steps: int, reg_strength: float = 0.0):
+    """Listwise CE: every true root should sit atop the score softmax;
+    plus the physical-prior hinge regularizer."""
     scores = jax.vmap(lambda f, e: _forward(tree, f, e, steps))(feats, edges)
     logp = jax.nn.log_softmax(scores * 8.0, axis=1)
     per_case = -(roots * logp).sum(axis=1) / jnp.maximum(
         roots.sum(axis=1), 1.0
     )
-    return per_case.mean()
+    return per_case.mean() + reg_strength * _regularizer(tree)
 
 
 def hit_at_1(params: PropagationParams, cfg: TrainConfig,
@@ -168,6 +229,106 @@ def hit_at_1(params: PropagationParams, cfg: TrainConfig,
     return hits / trials
 
 
+# held-out generator settings for the shippability gate: at or OUTSIDE the
+# edges of the default training ranges (TrainConfig.dr_*), so a fit that
+# merely memorized the training domain fails here
+HOLDOUT_SETTINGS: Tuple[Dict, ...] = (
+    {"decay": 0.5, "noise": 0.12, "max_deps": 5, "dropout_keep": 0.45},
+    {"decay": 0.95, "noise": 0.02, "max_deps": 2, "dropout_keep": 0.8},
+    {"decay": 0.65, "noise": 0.08, "max_deps": 4, "dropout_keep": 0.6},
+)
+
+
+def shippability_report(
+    params: PropagationParams,
+    baseline: Optional[PropagationParams] = None,
+    trials_per_setting: int = 10,
+    seed_offset: int = 50_000,
+) -> Dict:
+    """The gate trained weights must pass to ship (VERDICT r2 item 4):
+
+    1. **physically sane** — decay > 0.3 and CRASH still counted as hard
+       evidence (the round-2 fit violated both and worked only on the
+       distribution it overfit);
+    2. **no worse than the defaults on adversarial cascades under
+       HELD-OUT generator settings** (:data:`HOLDOUT_SETTINGS` sit at or
+       outside the training randomization edges);
+    3. **fixtures don't regress** — the 5-service faulted world still
+       ranks both injected roots top-2, and a 50-service cascade world
+       still ranks its root first.
+
+    Returns a dict with per-check results and an overall ``ships`` bool.
+    """
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+    from rca_tpu.engine import GraphEngine
+    from rca_tpu.features.schema import SvcF
+
+    baseline = baseline or default_params(params.steps)
+
+    sane = {
+        "decay": round(params.decay, 4),
+        "decay_ok": params.decay > 0.3,
+        "hard_crash": round(params.hard_weights[SvcF.CRASH], 4),
+        "hard_crash_ok": params.hard_weights[SvcF.CRASH] >= 0.6,
+        "anomaly_crash": round(params.anomaly_weights[SvcF.CRASH], 4),
+        "anomaly_crash_ok": params.anomaly_weights[SvcF.CRASH] >= 0.5,
+    }
+
+    def holdout_hit1(p: PropagationParams) -> float:
+        eng = GraphEngine(params=p)
+        hits = trials = 0
+        for si, setting in enumerate(HOLDOUT_SETTINGS):
+            for t in range(trials_per_setting):
+                case = synthetic_cascade_arrays(
+                    300, n_roots=1,
+                    seed=seed_offset + si * 1000 + t,
+                    mode="adversarial", **setting,
+                )
+                r = eng.analyze_case(case, k=1)
+                hits += int(np.argmax(r.score)) == int(case.roots[0])
+                trials += 1
+        return hits / trials
+
+    trained_acc = holdout_hit1(params)
+    default_acc = holdout_hit1(baseline)
+
+    def fixtures_ok(p: PropagationParams) -> Dict:
+        eng = GraphEngine(params=p)
+        snap = ClusterSnapshot.capture(
+            MockClusterClient(five_service_world()), NS
+        )
+        five = set(eng.analyze_snapshot(snap).top_components(2))
+        case = synthetic_cascade_arrays(50, n_roots=1, seed=0)
+        fifty = eng.analyze_case(case, k=1)
+        return {
+            "five_svc_top2": sorted(five),
+            "five_svc_ok": five == {"database", "api-gateway"},
+            "fifty_svc_top1_ok": (
+                fifty.ranked[0]["component"] == case.names[case.roots[0]]
+            ),
+        }
+
+    fx = fixtures_ok(params)
+    report = {
+        "sanity": sane,
+        "holdout_adversarial_hit1": {
+            "trained": round(trained_acc, 4),
+            "defaults": round(default_acc, 4),
+        },
+        "fixtures": fx,
+        "ships": bool(
+            sane["decay_ok"] and sane["hard_crash_ok"]
+            and sane["anomaly_crash_ok"]
+            and trained_acc >= default_acc
+            and fx["five_svc_ok"] and fx["fifty_svc_top1_ok"]
+        ),
+    }
+    return report
+
+
 def train(
     cfg: Optional[TrainConfig] = None,
     init: Optional[PropagationParams] = None,
@@ -185,7 +346,9 @@ def train(
     )
     history: List[float] = []
     for _ in range(cfg.iters):
-        loss, grads = grad_fn(tree, feats, edges, roots, cfg.steps)
+        loss, grads = grad_fn(
+            tree, feats, edges, roots, cfg.steps, cfg.reg_strength
+        )
         updates, opt_state = opt.update(grads, opt_state)
         tree = optax.apply_updates(tree, updates)
         history.append(float(loss))

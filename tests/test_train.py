@@ -12,7 +12,9 @@ from rca_tpu.engine.train import (
     make_dataset,
     params_to_pytree,
     pytree_to_params,
+    sample_generator_kwargs,
     save_params,
+    shippability_report,
     train,
 )
 
@@ -47,6 +49,47 @@ def test_param_pytree_roundtrip():
         q.anomaly_weights, p.anomaly_weights, atol=1e-3
     )
     assert abs(q.decay - p.decay) < 1e-3
+    # beta's domain is (0, inf): the v3 default 1.6 must survive the
+    # round trip (a sigmoid parameterization silently clamps it to ~1.0)
+    assert abs(q.impact_bonus - p.impact_bonus) < 1e-3
+    assert p.impact_bonus > 1.0
+
+
+def test_domain_randomization_samples_ranges():
+    cfg = TrainConfig()
+    rng = np.random.default_rng(0)
+    draws = [sample_generator_kwargs(cfg, rng) for _ in range(50)]
+    decays = {d["decay"] for d in draws}
+    deps = {d["max_deps"] for d in draws}
+    assert len(decays) == 50  # continuous knobs actually vary
+    assert deps == {2, 3, 4}  # inclusive integer range fully covered
+    for d in draws:
+        assert cfg.dr_decay[0] <= d["decay"] <= cfg.dr_decay[1]
+        assert cfg.dr_dropout_keep[0] <= d["dropout_keep"] <= cfg.dr_dropout_keep[1]
+
+
+def test_shippability_gate():
+    """Defaults pass the ship gate; round-2-style degenerate weights
+    (decay collapsed, CRASH dropped from hard evidence) are refused on
+    sanity alone."""
+    import dataclasses
+
+    from rca_tpu.features.schema import SvcF
+
+    report = shippability_report(default_params(), trials_per_setting=3)
+    assert report["ships"], report
+    assert report["fixtures"]["five_svc_ok"]
+
+    p = default_params()
+    hw = list(p.hard_weights)
+    hw[SvcF.CRASH] = 0.05
+    degenerate = dataclasses.replace(
+        p, decay=0.02, hard_weights=tuple(hw)
+    )
+    bad = shippability_report(degenerate, trials_per_setting=2)
+    assert not bad["ships"]
+    assert not bad["sanity"]["decay_ok"]
+    assert not bad["sanity"]["hard_crash_ok"]
 
 
 def test_training_reduces_loss_and_keeps_accuracy(trained):

@@ -164,6 +164,8 @@ def main(argv=None) -> int:
 
     start, stop = (int(x) for x in args.seeds.split(":"))
     seeds = range(start, stop)
+    if not len(seeds):
+        ap.error(f"--seeds {args.seeds}: empty band (need start < stop)")
     params = None
     if args.weights:
         from rca_tpu.engine.train import load_params
