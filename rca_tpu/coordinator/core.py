@@ -23,8 +23,6 @@ from rca_tpu.agents.llm_agent import make_llm_agents
 from rca_tpu.coordinator import hypotheses as hypo
 from rca_tpu.coordinator.correlate import correlate_findings, default_backend
 from rca_tpu.coordinator.structured import (
-    build_suggestions,
-    cluster_state_counts,
     format_structured_response,
     merge_llm_structured,
 )
@@ -305,10 +303,16 @@ class RCACoordinator:
         }
 
     def _followups(
-        self, ctx: AnalysisContext, evidence_note: str
+        self, ctx: AnalysisContext, evidence: Dict[str, Any]
     ) -> List[Dict[str, Any]]:
-        state = cluster_state_counts(ctx)
-        return build_suggestions(state)
+        """Evidence-conditioned follow-ups (coordinator.followups): the
+        deterministic rule tier reads the gathered evidence, an optional
+        LLM tier adds up to two more, generics only backfill.  The
+        round-2 version ignored its evidence argument entirely — every
+        branch returned the same counts-derived list (VERDICT item 5)."""
+        from rca_tpu.coordinator.followups import evidence_followups
+
+        return evidence_followups(ctx, evidence, llm=self.llm)
 
     def _analyze_evidence_text(
         self, what: str, payload: Any, question: str
@@ -342,13 +346,24 @@ class RCACoordinator:
                 for f in res.get("findings", [])[:8]
             ]
             key_findings = points[:5]
+        flat_findings = [
+            f
+            for r in results.values()
+            if isinstance(r, dict)
+            for f in r.get("findings", [])
+        ]
+        tag = {
+            "kind": "analysis", "agent_type": agent_type,
+            "findings": flat_findings,
+        }
         return {
             "response": {
                 "points": points or ["No findings."],
                 "sections": [],
             },
             "evidence": {"analysis": results},
-            "suggestions": self._followups(ctx, agent_type),
+            "evidence_tag": tag,
+            "suggestions": self._followups(ctx, tag),
             "key_findings": key_findings,
         }
 
@@ -360,10 +375,15 @@ class RCACoordinator:
             "resource", details, f"what is wrong with {kind}/{name}?"
         )
         ctx = ctx or self.capture(namespace)
+        tag = {
+            "kind": "resource", "resource_kind": kind, "name": name,
+            "details": details,
+        }
         return {
             "response": {"points": [analysis], "sections": []},
             "evidence": {f"{kind}/{name}": details},
-            "suggestions": self._followups(ctx, f"{kind}/{name}"),
+            "evidence_tag": tag,
+            "suggestions": self._followups(ctx, tag),
             "key_findings": [f"Inspected {kind}/{name}"],
         }
 
@@ -385,6 +405,12 @@ class RCACoordinator:
             for i, c in enumerate(counts) if c > 0
         ]
         ctx = ctx or self.capture(namespace)
+        # plain list, not ndarray: the tag rides the JSON-serialized result
+        tag = {
+            "kind": "logs", "pod": pod,
+            "pattern_counts": [int(c) for c in counts],
+            "previous": bool(action.get("previous", False)),
+        }
         return {
             "response": {
                 "points": [analysis]
@@ -392,7 +418,8 @@ class RCACoordinator:
                 "sections": [],
             },
             "evidence": {f"logs/{pod}": (logs or "")[-4000:]},
-            "suggestions": self._followups(ctx, f"logs {pod}"),
+            "evidence_tag": tag,
+            "suggestions": self._followups(ctx, tag),
             "key_findings": [
                 f"{pod} log classes: {', '.join(hits)}" if hits
                 else f"{pod}: no error classes in logs"
@@ -411,10 +438,25 @@ class RCACoordinator:
             "events", events[:30], "what do these events indicate?"
         )
         ctx = ctx or self.capture(namespace)
+        # tag carries only the fields the follow-up rules read (the full
+        # events are already under "evidence" — no need to double them)
+        tag = {
+            "kind": "events",
+            "events": [
+                {
+                    "reason": e.get("reason"),
+                    "involved_object": e.get(
+                        "involved_object", e.get("involvedObject", {})
+                    ),
+                }
+                for e in events[:50]
+            ],
+        }
         return {
             "response": {"points": [analysis], "sections": []},
             "evidence": {"events": events[:30]},
-            "suggestions": self._followups(ctx, "events"),
+            "evidence_tag": tag,
+            "suggestions": self._followups(ctx, tag),
             "key_findings": [f"{len(events)} events reviewed"],
         }
 
@@ -427,9 +469,15 @@ class RCACoordinator:
     ) -> List[Dict[str, Any]]:
         """Regenerate prioritized next actions after one was taken,
         dropping the action just executed (reference:
-        mcp_coordinator.py:3555-3640)."""
+        mcp_coordinator.py:3555-3640).  When the result carries its tagged
+        evidence (every process_suggestion branch returns one), the fresh
+        list is conditioned on THAT evidence — so what was just learned
+        drives what to do next."""
         ctx = ctx or self.capture(namespace)
-        fresh = self._followups(ctx, "post_action")
+        evidence = (
+            result.get("evidence_tag") if isinstance(result, dict) else None
+        ) or {"kind": "none"}
+        fresh = self._followups(ctx, evidence)
         taken = json.dumps(taken_action, sort_keys=True, default=str)
         return [
             s for s in fresh

@@ -229,14 +229,19 @@ def hit_at_1(params: PropagationParams, cfg: TrainConfig,
     return hits / trials
 
 
-# held-out generator settings for the shippability gate: at or OUTSIDE the
-# edges of the default training ranges (TrainConfig.dr_*), so a fit that
-# merely memorized the training domain fails here
+# held-out generator settings for the shippability gate: EVERY entry sits
+# at or OUTSIDE the edges of the default training ranges (TrainConfig.dr_*
+# — decay [0.55,0.9], noise [0.02,0.1], max_deps {2..4}, dropout_keep
+# [0.5,0.8]), so a fit that merely memorized the training domain fails here
 HOLDOUT_SETTINGS: Tuple[Dict, ...] = (
     {"decay": 0.5, "noise": 0.12, "max_deps": 5, "dropout_keep": 0.45},
     {"decay": 0.95, "noise": 0.02, "max_deps": 2, "dropout_keep": 0.8},
-    {"decay": 0.65, "noise": 0.08, "max_deps": 4, "dropout_keep": 0.6},
+    {"decay": 0.9, "noise": 0.12, "max_deps": 5, "dropout_keep": 0.5},
 )
+
+# (baseline params, trials, seed_offset) -> holdout hit@1; PropagationParams
+# is a frozen (hashable) dataclass
+_BASELINE_HOLDOUT_CACHE: Dict = {}
 
 
 def shippability_report(
@@ -293,7 +298,15 @@ def shippability_report(
         return hits / trials
 
     trained_acc = holdout_hit1(params)
-    default_acc = holdout_hit1(baseline)
+    # the defaults' holdout score is a deterministic constant per
+    # (steps, trials, seed_offset): memoize so every gated train run
+    # doesn't pay 30 redundant analyses re-measuring it
+    base_key = (baseline, trials_per_setting, seed_offset)
+    if base_key in _BASELINE_HOLDOUT_CACHE:
+        default_acc = _BASELINE_HOLDOUT_CACHE[base_key]
+    else:
+        default_acc = holdout_hit1(baseline)
+        _BASELINE_HOLDOUT_CACHE[base_key] = default_acc
 
     def fixtures_ok(p: PropagationParams) -> Dict:
         eng = GraphEngine(params=p)

@@ -325,6 +325,99 @@ def test_update_suggestions_drops_taken_action(coord, ctx):
     )
 
 
+def test_followups_are_evidence_conditioned(ctx):
+    """VERDICT r2 item 5: different evidence must yield DIFFERENT, targeted
+    suggestions that name the objects the evidence implicates (the round-2
+    version returned the same counts-derived list for every branch)."""
+    import numpy as np
+
+    from rca_tpu.coordinator.followups import evidence_followups
+    from rca_tpu.features.logscan import LOG_PATTERN_NAMES
+
+    def counts(**hits):
+        c = np.zeros(len(LOG_PATTERN_NAMES))
+        for name, n in hits.items():
+            c[LOG_PATTERN_NAMES.index(name)] = n
+        return c
+
+    oom_logs = evidence_followups(ctx, {
+        "kind": "logs", "pod": "cache-0",
+        "pattern_counts": counts(oom_kill=40), "previous": False,
+    })
+    net_logs = evidence_followups(ctx, {
+        "kind": "logs", "pod": "web-1",
+        "pattern_counts": counts(connection_refused=7, dns_resolution=2),
+        "previous": False,
+    })
+    sched_events = evidence_followups(ctx, {
+        "kind": "events",
+        "events": [{"reason": "FailedScheduling",
+                    "involved_object": {"kind": "Pod", "name": "big-0"}}],
+    })
+
+    def actions(suggs):
+        return [json.dumps(s["action"], sort_keys=True) for s in suggs]
+
+    # three evidences, three different suggestion lists
+    assert len({tuple(actions(s))
+                for s in (oom_logs, net_logs, sched_events)}) == 3
+    # 40 OOM-kill hits → describe THAT pod (memory limits), named
+    top = oom_logs[0]
+    assert top["action"] == {"type": "check_resource", "kind": "Pod",
+                             "name": "cache-0"}
+    assert "oom" in top["reasoning"].lower()
+    # connection refusals → trace the dependency via the topology agent
+    assert any(
+        s["action"] == {"type": "run_agent", "agent_type": "topology"}
+        and "web-1" in s["reasoning"]
+        for s in net_logs
+    ), net_logs
+    # FailedScheduling → resource-pressure analysis naming the pod
+    assert any(
+        s["action"].get("agent_type") == "resources"
+        and "big-0" in s["text"]
+        for s in sched_events
+    ), sched_events
+
+
+def test_followups_fall_back_to_generics_on_quiet_evidence(ctx):
+    """Unremarkable evidence degrades to the counts-derived generics —
+    the list is never empty."""
+    import numpy as np
+
+    from rca_tpu.coordinator.followups import evidence_followups
+    from rca_tpu.features.logscan import LOG_PATTERN_NAMES
+
+    out = evidence_followups(ctx, {
+        "kind": "logs", "pod": "quiet-0",
+        "pattern_counts": np.zeros(len(LOG_PATTERN_NAMES)),
+        "previous": False,
+    })
+    assert out
+    # generic tier: driven by cluster counts, not the quiet pod
+    assert all("quiet-0" not in json.dumps(s) for s in out)
+
+
+def test_update_suggestions_consume_result_evidence(coord, ctx):
+    """After an action, the regenerated list is conditioned on what that
+    action just found (result.evidence_tag), not only on cluster counts."""
+    crash_pod = "database-7c9f8b6d5e-3x5qp"
+    taken = {"type": "check_logs", "pod_name": crash_pod}
+    result = coord.process_suggestion(taken, NS, ctx=ctx)
+    assert result.get("evidence_tag", {}).get("kind") == "logs"
+    fresh = coord.update_suggestions_after_action(taken, result, NS, ctx=ctx)
+    # the taken action itself is dropped...
+    assert all(
+        json.dumps(s["action"], sort_keys=True, default=str)
+        != json.dumps(taken, sort_keys=True, default=str)
+        for s in fresh
+    )
+    # ...but its evidence still steers the follow-ups at the pod
+    assert any(
+        crash_pod in json.dumps(s["action"], default=str) for s in fresh
+    ), fresh
+
+
 def test_hypothesis_workflow_end_to_end(coord, ctx):
     finding = {
         "issue": "pod stuck in CrashLoopBackOff",
