@@ -298,6 +298,11 @@ class RCACoordinator:
         return {
             "response": out["response_data"],
             "evidence": {"cluster_state": out["cluster_state"]},
+            # free-text queries carry no targeted evidence; the tag keeps
+            # the five-branch contract and routes post-action regeneration
+            # to the generic tier explicitly
+            "evidence_tag": {"kind": "query",
+                             "key_findings": out["key_findings"][:5]},
             "suggestions": out["suggestions"],
             "key_findings": out["key_findings"],
         }

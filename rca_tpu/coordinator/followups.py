@@ -176,6 +176,10 @@ def _from_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         for key, (act, priority, why) in _EVENT_REASON_RULES.items():
             if key in reason:
                 seen_objects.add((kind, name, reason))
+                if act in ("check_logs", "check_logs_previous") and kind != "Pod":
+                    # logs live in pods; for a Job/Deployment/ReplicaSet
+                    # event the safe next hop is describing the object
+                    act = "check_resource"
                 if act == "check_logs_previous":
                     action = {"type": "check_logs", "pod_name": name,
                               "previous": True}
@@ -201,16 +205,22 @@ def _from_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 def _from_resource_details(kind: str, name: str,
                            details: Dict[str, Any]) -> List[Dict[str, Any]]:
     """Resource state → state-specific checks (reference semantics:
-    resource_analyzer per-group analyzers, as next actions)."""
+    resource_analyzer per-group analyzers, as next actions).  ``check_logs``
+    actions are only emitted for Pods — logs live in pods, and a
+    Deployment/Job name is not a pod name."""
     out: List[Dict[str, Any]] = []
+    is_pod = kind == "Pod"
     blob = json.dumps(details, default=str).lower()
     if "crashloopbackoff" in blob:
         out.append(_sugg(
-            f"Check previous logs of {name}",
+            f"Check previous logs of {name}" if is_pod
+            else f"Inspect events of {kind}/{name}",
             "high",
             f"{kind}/{name} is crash-looping — the cause is in the "
             "previous container's output",
-            {"type": "check_logs", "pod_name": name, "previous": True},
+            {"type": "check_logs", "pod_name": name, "previous": True}
+            if is_pod
+            else {"type": "check_events", "kind": kind, "name": name},
         ))
     if "oomkilled" in blob:
         out.append(_sugg(
@@ -227,7 +237,7 @@ def _from_resource_details(kind: str, name: str,
             "image-pull failure — the registry error detail is in events",
             {"type": "check_events", "kind": "Pod", "name": name},
         ))
-    if '"ready": false' in blob or "unhealthy" in blob:
+    if ('"ready": false' in blob or "unhealthy" in blob) and is_pod:
         out.append(_sugg(
             f"Check logs of {name}",
             "medium",
@@ -243,7 +253,7 @@ def _from_resource_details(kind: str, name: str,
             restarts = max(restarts, int(cs.get("restart_count", 0) or 0))
     except (AttributeError, TypeError, ValueError):
         pass
-    if restarts > 0 and not any(
+    if restarts > 0 and is_pod and not any(
         s["action"].get("type") == "check_logs" for s in out
     ):
         out.append(_sugg(
@@ -269,12 +279,18 @@ def _from_findings(findings: List[Dict[str, Any]],
         if not name:
             continue
         if any(w in issue for w in ("crash", "restart", "exit")):
+            if kind in ("Pod", ""):
+                action = {"type": "check_logs", "pod_name": name,
+                          "previous": "crash" in issue}
+                text = f"Check logs of {name}"
+            else:
+                # logs live in pods; for Service/Deployment findings the
+                # object's events carry the crash detail
+                action = {"type": "check_events", "kind": kind, "name": name}
+                text = f"Inspect events of {kind}/{name}"
             out.append(_sugg(
-                f"Check logs of {name}",
-                "high",
-                f"{agent_type} finding: {f.get('issue')}",
-                {"type": "check_logs", "pod_name": name,
-                 "previous": "crash" in issue},
+                text, "high",
+                f"{agent_type} finding: {f.get('issue')}", action,
             ))
         elif any(w in issue for w in ("event", "warning")):
             out.append(_sugg(
@@ -311,9 +327,24 @@ def _llm_followups(llm, evidence: Dict[str, Any],
                    namespace: str) -> List[Dict[str, Any]]:
     """Up to two ADDITIONAL LLM-proposed suggestions, conditioned on the
     gathered evidence (the reference's :3370 flow, minus its NameError).
-    Offline/failed providers contribute nothing."""
+    Offline/failed providers contribute nothing — and never break the
+    deterministic tier (a provider 500 degrades to [])."""
     if llm is None:
         return []
+    if getattr(getattr(llm, "provider", None), "name", "") == "offline":
+        # the offline provider never emits suggestions; skip the round trip
+        return []
+    try:
+        out = _llm_followups_inner(llm, evidence, namespace)
+    except Exception:
+        # any provider failure (network, 5xx, auth) must not cost the
+        # caller the deterministic suggestions already computed
+        return []
+    return out
+
+
+def _llm_followups_inner(llm, evidence: Dict[str, Any],
+                         namespace: str) -> List[Dict[str, Any]]:
     out = llm.generate_structured_output(
         "Given this Kubernetes investigation evidence, propose up to 2 "
         "NEXT diagnostic actions as JSON "
@@ -386,6 +417,11 @@ def evidence_followups(
             list(evidence.get("findings", [])),
             str(evidence.get("agent_type", "")),
         )
-    llm_tier = _llm_followups(llm, evidence, getattr(ctx, "namespace", ""))
+    # skip the LLM round trip when the deterministic tier already fills the
+    # cap — those entries outrank anything the LLM tier could add
+    llm_tier = (
+        [] if len(specific) >= max_suggestions
+        else _llm_followups(llm, evidence, getattr(ctx, "namespace", ""))
+    )
     generic = build_suggestions(cluster_state_counts(ctx))
     return _dedupe_cap([specific, llm_tier, generic], cap=max_suggestions)

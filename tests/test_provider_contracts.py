@@ -1,0 +1,356 @@
+"""Contract tests for the REAL provider bindings, without network or SDKs.
+
+VERDICT r2 weak-spot 5: ``OpenAIProvider``/``AnthropicProvider`` message and
+tool translation, the tool-call round trip, and quota-error classification
+had zero coverage — every LLM test ran ``OfflineProvider`` subclasses, so a
+signature drift in either SDK binding would ship silently.
+
+These tests install **stub ``openai``/``anthropic`` modules** into
+``sys.modules`` (the real SDKs are not in the image — reference anchor for
+the wire behavior: /root/reference/utils/llm_client_improved.py:163-495).
+Each stub records the exact request the binding sent, asserts nothing about
+the network, and returns canned SDK-shaped responses (tool calls, quota
+errors), driving the bindings end-to-end through ``LLMClient.analyze``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import types
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from rca_tpu.llm.client import LLMClient
+from rca_tpu.llm.providers import (
+    LLMQuotaExceeded,
+    LLMUnavailable,
+)
+from rca_tpu.llm.tools import ToolSpec
+
+
+# -- SDK stubs ---------------------------------------------------------------
+
+class _Obj:
+    """Attribute bag mimicking SDK response objects."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def _openai_text_response(text: str, finish: str = "stop"):
+    return _Obj(choices=[_Obj(
+        message=_Obj(content=text, tool_calls=None), finish_reason=finish,
+    )])
+
+
+def _openai_toolcall_response(calls: List[Dict[str, Any]]):
+    return _Obj(choices=[_Obj(
+        message=_Obj(
+            content=None,
+            tool_calls=[
+                _Obj(id=c["id"], function=_Obj(
+                    name=c["name"], arguments=json.dumps(c["arguments"]),
+                ))
+                for c in calls
+            ],
+        ),
+        finish_reason="tool_calls",
+    )])
+
+
+class _FakeOpenAIClient:
+    def __init__(self, replies: List[Any]):
+        self.requests: List[Dict[str, Any]] = []
+        self._replies = list(replies)
+        outer = self
+
+        class _Completions:
+            def create(self, **kwargs):
+                outer.requests.append(kwargs)
+                reply = outer._replies.pop(0)
+                if isinstance(reply, Exception):
+                    raise reply
+                return reply
+
+        self.chat = _Obj(completions=_Completions())
+
+
+def install_openai_stub(monkeypatch, replies: List[Any]) -> _FakeOpenAIClient:
+    fake_client = _FakeOpenAIClient(replies)
+    mod = types.ModuleType("openai")
+    mod.OpenAI = lambda api_key: fake_client  # binding passes api_key only
+    monkeypatch.setitem(sys.modules, "openai", mod)
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-test")
+    return fake_client
+
+
+def _anthropic_text_response(text: str, stop: str = "end_turn"):
+    return _Obj(
+        content=[_Obj(type="text", text=text)], stop_reason=stop,
+    )
+
+
+def _anthropic_tooluse_response(calls: List[Dict[str, Any]]):
+    return _Obj(
+        content=[
+            _Obj(type="tool_use", id=c["id"], name=c["name"],
+                 input=c["arguments"])
+            for c in calls
+        ],
+        stop_reason="tool_use",
+    )
+
+
+class _FakeAnthropicClient:
+    def __init__(self, replies: List[Any]):
+        self.requests: List[Dict[str, Any]] = []
+        self._replies = list(replies)
+        outer = self
+
+        class _Messages:
+            def create(self, **kwargs):
+                outer.requests.append(kwargs)
+                reply = outer._replies.pop(0)
+                if isinstance(reply, Exception):
+                    raise reply
+                return reply
+
+        self.messages = _Messages()
+
+
+def install_anthropic_stub(
+    monkeypatch, replies: List[Any]
+) -> _FakeAnthropicClient:
+    fake_client = _FakeAnthropicClient(replies)
+    mod = types.ModuleType("anthropic")
+    mod.Anthropic = lambda api_key: fake_client
+    monkeypatch.setitem(sys.modules, "anthropic", mod)
+    monkeypatch.setenv("ANTHROPIC_API_KEY", "sk-ant-test")
+    return fake_client
+
+
+def _make_provider(name: str):
+    # import AFTER stubs are installed; the classes import the SDK lazily
+    # in __init__ so construction under the stub exercises the real path
+    from rca_tpu.llm.providers import AnthropicProvider, OpenAIProvider
+
+    return OpenAIProvider() if name == "openai" else AnthropicProvider()
+
+
+ECHO_TOOL = ToolSpec(
+    name="get_pod_logs",
+    description="fetch pod logs",
+    parameters={
+        "type": "object",
+        "properties": {"pod_name": {"type": "string"}},
+        "required": ["pod_name"],
+    },
+    fn=lambda pod_name="": f"logs-of-{pod_name}: ERROR connection refused",
+)
+
+
+# -- OpenAI wire format ------------------------------------------------------
+
+def test_openai_request_shape_and_tool_roundtrip(monkeypatch):
+    fake = install_openai_stub(monkeypatch, [
+        _openai_toolcall_response(
+            [{"id": "call_1", "name": "get_pod_logs",
+              "arguments": {"pod_name": "db-0"}}]
+        ),
+        _openai_text_response("db-0 is crash-looping"),
+    ])
+    client = LLMClient(provider=_make_provider("openai"))
+    out = client.analyze(
+        "why is db-0 failing?", tools=[ECHO_TOOL],
+        system_prompt="you are an SRE",
+    )
+
+    # round trip: the tool executed and its output reached the final turn
+    assert out["final_analysis"] == "db-0 is crash-looping"
+    assert out["reasoning_steps"][0]["tool"] == "get_pod_logs"
+    assert out["reasoning_steps"][0]["arguments"] == {"pod_name": "db-0"}
+
+    first, second = fake.requests
+    # OpenAI wire shape: tools wrapped as {"type": "function", "function"}
+    assert first["tools"] == [{
+        "type": "function",
+        "function": ECHO_TOOL.schema(),
+    }]
+    assert first["messages"][0] == {
+        "role": "system", "content": "you are an SRE",
+    }
+    assert first["messages"][1] == {
+        "role": "user", "content": "why is db-0 failing?",
+    }
+    # second request replays the assistant tool call in OpenAI's nested
+    # function shape with JSON-ENCODED arguments, then the tool result
+    # bound by tool_call_id
+    assistant = second["messages"][2]
+    assert assistant["role"] == "assistant"
+    assert assistant["tool_calls"] == [{
+        "id": "call_1",
+        "type": "function",
+        "function": {
+            "name": "get_pod_logs",
+            "arguments": json.dumps({"pod_name": "db-0"}),
+        },
+    }]
+    tool_msg = second["messages"][3]
+    assert tool_msg["role"] == "tool"
+    assert tool_msg["tool_call_id"] == "call_1"
+    assert "logs-of-db-0" in tool_msg["content"]
+
+
+def test_openai_json_mode_flag(monkeypatch):
+    fake = install_openai_stub(monkeypatch, [
+        _openai_text_response('{"a": 1}'),
+    ])
+    client = LLMClient(provider=_make_provider("openai"))
+    out = client.generate_structured_output("give json")
+    assert out == {"a": 1}
+    assert fake.requests[0]["response_format"] == {"type": "json_object"}
+
+
+def test_openai_malformed_tool_arguments_degrade_to_empty(monkeypatch):
+    """SDKs deliver arguments as a JSON string; garbage must not crash the
+    loop (providers._safe_json)."""
+    resp = _Obj(choices=[_Obj(
+        message=_Obj(content=None, tool_calls=[
+            _Obj(id="x", function=_Obj(name="get_pod_logs",
+                                       arguments="{not json")),
+        ]),
+        finish_reason="tool_calls",
+    )])
+    install_openai_stub(monkeypatch, [resp, _openai_text_response("done")])
+    client = LLMClient(provider=_make_provider("openai"))
+    out = client.analyze("q", tools=[ECHO_TOOL])
+    assert out["final_analysis"] == "done"
+    assert out["reasoning_steps"][0]["arguments"] == {}
+
+
+# -- Anthropic wire format ---------------------------------------------------
+
+def test_anthropic_request_shape_and_tool_roundtrip(monkeypatch):
+    fake = install_anthropic_stub(monkeypatch, [
+        _anthropic_tooluse_response(
+            [{"id": "toolu_1", "name": "get_pod_logs",
+              "arguments": {"pod_name": "db-0"}}]
+        ),
+        _anthropic_text_response("db-0 is crash-looping"),
+    ])
+    client = LLMClient(provider=_make_provider("anthropic"))
+    out = client.analyze(
+        "why is db-0 failing?", tools=[ECHO_TOOL],
+        system_prompt="you are an SRE",
+    )
+
+    assert out["final_analysis"] == "db-0 is crash-looping"
+    assert out["reasoning_steps"][0]["tool"] == "get_pod_logs"
+
+    first, second = fake.requests
+    # Anthropic wire shape: system is a TOP-LEVEL param, not a message
+    assert first["system"] == "you are an SRE"
+    assert all(m["role"] != "system" for m in first["messages"])
+    # tools carry input_schema (not "parameters")
+    assert first["tools"] == [{
+        "name": "get_pod_logs",
+        "description": "fetch pod logs",
+        "input_schema": ECHO_TOOL.parameters,
+    }]
+    # the replayed assistant turn uses tool_use content blocks with DICT
+    # input; the result returns as a user-role tool_result block
+    assistant = second["messages"][1]
+    assert assistant["role"] == "assistant"
+    assert {"type": "tool_use", "id": "toolu_1", "name": "get_pod_logs",
+            "input": {"pod_name": "db-0"}} in assistant["content"]
+    result_msg = second["messages"][2]
+    assert result_msg["role"] == "user"
+    block = result_msg["content"][0]
+    assert block["type"] == "tool_result"
+    assert block["tool_use_id"] == "toolu_1"
+    assert "logs-of-db-0" in block["content"]
+
+
+def test_anthropic_json_mode_appends_instruction(monkeypatch):
+    fake = install_anthropic_stub(monkeypatch, [
+        _anthropic_text_response('```json\n{"b": 2}\n```'),
+    ])
+    client = LLMClient(provider=_make_provider("anthropic"))
+    out = client.generate_structured_output("give json")
+    # fenced-block rescue still applies to real-provider output
+    assert out == {"b": 2}
+    assert "valid JSON" in fake.requests[0]["system"]
+
+
+def test_anthropic_multiblock_text_joined(monkeypatch):
+    resp = _Obj(
+        content=[
+            _Obj(type="text", text="part one"),
+            _Obj(type="text", text="part two"),
+        ],
+        stop_reason="end_turn",
+    )
+    install_anthropic_stub(monkeypatch, [resp])
+    client = LLMClient(provider=_make_provider("anthropic"))
+    assert client.generate_completion("q") == "part one\npart two"
+
+
+# -- quota classification & failover ----------------------------------------
+
+class _FakeRateLimitError(Exception):
+    """Shaped like SDK rate-limit errors: classification is message-based
+    (providers._classify_error), matching the reference's string checks
+    (reference: utils/llm_client_improved.py:465-495)."""
+
+
+@pytest.mark.parametrize("msg,expect_quota", [
+    ("Error code: 429 - Rate limit reached for gpt-4o", True),
+    ("You exceeded your current quota, please check your plan", True),
+    ("rate_limit_error: Number of request tokens has exceeded", True),
+    ("Error code: 500 - internal server error", False),
+])
+def test_quota_error_classification(monkeypatch, msg, expect_quota):
+    install_openai_stub(monkeypatch, [_FakeRateLimitError(msg)])
+    provider = _make_provider("openai")
+    with pytest.raises(LLMUnavailable) as exc_info:
+        provider.complete([{"role": "user", "content": "q"}])
+    assert isinstance(exc_info.value, LLMQuotaExceeded) == expect_quota
+
+
+def test_quota_failover_openai_to_anthropic(monkeypatch):
+    """End-to-end runtime failover through LLMClient._complete: OpenAI 429s,
+    the client fails over to Anthropic (stub) and sticks with it."""
+    install_openai_stub(monkeypatch, [
+        _FakeRateLimitError("Error code: 429 - rate limit"),
+    ])
+    install_anthropic_stub(monkeypatch, [
+        _anthropic_text_response("anthropic took over"),
+        _anthropic_text_response("still anthropic"),
+    ])
+    client = LLMClient(provider=_make_provider("openai"))
+    assert client.generate_completion("q") == "anthropic took over"
+    assert client.provider.name == "anthropic"  # sticky failover
+    assert client.generate_completion("q2") == "still anthropic"
+
+
+def test_quota_failover_lands_offline_when_all_keys_missing(monkeypatch):
+    """Anthropic quota error with no other provider configured degrades to
+    the deterministic offline provider instead of dying."""
+    install_anthropic_stub(monkeypatch, [
+        _FakeRateLimitError("rate_limit_error"),
+    ])
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    client = LLMClient(provider=_make_provider("anthropic"))
+    text = client.generate_completion("q")
+    assert text.startswith("Offline analysis")
+    assert client.provider.name == "offline"
+
+
+def test_missing_key_raises_unavailable(monkeypatch):
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    from rca_tpu.llm.providers import OpenAIProvider
+
+    with pytest.raises(LLMUnavailable):
+        OpenAIProvider()
