@@ -245,6 +245,7 @@ def cmd_stream(args) -> int:
             "tick": out["tick"],
             "latency_ms": round(out["latency_ms"], 3),
             "capture_ms": out["capture_ms"],
+            "quiet": out.get("quiet", False),
             "changed_rows": out["changed_rows"],
             "upload_rows": out["upload_rows"],
             "resynced": out["resynced"],

@@ -559,6 +559,42 @@ class K8sApiClient:
             return {"error": f"{kind}/{name} not found in namespace {namespace}"}
         return data
 
+    # ---- incremental changes (watch surface) ------------------------------
+    def watch_changes(
+        self, namespace: str, cursor: Optional[str]
+    ) -> Dict[str, Any]:
+        """Kubernetes-watch-backed incremental change feed (VERDICT r2
+        item 6).  Background pump threads hold long watch streams on pods
+        and events (the kinds whose churn drives streaming features) and
+        queue ``(kind, name)`` notifications; each call drains the queue
+        without blocking — the poll loop never waits on the API server.
+
+        ``cursor=None`` (re)starts the pumps for this namespace.  A pump
+        death (410 Gone, queue overflow, network error) reports
+        ``expired`` — the caller resyncs from a full list exactly as a
+        real watch consumer re-lists, then reopens with ``cursor=None``.
+        Without the kubernetes lib (kubectl-only clients) this surface is
+        ``supported: False`` and callers keep the full-sweep path."""
+        if not HAVE_K8S_LIB or not self._connected:
+            return {"supported": False, "cursor": None,
+                    "expired": False, "changes": []}
+        from rca_tpu.cluster.watch_pump import WatchPumpSet
+
+        if cursor is None or getattr(self, "_pumps", None) is None or (
+            self._pumps.namespace != namespace
+        ):
+            if getattr(self, "_pumps", None) is not None:
+                self._pumps.stop()
+            self._pumps = WatchPumpSet(self._core, namespace)
+            self._pumps.start()
+            return {"supported": True, "cursor": self._pumps.token,
+                    "expired": False, "changes": []}
+        if cursor != self._pumps.token or self._pumps.expired:
+            return {"supported": True, "cursor": self._pumps.token,
+                    "expired": True, "changes": []}
+        return {"supported": True, "cursor": self._pumps.token,
+                "expired": False, "changes": self._pumps.drain()}
+
     def run_kubectl(self, args: List[str]) -> str:
         if not self._kubectl:
             return "kubectl not available"

@@ -201,6 +201,45 @@ class MockClusterClient:
         ops = self.world.traces.get("slow_ops", {}).get(namespace, [])
         return [op for op in ops if op.get("duration_ms", 0) >= threshold_ms]
 
+    # ---- incremental changes (watch surface) ------------------------------
+    def watch_changes(
+        self, namespace: str, cursor: Optional[str]
+    ) -> Dict[str, Any]:
+        """Journal-backed incremental change feed (the hermetic twin of
+        kubernetes watch streams; VERDICT r2 item 6).
+
+        ``cursor=None`` opens the feed at the journal head.  Returns
+        ``{"supported", "cursor", "expired", "changes"}`` where each change
+        is ``{"kind", "name"}`` (deduped, this namespace only).  A cursor
+        older than the journal's retained window reports ``expired`` — the
+        caller must resync from a full snapshot, exactly like a 410 Gone
+        on a real watch."""
+        w = self.world
+        if cursor is None:
+            return {"supported": True, "cursor": str(w.journal_seq),
+                    "expired": False, "changes": []}
+        try:
+            seq = int(cursor)
+        except ValueError:
+            return {"supported": True, "cursor": str(w.journal_seq),
+                    "expired": True, "changes": []}
+        entries = w.changes_since(seq)
+        if entries is None:
+            return {"supported": True, "cursor": str(w.journal_seq),
+                    "expired": True, "changes": []}
+        seen = set()
+        changes = []
+        for e in entries:
+            if e["namespace"] != namespace:
+                continue
+            key = (e["kind"], e["name"])
+            if key in seen:
+                continue
+            seen.add(key)
+            changes.append({"kind": e["kind"], "name": e["name"]})
+        return {"supported": True, "cursor": str(w.journal_seq),
+                "expired": False, "changes": changes}
+
     # ---- generic ---------------------------------------------------------
     _KIND_STORES = {
         "pod": "pods",

@@ -1,0 +1,138 @@
+"""Background kubernetes watch pumps feeding the incremental-change feed.
+
+The live :meth:`K8sApiClient.watch_changes` surface must never block the
+1 Hz streaming poll loop on the API server, so watches run in daemon
+threads: each pump holds one long ``kubernetes.watch.Watch`` stream (pods,
+events) and appends ``{"kind", "name"}`` notifications to a bounded
+thread-safe queue; :meth:`WatchPumpSet.drain` empties it without blocking.
+
+Failure semantics mirror a real watch consumer's contract:
+
+- **410 Gone** (the server compacted past our resourceVersion), queue
+  overflow, or any stream error marks the pump set ``expired`` — the
+  caller re-lists (full resync) and reopens with ``cursor=None``;
+- streams auto-renew on their server-side timeout (a normal end of stream
+  is NOT an expiry; the watch lib re-lists internally from "now").
+
+Tested hermetically with a stub ``kubernetes`` module
+(tests/test_watch.py) — the same technique as the provider contract tests.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, List
+
+QUEUE_CAP = 10_000
+
+# resource kinds pumped: churn in these drives streaming features; other
+# kinds (services, deployments, config) change topology and are handled by
+# the session's periodic full check
+_PUMPED = (
+    ("pod", "list_namespaced_pod"),
+    ("event", "list_namespaced_event"),
+)
+
+
+class _Pump(threading.Thread):
+    def __init__(self, owner: "WatchPumpSet", kind: str, list_method: str):
+        super().__init__(daemon=True, name=f"rca-watch-{kind}")
+        self.owner = owner
+        self.kind = kind
+        self.list_method = list_method
+
+    def run(self) -> None:  # pragma: no cover - exercised via stub in tests
+        from kubernetes import watch
+
+        w = watch.Watch()
+        try:
+            while not self.owner._stop.is_set():
+                stream = w.stream(
+                    getattr(self.owner.core, self.list_method),
+                    namespace=self.owner.namespace,
+                    timeout_seconds=30,
+                )
+                for ev in stream:
+                    if self.owner._stop.is_set():
+                        return
+                    obj = ev.get("object")
+                    name = ""
+                    meta = getattr(obj, "metadata", None)
+                    if meta is not None:
+                        name = getattr(meta, "name", "") or ""
+                    elif isinstance(obj, dict):
+                        name = obj.get("metadata", {}).get("name", "")
+                    if self.kind == "event":
+                        # the change the analyzer cares about is the event's
+                        # INVOLVED object; fall back to the event's own name
+                        inv = getattr(obj, "involved_object", None)
+                        if inv is not None and getattr(inv, "name", ""):
+                            name = inv.name
+                        elif isinstance(obj, dict):
+                            name = (
+                                obj.get("involvedObject", {}).get("name", "")
+                                or name
+                            )
+                    if name:
+                        self.owner.push(self.kind, name)
+                # normal stream end (server timeout): loop re-opens from now
+        except Exception:
+            # 410 Gone / network error / anything: the consumer must
+            # re-list; a dead pump silently dropping changes would be the
+            # one unrecoverable failure mode
+            self.owner.mark_expired()
+        finally:
+            w.stop()
+
+
+class WatchPumpSet:
+    """One pump per watched kind for a single namespace."""
+
+    _counter = 0
+
+    def __init__(self, core_api: Any, namespace: str):
+        self.core = core_api
+        self.namespace = namespace
+        WatchPumpSet._counter += 1
+        self.token = f"pumps-{WatchPumpSet._counter}"
+        self._lock = threading.Lock()
+        self._queue: collections.deque = collections.deque()
+        self._stop = threading.Event()
+        self._expired = threading.Event()
+        self._threads = [_Pump(self, k, m) for k, m in _PUMPED]
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def push(self, kind: str, name: str) -> None:
+        with self._lock:
+            if len(self._queue) >= QUEUE_CAP:
+                # overflow: the consumer fell too far behind to trust a
+                # drain — same contract as a compacted resourceVersion
+                self._expired.set()
+                return
+            self._queue.append({"kind": kind, "name": name})
+
+    def drain(self) -> List[Dict[str, str]]:
+        with self._lock:
+            seen = set()
+            out = []
+            while self._queue:
+                c = self._queue.popleft()
+                key = (c["kind"], c["name"])
+                if key not in seen:
+                    seen.add(key)
+                    out.append(c)
+            return out
+
+    @property
+    def expired(self) -> bool:
+        return self._expired.is_set()
+
+    def mark_expired(self) -> None:
+        self._expired.set()

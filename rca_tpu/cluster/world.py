@@ -60,14 +60,64 @@ class World:
     # ground truth for synthetic worlds (fault-injection bookkeeping)
     ground_truth: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
+    # -- mutation journal (the watch surface; VERDICT r2 item 6) ----------
+    # Incremental-change feed backing MockClusterClient.watch_changes, the
+    # hermetic twin of kubernetes watch streams.  Mutations made through
+    # the real K8s API always pass the API server; in the mock, DIRECT
+    # dict edits are "out-of-band" — call :meth:`touch` after one (or use
+    # :meth:`add`, which journals automatically) for a watcher to see it.
+    journal: List[dict] = dataclasses.field(default_factory=list)
+    journal_seq: int = 0
+    journal_cap: int = 10_000  # older entries trim; stale cursors expire
+    journal_floor: int = 0     # seq of the oldest retained entry
+
     def namespaces(self) -> List[str]:
         names = set()
         for store in (self.pods, self.services, self.deployments, self.events):
             names.update(store.keys())
         return sorted(names) or ["default"]
 
+    def touch(self, kind: str, namespace: str, name: str) -> None:
+        """Record that object ``kind``/``name`` changed (create, update, or
+        delete — watchers re-fetch, so the op is irrelevant).  ``kind`` is
+        the singular store name ("pod", "service", ...) plus the pseudo
+        kinds "pod_metrics", "event", and "logs"."""
+        self.journal_seq += 1
+        self.journal.append(
+            {"seq": self.journal_seq, "kind": kind,
+             "namespace": namespace, "name": name}
+        )
+        if len(self.journal) > self.journal_cap:
+            drop = len(self.journal) - self.journal_cap
+            del self.journal[:drop]
+            self.journal_floor = self.journal[0]["seq"]
+
+    def changes_since(self, seq: int) -> Optional[List[dict]]:
+        """Journal entries after ``seq``; None = expired (trimmed past).
+
+        A cursor at ``floor - 1`` is still complete — it needs entries
+        from ``floor`` onward, all of which are retained; only cursors
+        strictly older than that have lost entries to the trim."""
+        if seq < self.journal_floor - 1:
+            return None
+        return [e for e in self.journal if e["seq"] > seq]
+
+    _KIND_SINGULAR = {
+        "pods": "pod", "services": "service", "deployments": "deployment",
+        "statefulsets": "statefulset", "daemonsets": "daemonset",
+        "cronjobs": "cronjob", "events": "event", "endpoints": "endpoints",
+        "ingresses": "ingress", "network_policies": "networkpolicy",
+        "configmaps": "configmap", "secrets": "secret", "pvcs": "pvc",
+        "resource_quotas": "resourcequota", "hpas": "hpa",
+    }
+
     def add(self, kind: str, namespace: str, obj: dict) -> dict:
         getattr(self, kind).setdefault(namespace, []).append(obj)
+        self.touch(
+            self._KIND_SINGULAR.get(kind, kind), namespace,
+            obj.get("metadata", {}).get("name", "")
+            or obj.get("involvedObject", {}).get("name", ""),
+        )
         return obj
 
 

@@ -439,6 +439,7 @@ def _stream_tab_body(st, client, namespace) -> None:
             "tick": out["tick"],
             "latency_ms": round(out["latency_ms"], 1),
             "capture_ms": out["capture_ms"],
+            "quiet": out.get("quiet", False),
             "changed_rows": out["changed_rows"],
             "upload_rows": out["upload_rows"],
             "resynced": out["resynced"],

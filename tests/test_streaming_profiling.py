@@ -186,6 +186,9 @@ def test_live_streaming_session_tracks_world_changes():
         waiting_status(victim_svc, "CrashLoopBackOff",
                        restarts=9, last_exit_code=1)
     ]
+    # direct dict edits are out-of-band for the watch feed — notify it,
+    # as every API-server-mediated mutation would be
+    world.touch("pod", "stream", pod["metadata"]["name"])
     out2 = live.poll()
     assert out2["resynced"] is False
     assert 1 <= out2["changed_rows"] <= 3  # only the mutated service moved
@@ -198,6 +201,7 @@ def test_live_streaming_session_tracks_world_changes():
         {"name": victim_svc, "ready": True, "restartCount": 0,
          "state": {"running": {}}}
     ]
+    world.touch("pod", "stream", pod["metadata"]["name"])
     out3 = live.poll()
     assert out3["ranked"][0]["component"] == root
     assert victim_svc not in {r["component"] for r in out3["ranked"][:1]}
@@ -216,12 +220,13 @@ def test_live_streaming_session_resyncs_on_topology_change():
     n0 = len(live._names)
 
     # a brand-new service appears -> topology changed -> full rebuild
-    world.services[NS].append({
+    # (World.add journals the change, so the watch feed reports it)
+    world.add("services", NS, {
         "metadata": {"name": "newsvc", "namespace": NS},
         "spec": {"selector": {"app": "newsvc"},
                  "ports": [{"port": 80}]},
     })
-    world.deployments[NS].append(make_deployment("newsvc", NS, "newsvc"))
+    world.add("deployments", NS, make_deployment("newsvc", NS, "newsvc"))
     out = live.poll()
     assert out["resynced"] is True
     assert live.resyncs == 1
