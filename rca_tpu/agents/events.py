@@ -124,5 +124,19 @@ class EventsAgent(Agent):
                         NODE_CONDITION_RECS[ctype],
                     )
 
+        # viz payload: namespace-wide breakdowns by reason and by type
+        # (reference: components/visualization.py event breakdown charts)
+        reason_counts: Dict[str, int] = {}
+        type_counts: Dict[str, int] = {}
+        for ev in snap.events:
+            n = int(ev.get("count", 1) or 1)
+            reason = str(ev.get("reason", "") or "unknown")
+            reason_counts[reason] = reason_counts.get(reason, 0) + n
+            etype = str(ev.get("type", "") or "unknown")
+            type_counts[etype] = type_counts.get(etype, 0) + n
+        if reason_counts:
+            r.data["reason_counts"] = reason_counts
+            r.data["type_counts"] = type_counts
+
         summarize(r, "event")
         return r

@@ -127,5 +127,13 @@ class TracesAgent(Agent):
                     "alerts and capacity",
                 )
 
+        # viz payload: per-service latency percentiles (reference:
+        # components/visualization.py latency charts per service)
+        if lat:
+            r.data["latency"] = {
+                name: stats for name, stats in sorted(lat.items())
+                if isinstance(stats, dict)
+            }
+
         summarize(r, "trace")
         return r

@@ -580,20 +580,27 @@ class K8sApiClient:
                     "expired": False, "changes": []}
         from rca_tpu.cluster.watch_pump import WatchPumpSet
 
-        if cursor is None or getattr(self, "_pumps", None) is None or (
-            self._pumps.namespace != namespace
-        ):
-            if getattr(self, "_pumps", None) is not None:
-                self._pumps.stop()
-            self._pumps = WatchPumpSet(self._core, namespace)
-            self._pumps.start()
-            return {"supported": True, "cursor": self._pumps.token,
+        # one pump set PER NAMESPACE: two sessions sharing this client
+        # (different namespaces) must not thrash each other's feed into a
+        # mutual expire/resync loop (round-3 review finding)
+        pumps_by_ns: Dict[str, WatchPumpSet] = getattr(self, "_pumps", None)
+        if pumps_by_ns is None:
+            pumps_by_ns = self._pumps = {}
+        pumps = pumps_by_ns.get(namespace)
+        if cursor is None or pumps is None:
+            if pumps is not None:
+                pumps.stop()
+            pumps = pumps_by_ns[namespace] = WatchPumpSet(
+                self._core, namespace
+            )
+            pumps.start()
+            return {"supported": True, "cursor": pumps.token,
                     "expired": False, "changes": []}
-        if cursor != self._pumps.token or self._pumps.expired:
-            return {"supported": True, "cursor": self._pumps.token,
+        if cursor != pumps.token or pumps.expired:
+            return {"supported": True, "cursor": pumps.token,
                     "expired": True, "changes": []}
-        return {"supported": True, "cursor": self._pumps.token,
-                "expired": False, "changes": self._pumps.drain()}
+        return {"supported": True, "cursor": pumps.token,
+                "expired": False, "changes": pumps.drain()}
 
     def run_kubectl(self, args: List[str]) -> str:
         if not self._kubectl:

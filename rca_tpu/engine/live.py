@@ -106,7 +106,7 @@ class LiveStreamingSession:
         src, dst = edges if edges is not None else service_dependency_edges(
             snap, fs
         )
-        self._snap = snap
+        self._snap = snap if self._watch else None
         self._names = list(fs.service_names)
         self._edge_key = (src.tobytes(), dst.tobytes())
         self._features = np.array(fs.service_features, np.float32)
@@ -143,6 +143,7 @@ class LiveStreamingSession:
         traces_touched = any(c["kind"] == "traces" for c in changes)
 
         patch: Dict[str, Any] = {"captured_at": self.client.get_current_time()}
+        can_check_errors = hasattr(self.client, "collect_errors")
         if traces_touched:
             # error-rate/latency channels come straight from trace data —
             # a journaled trace update re-pulls the four payloads (each is
@@ -162,15 +163,29 @@ class LiveStreamingSession:
             except Exception:
                 pass
         if pod_names:
+            by_name_old = {
+                p.get("metadata", {}).get("name"): p for p in snap.pods
+            }
             kept = [
                 p for p in snap.pods
                 if p.get("metadata", {}).get("name") not in pod_names
             ]
             refetched = []
             for name in sorted(pod_names):
+                if can_check_errors:
+                    self.client.collect_errors()  # drain stale errors
                 pod = self.client.get_pod(self.namespace, name)
                 if pod is not None:
                     refetched.append(pod)
+                elif can_check_errors and self.client.collect_errors():
+                    # None + a recorded fetch error = transient failure,
+                    # NOT deletion — keep the stale object rather than
+                    # fabricating a pod removal the cluster never saw
+                    # (round-3 review finding); the next change or sweep
+                    # refreshes it
+                    old = by_name_old.get(name)
+                    if old is not None:
+                        refetched.append(old)
             patch["pods"] = kept + sanitize_objects(refetched)
         if pod_names or log_names:
             logs = dict(snap.logs)
@@ -306,11 +321,22 @@ class LiveStreamingSession:
             if (edges[0].tobytes(), edges[1].tobytes()) != self._edge_key:
                 resynced = True
         if resynced:
-            self._reopen_feed()
-            self._resync(snap=snap, fs=fs, edges=edges)
+            if self._watch:
+                # reopen-THEN-capture, not the reverse: jumping the cursor
+                # to head after this (already minutes-old) capture would
+                # orphan every change that landed during it.  _resync with
+                # no snapshot does the ordering right (reopen, re-list) at
+                # the cost of one extra sweep — resyncs are rare
+                self._resync()
+            else:
+                self._resync(snap=snap, fs=fs, edges=edges)
             return self._finish(
                 t0, changed=len(self._names), resynced=True, quiet=False,
             )
-        self._snap = snap
+        if self._watch:
+            # only the watch path's _patch_snapshot ever reads _snap;
+            # retaining a 10k-service snapshot in pure-sweep mode would
+            # pin pods+logs+events for the session lifetime for nothing
+            self._snap = snap
         changed = self._upload_diff(fs)
         return self._finish(t0, changed=changed, resynced=False, quiet=False)

@@ -138,12 +138,30 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
     investigation = store.get_investigation(inv_id) or {}
 
     st.title("Kubernetes Root Cause Analysis")
-    tab_chat, tab_report, tab_topology, tab_wizard, tab_stream = st.tabs(
-        ["Chat", "Report", "Topology", "Investigate", "Stream"]
+    # view navigation with ?view= deep links (reference: app.py:88-105
+    # reads ?investigation=<id>&view=chat): a radio nav (not st.tabs,
+    # which cannot be preselected programmatically) restores the view
+    # named in the URL and writes the user's choice back to it
+    views = ["Chat", "Report", "Topology", "Investigate", "Stream"]
+    url_view = str(st.query_params.get("view", "")).lower()
+    default_idx = next(
+        (i for i, v in enumerate(views) if v.lower() == url_view), 0
     )
+    view = st.radio(
+        "View", views, index=default_idx, horizontal=True,
+        label_visibility="collapsed",
+    )
+    if st.query_params.get("view") != view.lower():
+        st.query_params["view"] = view.lower()
 
-    # ---- chat tab (reference: chatbot_interface.py) ----------------------
-    with tab_chat:
+    # per-namespace session keys: results/topology/wizard state from one
+    # namespace must not leak into another after a sidebar switch
+    results_key = f"last_results-{namespace}"
+    topology_key = f"topology-{namespace}"
+    wizard_key = f"wizard-{namespace}"
+
+    # ---- chat view (reference: chatbot_interface.py) ---------------------
+    if view == "Chat":
         for msg in investigation.get("conversation", []):
             with st.chat_message(msg["role"]):
                 content = msg["content"]
@@ -189,8 +207,8 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                 store.set_title(inv_id, title)
             st.rerun()
 
-    # ---- report tab (reference: report.py:57-196 tabbed report) ----------
-    with tab_report:
+    # ---- report view (reference: report.py:57-196 tabbed report) ---------
+    elif view == "Report":
         if st.button("Run comprehensive analysis"):
             with st.spinner("Analyzing (TPU fusion)…"):
                 record = coord.run_analysis("comprehensive", namespace)
@@ -200,11 +218,11 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                     + str(record.get("error", "unknown error"))
                 )
                 # don't render a previous run's results under the error
-                st.session_state.pop("last_results", None)
+                st.session_state.pop(results_key, None)
             else:
-                st.session_state.last_results = record.get("results", {})
+                st.session_state[results_key] = record.get("results", {})
                 store.add_agent_findings(inv_id, "comprehensive", record)
-        results = st.session_state.get("last_results")
+        results = st.session_state.get(results_key)
         if results:
             if results.get("degraded"):
                 st.warning(results["degraded"]["note"])
@@ -229,22 +247,20 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                     viz = analysis_viz_data(agent, res)
                     for chart in analysis_chart_series(viz):
                         st.caption(chart["title"])
-                        if chart["kind"] == "bar":
-                            st.bar_chart(chart["data"])
-                        else:
-                            st.dataframe(chart["data"])
+                        _render_chart(st, chart)
                     if agent == "topology" and viz.get("graph"):
                         st.caption("Dependency graph")
                         st.json(topology_plot_data(viz["graph"]))
-                    for f in res.get("findings", [])[:12]:
-                        st.markdown(finding_markdown(f))
+                    with st.expander("Finding details"):
+                        for f in res.get("findings", [])[:12]:
+                            st.markdown(finding_markdown(f))
 
-    # ---- topology tab (reference: visualization.py) ----------------------
-    with tab_topology:
+    # ---- topology view (reference: visualization.py) ---------------------
+    elif view == "Topology":
         if st.button("Build topology graph"):
             ctx = coord.capture(namespace)
-            st.session_state.topology = ctx.graph.to_dict()
-        graph = st.session_state.get("topology")
+            st.session_state[topology_key] = ctx.graph.to_dict()
+        graph = st.session_state.get(topology_key)
         if graph:
             data = topology_plot_data(graph)
             try:
@@ -286,14 +302,14 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                 st.json(data)
 
     # ---- guided 4-stage wizard (reference: interactive_session.py) -------
-    with tab_wizard:
+    elif view == "Investigate":
         from rca_tpu.ui.render import wizard_stage_markdown
 
-        wiz = st.session_state.setdefault("wizard", {"stage": 0})
+        wiz = st.session_state.setdefault(wizard_key, {"stage": 0})
         st.markdown(wizard_stage_markdown(wiz))
 
         if wiz["stage"] == 0:
-            results = st.session_state.get("last_results")
+            results = st.session_state.get(results_key)
             if not results:
                 st.info("Run a comprehensive analysis in the Report tab "
                         "first, then pick a finding to investigate.")
@@ -383,12 +399,56 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                 store.add_evidence(inv_id, "root_cause_report", wiz["report"])
             st.markdown(wiz["report"])
             if st.button("Start a new investigation"):
-                st.session_state["wizard"] = {"stage": 0}
+                st.session_state[wizard_key] = {"stage": 0}
                 st.rerun()
 
-    # ---- live streaming tab (engine/live.py; no reference equivalent) ----
-    with tab_stream:
+    # ---- live streaming view (engine/live.py; no reference equivalent) ---
+    elif view == "Stream":
         _render_stream_tab(st, client, namespace)
+
+
+def _render_chart(st, chart) -> None:
+    """Draw one renderer-agnostic chart spec (ui.render.
+    analysis_chart_series).  Bars with ``thresholds`` draw the 80/90%
+    rule-engine lines when plotly is available (reference:
+    components/visualization.py utilization charts) and degrade to a plain
+    bar chart otherwise; ``findings_table`` rows carry severity icons so
+    the table reads severity-colored without a pandas Styler dependency."""
+    kind = chart.get("kind")
+    if kind == "bar":
+        thresholds = chart.get("thresholds") or []
+        if thresholds:
+            try:
+                import plotly.graph_objects as go
+
+                data = chart["data"]
+                fig = go.Figure(
+                    go.Bar(x=list(data.keys()), y=list(data.values()))
+                )
+                for t in thresholds:
+                    fig.add_hline(
+                        y=t["value"], line_dash="dash",
+                        annotation_text=t.get("label", str(t["value"])),
+                    )
+                st.plotly_chart(fig, use_container_width=True)
+                return
+            except ImportError:
+                st.caption(
+                    "thresholds: "
+                    + ", ".join(t.get("label", "") for t in thresholds)
+                )
+        st.bar_chart(chart["data"])
+    elif kind == "findings_table":
+        st.dataframe(
+            [
+                {"": row["icon"], "severity": row["severity"],
+                 "component": row["component"], "issue": row["issue"]}
+                for row in chart["data"]
+            ],
+            use_container_width=True,
+        )
+    else:
+        st.dataframe(chart["data"])
 
 
 def _render_stream_tab(st, client, namespace) -> None:

@@ -165,6 +165,23 @@ def analysis_viz_data(agent_type: str, result: Dict[str, Any]) -> Dict[str, Any]
             if isinstance(f.get("evidence"), dict)
             and "error_rate" in f["evidence"]
         ]
+        out["latency"] = result.get("data", {}).get("latency", {})
+    elif agent_type == "events":
+        out["reason_counts"] = result.get("data", {}).get("reason_counts", {})
+        out["type_counts"] = result.get("data", {}).get("type_counts", {})
+    # severity-tagged findings rows: the table every tab can render with
+    # per-row severity coloring (reference: report/resource tables)
+    out["finding_rows"] = [
+        {
+            "severity": str(f.get("severity", "info")).lower(),
+            "icon": SEVERITY_ICONS.get(
+                str(f.get("severity", "info")).lower(), "⚪"
+            ),
+            "component": str(f.get("component", "")),
+            "issue": str(f.get("issue", ""))[:120],
+        }
+        for f in findings
+    ]
     return out
 
 
@@ -186,7 +203,10 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
     agent = viz.get("agent_type", "")
     if agent == "metrics" and viz.get("utilization"):
         # one component can carry several metrics findings (cpu AND memory)
-        # — key by component+resource so neither overwrites the other
+        # — key by component+resource so neither overwrites the other.
+        # Thresholds mirror the rule engine's 80%/90% utilization ladder
+        # (reference: components/visualization.py utilization charts draw
+        # the same warn/critical lines; agents/metrics_agent.py:88-151)
         charts.append({
             "title": "Utilization (% of limit)", "kind": "bar",
             "data": {
@@ -196,6 +216,10 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
                 ): row.get("usage_percentage", 0)
                 for row in viz["utilization"]
             },
+            "thresholds": [
+                {"value": 80, "label": "warn (80%)"},
+                {"value": 90, "label": "critical (90%)"},
+            ],
         })
     elif agent == "logs" and viz.get("pattern_counts"):
         charts.append({
@@ -207,14 +231,38 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
             "title": "Pod status buckets", "kind": "bar",
             "data": {k: v for k, v in viz["pod_buckets"].items() if v},
         })
-    elif agent == "traces" and viz.get("error_rates"):
-        charts.append({
-            "title": "Error rate per service", "kind": "bar",
-            "data": {
-                row["component"]: row["error_rate"]
-                for row in viz["error_rates"]
-            },
-        })
+    elif agent == "events":
+        if viz.get("reason_counts"):
+            charts.append({
+                "title": "Events by reason", "kind": "bar",
+                "data": dict(sorted(
+                    viz["reason_counts"].items(),
+                    key=lambda kv: -kv[1],
+                )[:12]),
+            })
+        if viz.get("type_counts"):
+            charts.append({
+                "title": "Events by type", "kind": "bar",
+                "data": dict(viz["type_counts"]),
+            })
+    elif agent == "traces":
+        if viz.get("error_rates"):
+            charts.append({
+                "title": "Error rate per service", "kind": "bar",
+                "data": {
+                    row["component"]: row["error_rate"]
+                    for row in viz["error_rates"]
+                },
+            })
+        lat = viz.get("latency") or {}
+        if lat:
+            charts.append({
+                "title": "p95 latency per service (ms)", "kind": "bar",
+                "data": {
+                    name: stats.get("p95", 0)
+                    for name, stats in lat.items()
+                },
+            })
     elif agent == "topology" and viz.get("service_pod_mapping"):
         charts.append({
             "title": "Service → pod mapping", "kind": "table",
@@ -224,6 +272,13 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
                 )}
                 for svc, info in viz["service_pod_mapping"].items()
             ],
+        })
+    # per-row severity-tagged findings table, every agent (reference:
+    # resource/report tables with severity coloring)
+    if viz.get("finding_rows"):
+        charts.append({
+            "title": "Findings", "kind": "findings_table",
+            "data": viz["finding_rows"],
         })
     return charts
 

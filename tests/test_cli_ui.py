@@ -218,6 +218,75 @@ def test_analysis_chart_series_per_agent():
     assert table["data"][0]["service"] == "svc-a"
 
 
+def test_chart_series_per_type_richness():
+    """Round-3 per-type chart parity (VERDICT r2 item 8): metrics carry
+    the 80/90% rule-engine threshold lines, events break down by reason
+    and type, traces chart latency percentiles, and every agent emits a
+    severity-tagged findings table."""
+    from rca_tpu.ui.render import analysis_chart_series, analysis_viz_data
+
+    metrics_result = {
+        "findings": [
+            {"component": "Pod/y", "severity": "high",
+             "evidence": {"usage_percentage": 95.0, "resource": "cpu"},
+             "issue": "CPU utilization at 95% of its limit"},
+        ],
+    }
+    charts = analysis_chart_series(
+        analysis_viz_data("metrics", metrics_result)
+    )
+    util = next(c for c in charts if c["title"].startswith("Utilization"))
+    assert [t["value"] for t in util["thresholds"]] == [80, 90]
+    ftable = next(c for c in charts if c["kind"] == "findings_table")
+    assert ftable["data"][0]["severity"] == "high"
+    assert ftable["data"][0]["component"] == "Pod/y"
+    assert ftable["data"][0]["icon"]  # severity color carrier
+
+    events_result = {
+        "findings": [],
+        "data": {
+            "reason_counts": {"BackOff": 12, "Unhealthy": 3},
+            "type_counts": {"Warning": 15},
+        },
+    }
+    charts = analysis_chart_series(
+        analysis_viz_data("events", events_result)
+    )
+    titles = {c["title"]: c for c in charts}
+    assert titles["Events by reason"]["data"] == {
+        "BackOff": 12, "Unhealthy": 3,
+    }
+    assert titles["Events by type"]["data"] == {"Warning": 15}
+
+    traces_result = {
+        "findings": [],
+        "data": {"latency": {"svc-a": {"p50": 10, "p95": 120, "p99": 300}}},
+    }
+    charts = analysis_chart_series(
+        analysis_viz_data("traces", traces_result)
+    )
+    lat = next(c for c in charts if "latency" in c["title"])
+    assert lat["data"] == {"svc-a": 120}
+
+
+def test_agents_emit_viz_data_payloads(five_svc_client):
+    """The events/traces agents attach the chart payloads the UI renders."""
+    from rca_tpu.agents import AnalysisContext, make_agents
+    from rca_tpu.cluster.fixtures import NS
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+
+    ctx = AnalysisContext(ClusterSnapshot.capture(five_svc_client, NS))
+    agents = make_agents()
+    ev = agents["events"].analyze(ctx).to_dict()
+    assert ev["data"]["reason_counts"]
+    assert sum(ev["data"]["type_counts"].values()) >= sum(
+        1 for _ in ctx.snapshot.events
+    )
+    tr = agents["traces"].analyze(ctx).to_dict()
+    assert "latency" in tr.get("data", {})
+    assert all(isinstance(v, dict) for v in tr["data"]["latency"].values())
+
+
 def test_correlated_markdown_groups():
     from rca_tpu.ui.render import correlated_markdown
 

@@ -209,8 +209,9 @@ def test_use_watch_false_forces_sweep_strategy():
 # -- live watch pumps (stub kubernetes module) -------------------------------
 
 class _Meta:
-    def __init__(self, name):
+    def __init__(self, name, rv=""):
         self.name = name
+        self.resource_version = rv
 
 
 class _Involved:
@@ -219,25 +220,35 @@ class _Involved:
 
 
 class _PodObj:
-    def __init__(self, name):
-        self.metadata = _Meta(name)
+    def __init__(self, name, rv="101"):
+        self.metadata = _Meta(name, rv)
 
 
 class _EventObj:
-    def __init__(self, involved):
-        self.metadata = _Meta("evt-x")
+    def __init__(self, involved, rv="201"):
+        self.metadata = _Meta("evt-x", rv)
         self.involved_object = _Involved(involved)
 
 
+class _BookmarkObj:
+    def __init__(self, rv):
+        self.metadata = _Meta("", rv)
+
+
 def _install_kubernetes_stub(monkeypatch, pod_events, event_events,
-                             die_after=False):
+                             die_after=False, seen_rvs=None):
     """Stub kubernetes.watch.Watch whose stream yields canned events once,
-    then (optionally) raises like a 410, else blocks briefly forever."""
+    then (optionally) raises like a 410, else blocks briefly forever.
+    Records the resource_version each stream call resumed from in
+    ``seen_rvs`` so tests can assert RV tracking (no-replay contract)."""
     mod = types.ModuleType("kubernetes")
     watch_mod = types.ModuleType("kubernetes.watch")
 
     class _Watch:
-        def stream(self, list_fn, namespace=None, timeout_seconds=None):
+        def stream(self, list_fn, namespace=None, timeout_seconds=None,
+                   resource_version=None, allow_watch_bookmarks=None):
+            if seen_rvs is not None:
+                seen_rvs.append(resource_version)
             batch = pod_events if "pod" in list_fn.__name__ else event_events
             yield from batch
             batch.clear()  # second stream round yields nothing
@@ -254,12 +265,21 @@ def _install_kubernetes_stub(monkeypatch, pod_events, event_events,
     monkeypatch.setitem(sys.modules, "kubernetes.watch", watch_mod)
 
 
+class _ListResp:
+    def __init__(self, rv):
+        self.metadata = _Meta("", rv)
+        self.items = []
+
+
 class _FakeCore:
+    """The initial limit=1 list returns the collection RV the pump must
+    pin its first stream to."""
+
     def list_namespaced_pod(self, *a, **k):
-        pass
+        return _ListResp("100")
 
     def list_namespaced_event(self, *a, **k):
-        pass
+        return _ListResp("200")
 
 
 def _wait_until(pred, timeout=5.0):
@@ -274,10 +294,10 @@ def _wait_until(pred, timeout=5.0):
 def test_watch_pumps_queue_changes(monkeypatch):
     _install_kubernetes_stub(
         monkeypatch,
-        pod_events=[{"object": _PodObj("db-0")},
-                    {"object": _PodObj("db-0")},
-                    {"object": _PodObj("web-1")}],
-        event_events=[{"object": _EventObj("db-0")}],
+        pod_events=[{"type": "ADDED", "object": _PodObj("db-0")},
+                    {"type": "MODIFIED", "object": _PodObj("db-0")},
+                    {"type": "ADDED", "object": _PodObj("web-1")}],
+        event_events=[{"type": "ADDED", "object": _EventObj("db-0")}],
     )
     from rca_tpu.cluster.watch_pump import WatchPumpSet
 
@@ -295,10 +315,40 @@ def test_watch_pumps_queue_changes(monkeypatch):
         pumps.stop()
 
 
+def test_watch_pump_tracks_resource_version(monkeypatch):
+    """The no-replay contract: the first stream resumes from the initial
+    list's collection RV, later streams from the last event/bookmark RV —
+    otherwise every 30 s renewal replays the whole collection and a 10k
+    namespace overflows the queue into a permanent resync loop."""
+    seen_rvs = []
+    _install_kubernetes_stub(
+        monkeypatch,
+        pod_events=[{"type": "MODIFIED", "object": _PodObj("db-0", rv="150")},
+                    {"type": "BOOKMARK", "object": _BookmarkObj("175")}],
+        event_events=[],
+        seen_rvs=seen_rvs,
+    )
+    from rca_tpu.cluster.watch_pump import WatchPumpSet
+
+    pumps = WatchPumpSet(_FakeCore(), "prod")
+    pumps.start()
+    try:
+        # both pumps opened (RVs 100/200 from the initial lists), then the
+        # pod pump renewed at the bookmark RV after draining its batch
+        assert _wait_until(lambda: "175" in seen_rvs)
+        assert "100" in seen_rvs and "200" in seen_rvs
+        # bookmark events advance RV but enqueue nothing
+        assert {(c["kind"], c["name"]) for c in pumps.drain()} == {
+            ("pod", "db-0"),
+        }
+    finally:
+        pumps.stop()
+
+
 def test_watch_pump_error_marks_expired(monkeypatch):
     _install_kubernetes_stub(
         monkeypatch,
-        pod_events=[{"object": _PodObj("p")}],
+        pod_events=[{"type": "ADDED", "object": _PodObj("p")}],
         event_events=[],
         die_after=True,
     )
