@@ -287,6 +287,59 @@ def test_agents_emit_viz_data_payloads(five_svc_client):
     assert all(isinstance(v, dict) for v in tr["data"]["latency"].values())
 
 
+def test_render_chart_dispatch_without_plotly(monkeypatch):
+    """The Streamlit chart dispatcher handles every spec kind with the
+    plotly-free fallbacks: threshold bars degrade to caption+bar, findings
+    tables carry the icon column.  The ImportError path is FORCED (a None
+    sys.modules entry makes `import plotly...` raise) so the assertions
+    don't flip on machines where plotly happens to be installed."""
+    import sys
+
+    monkeypatch.setitem(sys.modules, "plotly", None)
+    monkeypatch.setitem(sys.modules, "plotly.graph_objects", None)
+
+    from rca_tpu.ui.app import _render_chart
+
+    class FakeSt:
+        def __init__(self):
+            self.calls = []
+
+        def bar_chart(self, data):
+            self.calls.append(("bar_chart", data))
+
+        def dataframe(self, data, **kw):
+            self.calls.append(("dataframe", data))
+
+        def caption(self, text):
+            self.calls.append(("caption", text))
+
+    st = FakeSt()
+    _render_chart(st, {
+        "kind": "bar", "title": "Utilization",
+        "data": {"Pod/y (cpu)": 95.0},
+        "thresholds": [{"value": 80, "label": "warn (80%)"},
+                       {"value": 90, "label": "critical (90%)"}],
+    })
+    kinds = [c[0] for c in st.calls]
+    assert "bar_chart" in kinds
+    # thresholds surfaced even without plotly
+    assert any("warn (80%)" in str(c[1]) for c in st.calls
+               if c[0] == "caption")
+
+    st = FakeSt()
+    _render_chart(st, {
+        "kind": "findings_table", "title": "Findings",
+        "data": [{"icon": "🔴", "severity": "critical",
+                  "component": "Pod/x", "issue": "boom"}],
+    })
+    rows = next(c[1] for c in st.calls if c[0] == "dataframe")
+    assert rows[0][""] == "🔴" and rows[0]["component"] == "Pod/x"
+
+    st = FakeSt()
+    _render_chart(st, {"kind": "table", "title": "t", "data": [{"a": 1}]})
+    assert st.calls[0][0] == "dataframe"
+
+
 def test_correlated_markdown_groups():
     from rca_tpu.ui.render import correlated_markdown
 

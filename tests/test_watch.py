@@ -362,6 +362,49 @@ def test_watch_pump_error_marks_expired(monkeypatch):
         pumps.stop()
 
 
+def test_k8s_client_watch_changes_lifecycle(monkeypatch):
+    """Client-level feed contract over the stubbed SDK: open → drain →
+    namespace isolation → expiry surfaces as expired=True with a fresh
+    token to reopen against."""
+    _install_kubernetes_stub(
+        monkeypatch,
+        pod_events=[{"type": "MODIFIED", "object": _PodObj("db-0")}],
+        event_events=[],
+    )
+    import rca_tpu.cluster.k8s_client as kc
+    from rca_tpu.cluster.k8s_client import K8sApiClient
+
+    monkeypatch.setattr(kc, "HAVE_K8S_LIB", True)
+    client = K8sApiClient.__new__(K8sApiClient)  # skip kubeconfig loading
+    client._connected = True
+    client._core = _FakeCore()
+    client._errors = []
+    client._kubectl = None
+    client._kubeconfig = None
+
+    try:
+        head = client.watch_changes("prod", None)
+        assert head["supported"] and not head["expired"]
+        assert _wait_until(
+            lambda: client.watch_changes("prod", head["cursor"])["changes"]
+            or client._pumps["prod"].expired
+        )
+        # a second namespace opens its own pump set without touching prod's
+        other = client.watch_changes("staging", None)
+        assert other["cursor"] != head["cursor"]
+        assert set(client._pumps) == {"prod", "staging"}
+        again = client.watch_changes("prod", head["cursor"])
+        assert not again["expired"]
+
+        # stale/foreign cursor -> expired with the current token to reopen
+        stale = client.watch_changes("prod", "pumps-does-not-exist")
+        assert stale["expired"] is True
+        assert stale["cursor"] == head["cursor"]
+    finally:
+        for pumps in getattr(client, "_pumps", {}).values():
+            pumps.stop()
+
+
 def test_pump_queue_overflow_expires():
     from rca_tpu.cluster import watch_pump
     from rca_tpu.cluster.watch_pump import WatchPumpSet
