@@ -49,6 +49,18 @@ F_EVENTS = int(SvcF.EVENTS)
 F_LOG_ERRORS = int(SvcF.LOG_ERRORS)
 F_NOT_READY = int(SvcF.NOT_READY)
 F_RESOURCE = int(SvcF.RESOURCE)
+F_IMAGE = int(SvcF.IMAGE)
+F_CONFIG = int(SvcF.CONFIG)
+F_PENDING = int(SvcF.PENDING)
+F_OOM = int(SvcF.OOM)
+
+# Root fault archetypes (fault_mix="mixed"): what KIND of fault the root
+# has, mirroring the reference's injected fault classes
+# (reference: setup_test_cluster.py — crash loop :209, missing env/config
+# :256, memory :303; plus image-pull and unschedulable, the other pod
+# states its resource analyzer buckets, agents/resource_analyzer.py:275).
+# The default "crash" keeps every pre-existing seed's cascade byte-stable.
+ROOT_ARCHETYPES = ("crash", "oom", "image", "config", "pending")
 
 
 @dataclasses.dataclass
@@ -65,10 +77,12 @@ class CascadeArrays:
     anomaly: np.ndarray  # float32 [n] scalar anomaly per service
     names: Optional[List[str]] = None
     # diagnosis metadata (autopsy tooling, not consumed by the engine):
-    # decoy service indices (correlated modes) and hop distance from the
-    # nearest root along dependent edges (INT32_MAX = unaffected)
+    # decoy service indices (correlated modes), hop distance from the
+    # nearest root along dependent edges (INT32_MAX = unaffected), and
+    # each root's fault archetype (parallel to ``roots``)
     decoys: Optional[np.ndarray] = None
     hops: Optional[np.ndarray] = None
+    root_kinds: Optional[List[str]] = None
 
 
 def _build_dag(n: int, rng: np.random.Generator, max_deps: int = 3):
@@ -135,6 +149,7 @@ def synthetic_cascade_arrays(
     mode: str = "standard",
     max_deps: int = 3,
     dropout_keep: float = 0.65,
+    fault_mix: str = "crash",
 ) -> CascadeArrays:
     """Generate the raw-array cascade (any scale; used for bench + training).
 
@@ -165,6 +180,19 @@ def synthetic_cascade_arrays(
     fan-out, per-channel observation probability in the dropout modes) —
     exposed so training can domain-randomize over them instead of
     overfitting one fixed world (VERDICT r2 item 4).
+
+    ``fault_mix`` selects the roots' fault ARCHETYPE (round 3: a
+    crash-only generator let fitted weights zero the image/config/
+    pending/oom channels the real rule agents depend on):
+
+    - ``"crash"`` (default) — every root crash-loops; byte-stable with
+      every pre-existing seed;
+    - ``"mixed"`` — each root draws an archetype from
+      :data:`ROOT_ARCHETYPES` (crash / oom / image / config / pending),
+      with archetype-appropriate channels (an image-pull root produces NO
+      logs and NO crashes — the container never started);
+    - one archetype name — every root has that fault (the shippability
+      gate uses this to verify each channel family individually).
     """
     if mode not in CASCADE_MODES:
         raise ValueError(f"unknown cascade mode {mode!r}; one of {CASCADE_MODES}")
@@ -229,17 +257,76 @@ def synthetic_cascade_arrays(
     aff_decay = (decay ** hops[aff_idx]).astype(np.float32)
 
     crashing_victims = mode in ("crashing_victims", "adversarial")
-    if crashing_victims:
-        # roots crash over a wider, weaker range (flaky rather than dead) …
-        feats[roots, F_CRASH] = rng.uniform(0.55, 0.95, size=len(roots))
-        feats[roots, F_RESTARTS] = rng.uniform(0.5, 0.9, size=len(roots))
+    if fault_mix == "crash":
+        # byte-stable legacy path: identical rng draw sequence to the
+        # pre-archetype generator, so every published seed/band reproduces
+        if crashing_victims:
+            # roots crash over a wider, weaker range (flaky rather than dead)
+            feats[roots, F_CRASH] = rng.uniform(0.55, 0.95, size=len(roots))
+            feats[roots, F_RESTARTS] = rng.uniform(0.5, 0.9, size=len(roots))
+        else:
+            feats[roots, F_CRASH] = rng.uniform(0.85, 1.0, size=len(roots))
+            feats[roots, F_RESTARTS] = rng.uniform(0.7, 1.0, size=len(roots))
+        feats[roots, F_EVENTS] = rng.uniform(0.6, 1.0, size=len(roots))
+        feats[roots, F_LOG_ERRORS] = rng.uniform(0.7, 1.0, size=len(roots))
+        feats[roots, F_NOT_READY] = rng.uniform(0.8, 1.0, size=len(roots))
+        feats[roots, F_ERROR_RATE] = rng.uniform(0.5, 1.0, size=len(roots))
+        root_kinds = ["crash"] * len(roots)
     else:
-        feats[roots, F_CRASH] = rng.uniform(0.85, 1.0, size=len(roots))
-        feats[roots, F_RESTARTS] = rng.uniform(0.7, 1.0, size=len(roots))
-    feats[roots, F_EVENTS] = rng.uniform(0.6, 1.0, size=len(roots))
-    feats[roots, F_LOG_ERRORS] = rng.uniform(0.7, 1.0, size=len(roots))
-    feats[roots, F_NOT_READY] = rng.uniform(0.8, 1.0, size=len(roots))
-    feats[roots, F_ERROR_RATE] = rng.uniform(0.5, 1.0, size=len(roots))
+        if fault_mix == "mixed":
+            root_kinds = [
+                ROOT_ARCHETYPES[k]
+                for k in rng.integers(0, len(ROOT_ARCHETYPES), len(roots))
+            ]
+        elif fault_mix in ROOT_ARCHETYPES:
+            root_kinds = [fault_mix] * len(roots)
+        else:
+            raise ValueError(
+                f"unknown fault_mix {fault_mix!r}; one of "
+                f"('crash', 'mixed', *{ROOT_ARCHETYPES})"
+            )
+        for j, r in enumerate(roots.tolist()):
+            kind = root_kinds[j]
+            # common: the root is down/unready, K8s surfaces warning
+            # events, callers see errors
+            feats[r, F_EVENTS] = rng.uniform(0.6, 1.0)
+            feats[r, F_NOT_READY] = rng.uniform(0.8, 1.0)
+            feats[r, F_ERROR_RATE] = rng.uniform(0.5, 1.0)
+            if kind == "crash":
+                # ranges mirror the legacy crash path exactly (both
+                # channels), so one archetype never has two different
+                # evidence distributions between train (mixed) and eval
+                # (crash) data
+                if crashing_victims:
+                    feats[r, F_CRASH] = rng.uniform(0.55, 0.95)
+                    feats[r, F_RESTARTS] = rng.uniform(0.5, 0.9)
+                else:
+                    feats[r, F_CRASH] = rng.uniform(0.85, 1.0)
+                    feats[r, F_RESTARTS] = rng.uniform(0.7, 1.0)
+                feats[r, F_LOG_ERRORS] = rng.uniform(0.7, 1.0)
+            elif kind == "oom":
+                # memory at limit, kernel kills → restart loop with a
+                # strong OOM channel and saturated resource pressure
+                feats[r, F_OOM] = rng.uniform(0.8, 1.0)
+                feats[r, F_CRASH] = rng.uniform(0.4, 0.8)
+                feats[r, F_RESTARTS] = rng.uniform(0.5, 0.9)
+                feats[r, F_RESOURCE] = rng.uniform(0.8, 1.0)
+                feats[r, F_LOG_ERRORS] = rng.uniform(0.3, 0.8)
+            elif kind == "image":
+                # the container NEVER starts: no logs, no crashes — the
+                # only signals are the waiting reason and events
+                feats[r, F_IMAGE] = rng.uniform(0.85, 1.0)
+                feats[r, F_LOG_ERRORS] = 0.0
+            elif kind == "config":
+                # missing ConfigMap/Secret/env: config-error waiting state,
+                # possibly a few crash-exits when the app starts then dies
+                feats[r, F_CONFIG] = rng.uniform(0.85, 1.0)
+                feats[r, F_CRASH] = rng.uniform(0.3, 0.7)
+                feats[r, F_LOG_ERRORS] = rng.uniform(0.2, 0.7)
+            else:  # pending
+                # unschedulable: never placed, no container, no logs
+                feats[r, F_PENDING] = rng.uniform(0.8, 1.0)
+                feats[r, F_LOG_ERRORS] = 0.0
 
     # Dependents: soft degradation decaying with hop distance.  In standard
     # mode victims carry NO crash signal (they are victims, not causes);
@@ -300,6 +387,10 @@ def synthetic_cascade_arrays(
         names=names,
         decoys=None if decoys is None else np.sort(decoys).astype(np.int32),
         hops=hops.astype(np.int64),
+        # roots are returned sorted; reorder the parallel kinds list the
+        # same way (fault assignment iterated the UNSORTED draw order,
+        # which legacy-seed byte-stability forbids changing)
+        root_kinds=[root_kinds[j] for j in np.argsort(roots)],
     )
 
 

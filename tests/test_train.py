@@ -92,6 +92,31 @@ def test_shippability_gate():
     assert not bad["sanity"]["hard_crash_ok"]
 
 
+def test_shippability_gate_rejects_channel_zeroing():
+    """A fit that zeroes the image/config/pending/oom channels (what
+    crash-only training actually produced in round 3) is sane by the
+    scalar checks and competitive on crash cascades — the per-archetype
+    fixture check is what catches it."""
+    import dataclasses
+
+    from rca_tpu.features.schema import SvcF
+
+    p = default_params()
+    aw, hw = list(p.anomaly_weights), list(p.hard_weights)
+    for ch in (SvcF.IMAGE, SvcF.CONFIG, SvcF.PENDING, SvcF.OOM):
+        aw[ch] = 0.02
+        hw[ch] = 0.02
+    zeroed = dataclasses.replace(
+        p, anomaly_weights=tuple(aw), hard_weights=tuple(hw)
+    )
+    report = shippability_report(zeroed, trials_per_setting=2)
+    assert not report["fixtures"]["archetypes_ok"], report["fixtures"]
+    assert not report["ships"]
+    # and the sanity checks alone would NOT have caught it
+    assert report["sanity"]["decay_ok"]
+    assert report["sanity"]["hard_crash_ok"]
+
+
 def test_training_reduces_loss_and_keeps_accuracy(trained):
     params, history = trained
     assert history[-1] < history[0] * 0.9, history[:3] + history[-3:]
