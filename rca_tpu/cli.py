@@ -28,7 +28,8 @@ import sys
 from typing import Optional
 
 
-def _make_client(fixture: Optional[str], seed: int = 0):
+def _make_client(fixture: Optional[str], seed: int = 0,
+                 fault_mix: str = "crash"):
     from rca_tpu.cluster.mock_client import MockClusterClient
 
     if fixture in (None, "", "live"):
@@ -43,7 +44,9 @@ def _make_client(fixture: Optional[str], seed: int = 0):
     if m:
         from rca_tpu.cluster.generator import synthetic_cascade_world
 
-        world = synthetic_cascade_world(int(m.group(1)), n_roots=1, seed=seed)
+        world = synthetic_cascade_world(
+            int(m.group(1)), n_roots=1, seed=seed, fault_mix=fault_mix,
+        )
         return MockClusterClient(world), "synthetic"
     raise SystemExit(f"unknown fixture: {fixture!r} (want 5svc, <N>svc, live)")
 
@@ -54,7 +57,8 @@ def _coordinator(args):
     from rca_tpu.obslog import get_logger
 
     client, ns = _make_client(getattr(args, "fixture", None),
-                              getattr(args, "seed", 0))
+                              getattr(args, "seed", 0),
+                              getattr(args, "fault_mix", "crash"))
     namespace = getattr(args, "namespace", None) or ns or "default"
     prompt_logger = get_logger(getattr(args, "log_dir", "logs") + "/prompts")
     llm = LLMClient(
@@ -236,7 +240,8 @@ def cmd_stream(args) -> int:
 
     from rca_tpu.engine import LiveStreamingSession
 
-    client, ns = _make_client(args.fixture, args.seed)
+    client, ns = _make_client(args.fixture, args.seed,
+                              getattr(args, 'fault_mix', 'crash'))
     namespace = args.namespace or ns or "default"
     live = LiveStreamingSession(client, namespace, k=args.top)
     for i in range(args.ticks):
@@ -301,6 +306,9 @@ def build_parser() -> argparse.ArgumentParser:
     def common(sp):
         sp.add_argument("--fixture", default=None,
                         help="5svc | <N>svc | live (default: live)")
+        sp.add_argument("--fault-mix", default="crash", dest="fault_mix",
+                        help="synthetic fixtures' root fault archetypes: "
+                        "crash | mixed | oom | image | config | pending")
         sp.add_argument("--namespace", default=None)
         sp.add_argument("--backend", default=None,
                         help="jax | deterministic | llm (default: $RCA_BACKEND or jax)")
@@ -355,6 +363,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--fixture", default=None,
                     help="5svc | <N>svc | live (default: live)")
+    sp.add_argument("--fault-mix", default="crash", dest="fault_mix",
+                    help="synthetic fixtures' root fault archetypes")
     sp.add_argument("--namespace", default=None)
     sp.add_argument("--seed", type=int, default=0)
     sp.add_argument("--ticks", type=int, default=5)

@@ -394,6 +394,60 @@ def synthetic_cascade_arrays(
     )
 
 
+def _faulty_pod_parts(kind: str, svc: str, rng: np.random.Generator):
+    """Per-archetype pod state for the dict-world form: container status,
+    pod phase, event (reason, message), log text (None = container never
+    produced logs), and metrics (cpu_m, mem_mib) — each chosen so the
+    feature extractor's reason/phase/termination matching lights the
+    archetype's DEFINING channels (features/extract.py:36-108).  The
+    secondary-channel mix is the plausible K8s realization, not a replica
+    of the raw-array generator's exact per-channel ranges — an OOM-killed
+    pod really does carry a CrashLoopBackOff waiting reason, and a
+    config-error pod really produces no logs."""
+    if kind == "oom":
+        status = waiting_status(
+            svc, "CrashLoopBackOff", "Back-off restarting failed container",
+            restarts=int(rng.integers(3, 10)),
+            last_exit_code=137, last_reason="OOMKilled",
+        )
+        return (status, "Running",
+                ("OOMKilling",
+                 f"Memory cgroup out of memory: Killed process ({svc})"),
+                "INFO: allocating buffers\n"
+                "ERROR: Out of memory: killed by cgroup limit\n",
+                (30, 127))
+    if kind == "image":
+        status = waiting_status(
+            svc, "ImagePullBackOff",
+            f'Back-off pulling image "{svc}:latest"',
+        )
+        return (status, "Pending",
+                ("Failed", f'Failed to pull image "{svc}:latest": not found'),
+                None, (0, 0))
+    if kind == "config":
+        status = waiting_status(
+            svc, "CreateContainerConfigError",
+            f'configmap "{svc}-config" not found',
+        )
+        return (status, "Pending",
+                ("FailedCreate", f'configmap "{svc}-config" not found'),
+                None, (0, 0))
+    if kind == "pending":
+        return (None, "Pending",
+                ("FailedScheduling",
+                 "0/3 nodes are available: 3 Insufficient memory."),
+                None, (0, 0))
+    # crash (default)
+    status = waiting_status(
+        svc, "CrashLoopBackOff", "Back-off restarting failed container",
+        restarts=int(rng.integers(4, 12)), last_exit_code=1,
+    )
+    return (status, "Running",
+            ("BackOff", f"Back-off restarting failed container {svc}"),
+            "ERROR: fatal error during startup\n"
+            "Exception in thread main\nERROR: exiting\n", (5, 20))
+
+
 def synthetic_cascade_world(
     n_services: int,
     n_roots: int = 1,
@@ -401,13 +455,20 @@ def synthetic_cascade_world(
     namespace: str = "synthetic",
     pods_per_service: int = 1,
     mode: str = "standard",
+    fault_mix: str = "crash",
 ) -> World:
     """Generate a full dict-world cascade (drives the agent/coordinator layer).
 
     Suitable up to a few thousand services; the raw-array form above covers
-    10k-50k scale without dict materialization.
+    10k-50k scale without dict materialization.  ``fault_mix`` selects the
+    roots' fault archetypes exactly as in :func:`synthetic_cascade_arrays`
+    — the dict world realizes each archetype as the K8s states the feature
+    extractor and rule agents classify (ImagePullBackOff waiting status,
+    OOMKilled termination, FailedScheduling events, ...).
     """
-    case = synthetic_cascade_arrays(n_services, n_roots, seed, mode=mode)
+    case = synthetic_cascade_arrays(
+        n_services, n_roots, seed, mode=mode, fault_mix=fault_mix,
+    )
     rng = np.random.default_rng(seed + 1)
     names = [f"svc-{i:05d}" for i in range(n_services)]
 
@@ -423,6 +484,7 @@ def synthetic_cascade_world(
     }
 
     root_set = set(case.roots.tolist())
+    kind_of = dict(zip(case.roots.tolist(), case.root_kinds or []))
     hops = _bfs_hops(
         n_services, _dependents_adj(n_services, case.dep_src, case.dep_dst), case.roots
     )
@@ -450,33 +512,26 @@ def synthetic_cascade_world(
             pod_name = f"{svc}-{r}"
             pod_names.append(pod_name)
             if faulty:
+                status, phase, (ev_reason, ev_msg), log_text, (cpu_m, mem_mib) = (
+                    _faulty_pod_parts(kind_of.get(i, "crash"), svc, rng)
+                )
                 pod = make_pod(
                     pod_name,
                     namespace,
                     svc,
-                    container_statuses=[
-                        waiting_status(
-                            svc,
-                            "CrashLoopBackOff",
-                            "Back-off restarting failed container",
-                            restarts=int(rng.integers(4, 12)),
-                            last_exit_code=1,
-                        )
-                    ],
+                    phase=phase,
+                    container_statuses=[status] if status is not None else [],
                 )
-                w.logs[namespace][pod_name] = {
-                    svc: "ERROR: fatal error during startup\n"
-                    "Exception in thread main\nERROR: exiting\n"
-                }
+                if log_text is not None:
+                    w.logs[namespace][pod_name] = {svc: log_text}
                 events.append(
                     make_event(
-                        namespace, "Pod", pod_name, "BackOff",
-                        f"Back-off restarting failed container {svc}",
+                        namespace, "Pod", pod_name, ev_reason, ev_msg,
                         count=int(rng.integers(5, 25)),
                     )
                 )
                 w.pod_metrics[namespace]["pods"][pod_name] = pod_metric(
-                    5, 20, 200, 128, svc
+                    cpu_m, mem_mib, 200, 128, svc
                 )
             else:
                 pod = make_pod(pod_name, namespace, svc)
@@ -548,8 +603,10 @@ def synthetic_cascade_world(
     w.ground_truth = {
         "namespace": namespace,
         "fault_roots": [names[r] for r in case.roots.tolist()],
+        "fault_kinds": list(case.root_kinds or []),
         "n_services": n_services,
         "seed": seed,
         "mode": mode,
+        "fault_mix": fault_mix,
     }
     return w

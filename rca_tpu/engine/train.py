@@ -368,8 +368,13 @@ def shippability_report(
             }
             for ch in chans
         }
+        # compare RAW floats: the report's 3-decimal rounding would pass a
+        # 0.4996 weight as 0.5 — the exact epsilon-under-the-floor failure
+        # this check exists to catch
         channels_ok = all(
-            v["a"] >= 0.5 and v["h"] >= 0.4 for v in channel_floor.values()
+            float(p.anomaly_weights[ch]) >= 0.5
+            and float(p.hard_weights[ch]) >= 0.4
+            for ch in chans
         )
         return {
             "five_svc_top2": sorted(five),
