@@ -301,12 +301,13 @@ def main(skip_accuracy: bool = False) -> int:
         ))
         trained_engine = GraphEngine(params=trained_params)
 
-        def mode_hits(mode, trials=15, n=500):
+        def mode_hits(mode, trials=15, n=500, fault_mix="crash"):
             n_roots = 3 if mode == "overlapping_roots" else 1
             counts = {"engine": [0, 0], "trained": [0, 0], "naive": [0, 0]}
             for seed in range(trials):
                 c = synthetic_cascade_arrays(
-                    n, n_roots=n_roots, seed=1000 + seed, mode=mode
+                    n, n_roots=n_roots, seed=1000 + seed, mode=mode,
+                    fault_mix=fault_mix,
                 )
                 roots = set(c.roots.tolist())
                 for key, scores in (
@@ -330,6 +331,11 @@ def main(skip_accuracy: bool = False) -> int:
                          "correlated_noise", "overlapping_roots",
                          "adversarial")
         }
+        # round-3 fault archetypes: the hardest mode over mixed root-fault
+        # kinds (oom/image/config/pending roots alongside crash ones)
+        accuracy["adversarial_mixed_faults"] = mode_hits(
+            "adversarial", fault_mix="mixed"
+        )
 
     def r(x, nd=4):
         """Round, passing through None (= honestly unmeasured)."""

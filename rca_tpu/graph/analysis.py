@@ -16,6 +16,7 @@ All take COO edge arrays (src depends-on dst) over n nodes.
 from __future__ import annotations
 
 from collections import deque
+from functools import lru_cache as _lru_cache
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -127,6 +128,88 @@ def isolated_nodes(n: int, src: np.ndarray, dst: np.ndarray) -> np.ndarray:
     return np.nonzero(deg == 0)[0]
 
 
+@_lru_cache(maxsize=8)
+def _bc_kernel(n: int):
+    """jit-compiled ALL-SOURCES Brandes for an [n, n] dense adjacency.
+
+    The per-source Python BFS + accumulation is the topology agent's host
+    hot spot (~1.7 s at 2k services).  Unweighted Brandes is
+    level-synchronous, so every source advances one BFS level per step —
+    which makes each step two [n, n] matmuls that XLA tiles onto the MXU:
+
+    forward (σ = shortest-path counts, one row per source):
+        paths    = (σ ⊙ frontier) @ A        # arrivals via current level
+        newly    = (paths > 0) ∧ (dist < 0)
+        σ       += paths ⊙ newly ;  dist[newly] = level+1
+    backward (δ = dependency accumulation, levels descending):
+        X        = [dist = d] ⊙ (1 + δ) / σ
+        δ       += σ ⊙ (X @ Aᵀ) ⊙ [dist = d-1]
+    bc[v] = Σ_s δ[s, v] (v ≠ s)
+
+    An edge (u, v) with dist_u = d-1, dist_v = d is exactly a Brandes
+    predecessor pair under BFS, so the masked matmul reproduces the exact
+    algorithm (parity vs the Python loop: max |Δ| ≈ 1e-7 at 2k).  Runs in
+    fp32: the kernel also returns a finiteness flag — path COUNTS can
+    overflow fp32 on extremely path-dense graphs, and the caller falls
+    back to the float64 Python implementation then.  Measured at 2k
+    services: 1.7 s host Brandes → 0.74 s end-to-end through the tunneled
+    chip (the [n,n] upload + RTT dominates; device compute is tens of ms,
+    so a host-attached chip sees the full ~20x)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(A):
+        eye = jnp.eye(n, dtype=jnp.float32)
+
+        def fwd_cond(state):
+            frontier, _, _, _ = state
+            return frontier.sum() > 0
+
+        def fwd_body(state):
+            frontier, sigma, dist, level = state
+            paths = (sigma * frontier) @ A
+            newly = (paths > 0) & (dist < 0)
+            sigma = sigma + jnp.where(newly, paths, 0.0)
+            dist = jnp.where(newly, level + 1, dist)
+            return newly.astype(jnp.float32), sigma, dist, level + 1
+
+        dist0 = jnp.where(eye > 0, 0, -1).astype(jnp.int32)
+        _, sigma, dist, levels = jax.lax.while_loop(
+            fwd_cond, fwd_body, (eye, eye, dist0, jnp.int32(0))
+        )
+
+        def bwd_cond(state):
+            _, d = state
+            return d > 0
+
+        def bwd_body(state):
+            delta, d = state
+            mask_v = (dist == d).astype(jnp.float32)
+            x = mask_v * (1.0 + delta) / jnp.maximum(sigma, 1.0)
+            contrib = x @ A.T
+            delta = delta + sigma * contrib * (dist == d - 1)
+            return delta, d - 1
+
+        delta, _ = jax.lax.while_loop(
+            bwd_cond, bwd_body, (jnp.zeros_like(A), levels)
+        )
+        bc = (delta * (1.0 - eye)).sum(axis=0)
+        finite = jnp.isfinite(sigma).all() & jnp.isfinite(delta).all()
+        return bc, finite
+
+    return jax.jit(fn)
+
+
+# device path pays a per-size jit compile plus one [n,n] upload per call;
+# through the tunneled chip (~100 ms RTT) the measured crossover vs the
+# Python loop sits near ~1.3k nodes — the floor matches it.  The ceiling
+# bounds the dense [n,n] materialization (several same-shape device
+# buffers): callers that disable the degree-approximation gate
+# (max_nodes=None) keep the O(V+E)-memory Python loop beyond it
+_BC_DEVICE_MIN_NODES = 1280
+_BC_DEVICE_MAX_NODES = 4096
+
+
 def betweenness_centrality(
     n: int,
     src: np.ndarray,
@@ -136,7 +219,10 @@ def betweenness_centrality(
 ) -> np.ndarray:
     """Exact Brandes betweenness (directed). Gated by ``max_nodes`` — beyond
     it the SPOF analysis falls back to degree centrality (documented
-    approximation for 10k+ graphs)."""
+    approximation for 10k+ graphs).  Mid-size graphs
+    (``_BC_DEVICE_MIN_NODES``..max_nodes) run the all-sources matmul
+    formulation on the accelerator (:func:`_bc_kernel`); smaller graphs
+    and fp32-overflow cases use the float64 Python loop."""
     bc = np.zeros(n, dtype=np.float64)
     if n == 0 or len(src) == 0:
         return bc
@@ -145,6 +231,26 @@ def betweenness_centrality(
         np.add.at(deg, src, 1.0)
         np.add.at(deg, dst, 1.0)
         return deg / max(1.0, deg.max())
+    if _BC_DEVICE_MIN_NODES <= n <= _BC_DEVICE_MAX_NODES:
+        A = np.zeros((n, n), dtype=np.float32)
+        A[np.asarray(src), np.asarray(dst)] = 1.0
+        bc_dev, finite = _bc_kernel(n)(A)
+        if bool(finite):
+            bc = np.asarray(bc_dev, dtype=np.float64)
+            if normalized and n > 2:
+                bc /= (n - 1) * (n - 2)
+            return bc
+        # fp32 path counts overflowed: fall through to the float64 loop
+    return _betweenness_python(n, src, dst, normalized)
+
+
+def _betweenness_python(
+    n: int,
+    src: np.ndarray,
+    dst: np.ndarray,
+    normalized: bool = True,
+) -> np.ndarray:
+    bc = np.zeros(n, dtype=np.float64)
     adj = _adjacency(n, src, dst)
     for s in range(n):
         if not adj[s]:
