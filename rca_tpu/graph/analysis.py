@@ -158,7 +158,13 @@ def _bc_kernel(n: int):
     import jax
     import jax.numpy as jnp
 
-    def fn(A):
+    def fn(src, dst, mask):
+        # adjacency is scattered ON DEVICE from the COO arrays: uploading
+        # the dense [n,n] matrix instead cost 16 MB per call at 2k nodes —
+        # through the ~100 ms-RTT tunnel that upload dominated the whole
+        # kernel.  Padded edge slots carry mask 0 (a max-scatter of 0 is a
+        # no-op), real duplicates collapse to 1.
+        A = jnp.zeros((n, n), dtype=jnp.float32).at[src, dst].max(mask)
         eye = jnp.eye(n, dtype=jnp.float32)
 
         def fwd_cond(state):
@@ -220,9 +226,11 @@ def betweenness_centrality(
     """Exact Brandes betweenness (directed). Gated by ``max_nodes`` — beyond
     it the SPOF analysis falls back to degree centrality (documented
     approximation for 10k+ graphs).  Mid-size graphs
-    (``_BC_DEVICE_MIN_NODES``..max_nodes) run the all-sources matmul
-    formulation on the accelerator (:func:`_bc_kernel`); smaller graphs
-    and fp32-overflow cases use the float64 Python loop."""
+    (``_BC_DEVICE_MIN_NODES``..``_BC_DEVICE_MAX_NODES`` — a ceiling
+    independent of ``max_nodes``) run the all-sources matmul formulation
+    on the accelerator (:func:`_bc_kernel`); smaller graphs, larger
+    graphs under ``max_nodes=None``, and fp32-overflow cases use the
+    float64 Python loop."""
     bc = np.zeros(n, dtype=np.float64)
     if n == 0 or len(src) == 0:
         return bc
@@ -232,11 +240,24 @@ def betweenness_centrality(
         np.add.at(deg, dst, 1.0)
         return deg / max(1.0, deg.max())
     if _BC_DEVICE_MIN_NODES <= n <= _BC_DEVICE_MAX_NODES:
-        A = np.zeros((n, n), dtype=np.float32)
-        A[np.asarray(src), np.asarray(dst)] = 1.0
-        bc_dev, finite = _bc_kernel(n)(A)
+        # BOTH axes are tiered so jit compiles once per (node-tier,
+        # edge-tier), not per exact size — a live cluster's service count
+        # drifts across analyses and per-n recompiles would cost more
+        # than the Python loop.  Padding nodes are isolated (no edges):
+        # unreachable from every real source, bc 0, on no real shortest
+        # path; the result slices back to n
+        e = len(src)
+        e_pad = 1 << max(int(np.ceil(np.log2(max(e, 1)))), 0)
+        n_pad = -(-n // 256) * 256
+        src_p = np.zeros(e_pad, np.int32)
+        dst_p = np.zeros(e_pad, np.int32)
+        mask_p = np.zeros(e_pad, np.float32)
+        src_p[:e] = src
+        dst_p[:e] = dst
+        mask_p[:e] = 1.0
+        bc_dev, finite = _bc_kernel(n_pad)(src_p, dst_p, mask_p)
         if bool(finite):
-            bc = np.asarray(bc_dev, dtype=np.float64)
+            bc = np.asarray(bc_dev, dtype=np.float64)[:n]
             if normalized and n > 2:
                 bc /= (n - 1) * (n - 2)
             return bc
