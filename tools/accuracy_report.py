@@ -88,13 +88,15 @@ def autopsy_mode(
     n: int = 500,
     params: PropagationParams | None = None,
     k: int = 5,
+    fault_mix: str = "crash",
 ) -> dict:
     engine = GraphEngine(params=params)
     n_roots = 3 if mode == "overlapping_roots" else 1
     misses = []
     hits1 = hits3 = 0
     for seed in seeds:
-        case = synthetic_cascade_arrays(n, n_roots=n_roots, seed=seed, mode=mode)
+        case = synthetic_cascade_arrays(n, n_roots=n_roots, seed=seed,
+                                        mode=mode, fault_mix=fault_mix)
         res = engine.analyze_case(case, k=k)
         roots = set(case.roots.tolist())
         order = np.argsort(-res.score)
@@ -141,6 +143,7 @@ def autopsy_mode(
     taxonomy = collections.Counter(m["failure_mode"] for m in misses)
     return {
         "mode": mode,
+        "fault_mix": fault_mix,
         "n_services": n,
         "seeds": f"{seeds.start}:{seeds.stop}",
         "trials": trials,
@@ -158,6 +161,9 @@ def main(argv=None) -> int:
     ap.add_argument("--seeds", default="1000:1015",
                     help="start:stop seed band (bench uses 1000:1015)")
     ap.add_argument("--n", type=int, default=500)
+    ap.add_argument("--fault-mix", default="crash", dest="fault_mix",
+                    help="root fault archetypes: crash | mixed | oom | "
+                    "image | config | pending")
     ap.add_argument("--json", help="write the full report to this path")
     ap.add_argument("--weights", help="orbax checkpoint dir (RCA_WEIGHTS)")
     args = ap.parse_args(argv)
@@ -173,7 +179,11 @@ def main(argv=None) -> int:
         params = load_params(args.weights)
 
     modes = CASCADE_MODES if args.mode == "all" else (args.mode,)
-    reports = [autopsy_mode(m, seeds, n=args.n, params=params) for m in modes]
+    reports = [
+        autopsy_mode(m, seeds, n=args.n, params=params,
+                     fault_mix=args.fault_mix)
+        for m in modes
+    ]
 
     for rep in reports:
         print(
