@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import os
 import re
-from typing import Any, Dict, List, Optional
+from typing import AbstractSet, Any, Dict, List, Optional
 
 from rca_tpu.agents.base import AnalysisContext
 from rca_tpu.findings import max_severity, severity_rank
@@ -36,9 +36,13 @@ def default_backend() -> str:
     return os.environ.get("RCA_BACKEND", "jax").lower()
 
 
-def _component_service(component: str, service_names: List[str]) -> Optional[str]:
+def _component_service(
+    component: str, service_names: AbstractSet[str]
+) -> Optional[str]:
     """Map 'Pod/frontend-7d8f675c7b-jk2x5' / 'Deployment/frontend' /
-    'Service/frontend' onto a service name."""
+    'Service/frontend' onto a service name.  Pass a SET — with a list the
+    membership probes make the grouping O(findings × services), which
+    measured 2.6 s of a 3.1 s correlate at 10k services."""
     if "/" not in component:
         return component if component in service_names else None
     kind, name = component.split("/", 1)
@@ -137,8 +141,9 @@ def correlate_jax(
     groups = group_findings(agent_results)
     by_service: Dict[str, List[dict]] = {}
     unmapped: Dict[str, List[dict]] = {}
+    service_set = frozenset(fs.service_names)
     for component, findings in groups.items():
-        svc = _component_service(component, fs.service_names)
+        svc = _component_service(component, service_set)
         if svc is None:
             unmapped[component] = findings
         else:
