@@ -30,6 +30,15 @@ session, so a changed graph is a new session, counted in ``resyncs``.
 Trace-derived dependency drift is invisible to both the journal and the
 watch, so every ``topology_check_every``-th poll still does one full
 sweep + edge compare (the steady-state cost stays amortized).
+
+One sampling caveat: snapshot capture bounds HEALTHY-pod log fetches
+(``_prioritize_pods_for_logs``, 25 by default), and which healthy pods
+fall inside the cap shifts as other pods change state.  A watch session
+keeps its original sample until something journals those pods (their
+logs then refetch) or a resync runs — so above the cap, a session's
+log-derived channels for quiet healthy pods can lag a fresh capture's.
+Below the cap the patched session is bit-identical to a fresh one
+(property-tested in tests/test_watch.py).
 """
 
 from __future__ import annotations
