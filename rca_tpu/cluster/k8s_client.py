@@ -557,6 +557,10 @@ class K8sApiClient:
         data = self._kubectl_json(["get", k, name, "-n", namespace])
         if data is None:
             return {"error": f"{kind}/{name} not found in namespace {namespace}"}
+        if isinstance(data, dict):
+            from rca_tpu.findings import annotate_created_ago
+
+            annotate_created_ago(data, self.get_current_time())
         return data
 
     # ---- incremental changes (watch surface) ------------------------------

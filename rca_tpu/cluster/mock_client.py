@@ -267,13 +267,24 @@ class MockClusterClient:
         if store_name is None:
             return {"error": f"unsupported resource kind: {kind}"}
         objects = getattr(self.world, store_name).get(namespace, [])
+        match = None
         for obj in objects:
             if _name(obj) == name:
-                return obj
-        for obj in objects:  # prefix fallback only after all exact checks
-            if _name(obj).startswith(name):
-                return obj
-        return {"error": f"{kind}/{name} not found in namespace {namespace}"}
+                match = obj
+                break
+        if match is None:
+            for obj in objects:  # prefix fallback after all exact checks
+                if _name(obj).startswith(name):
+                    match = obj
+                    break
+        if match is None:
+            return {
+                "error": f"{kind}/{name} not found in namespace {namespace}"
+            }
+        # COPY before annotating: the stored world object must not mutate
+        from rca_tpu.findings import annotate_created_ago
+
+        return annotate_created_ago(dict(match), self.get_current_time())
 
     def run_kubectl(self, args: List[str]) -> str:
         """Mock kubectl escape hatch — renders a describe-ish text view."""

@@ -35,6 +35,39 @@ def utcnow_iso() -> str:
     )
 
 
+def humanize_age(creation_ts: str, now_ts: str) -> str:
+    """'2d ago' / '3h ago' / '5m ago' from two ISO timestamps (reference:
+    utils/k8s_client.py:949-1013 adds a createdAgo humanization to
+    resource details).  Unparseable inputs return ''."""
+    import datetime as _dt
+
+    def parse(ts: str):
+        return _dt.datetime.fromisoformat(str(ts).replace("Z", "+00:00"))
+
+    try:
+        delta = parse(now_ts) - parse(creation_ts)
+    except (ValueError, TypeError):
+        return ""
+    seconds = max(int(delta.total_seconds()), 0)
+    if seconds >= 86400:
+        return f"{seconds // 86400}d ago"
+    if seconds >= 3600:
+        return f"{seconds // 3600}h ago"
+    if seconds >= 60:
+        return f"{seconds // 60}m ago"
+    return f"{seconds}s ago"
+
+
+def annotate_created_ago(data: dict, now_ts: str) -> dict:
+    """Add the reference's ``createdAgo`` humanization to a resource-details
+    dict (shared by both cluster clients so the logic cannot drift)."""
+    meta = data.get("metadata", {}) or {}
+    age = humanize_age(meta.get("creationTimestamp", ""), now_ts)
+    if age:
+        data["createdAgo"] = age
+    return data
+
+
 def make_finding(
     component: str,
     issue: str,

@@ -16,6 +16,7 @@ from rca_tpu.ui.render import (
     analysis_chart_series,
     analysis_viz_data,
     correlated_markdown,
+    diagnostic_timeline_markdown,
     finding_markdown,
     initial_suggestions,
     report_markdown,
@@ -372,10 +373,8 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                     wiz["executed"].append(out)
                     st.rerun()
                 if wiz["executed"]:
-                    last = wiz["executed"][-1]["verdict"]
                     st.markdown(
-                        f"Latest verdict: **{last['verdict']}** "
-                        f"({last['confidence']:.0%}) — {last['reasoning']}"
+                        diagnostic_timeline_markdown(wiz["executed"])
                     )
             else:
                 if st.button("Accept conclusion"):

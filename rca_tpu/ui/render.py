@@ -319,6 +319,31 @@ def wizard_stage_markdown(session: Dict[str, Any]) -> str:
     return "  →  ".join(parts)
 
 
+_VERDICT_ICONS = {"supported": "🟢", "refuted": "🔴", "inconclusive": "🟡"}
+
+
+def diagnostic_timeline_markdown(executed: List[Dict[str, Any]]) -> str:
+    """Timeline of the diagnostic path taken so far — one line per executed
+    investigation step with its evidence kind and verdict (reference:
+    components/interactive_session.py renders a diagnostic-path timeline
+    alongside the wizard)."""
+    if not executed:
+        return "_No steps executed yet._"
+    lines = ["**Diagnostic path**", ""]
+    for i, s in enumerate(executed):
+        step = s.get("step", {}) or {}
+        verdict = s.get("verdict", {}) or {}
+        v = str(verdict.get("verdict", "n/a")).lower()
+        icon = _VERDICT_ICONS.get(v, "⚪")
+        lines.append(
+            f"{i + 1}. {icon} {step.get('description', step.get('type', 'step'))}"
+            f" — **{verdict.get('verdict', 'n/a')}**"
+            f" ({float(verdict.get('confidence', 0) or 0):.0%})"
+            f" · {str(verdict.get('reasoning', ''))[:120]}"
+        )
+    return "\n".join(lines)
+
+
 def report_markdown(results: Dict[str, Any]) -> str:
     """Full comprehensive-analysis report (reference: components/report.py)."""
     correlated = results.get("correlated", {})

@@ -123,6 +123,16 @@ def test_render_helpers():
     md = root_causes_markdown(correlated)
     assert "database" in md and "12.5 ms" in md
 
+    from rca_tpu.ui.render import diagnostic_timeline_markdown
+
+    assert "No steps" in diagnostic_timeline_markdown([])
+    tl = diagnostic_timeline_markdown([
+        {"step": {"description": "Check logs of db-0"},
+         "verdict": {"verdict": "supported", "confidence": 0.8,
+                     "reasoning": "exit 1 in previous logs"}},
+    ])
+    assert "Check logs of db-0" in tl and "supported" in tl and "80%" in tl
+
     md = response_markdown(
         {"points": ["p1"], "sections": [{"title": "T", "content": ["c1"]}]}
     )
