@@ -194,7 +194,12 @@ def _regularizer(tree):
       cascades by leaning on the generator's correlated secondary signals
       (archetype roots always carry not_ready/events there), but a real
       ImagePullBackOff may surface nothing but its waiting reason; these
-      floors mirror the shippability gate's direct channel check.
+      floors mirror the shippability gate's direct channel check;
+    - SOFT symptoms (error rate, latency, events, log errors, resource
+      pressure) stay OUT of hard evidence (hw ≤ 0.55) — a fit that calls
+      warning events "hard broken" works in the generator (its roots
+      always emit events) but would treat every real cluster's background
+      event churn as crashes (observed: hw[EVENTS] fitted to 0.99).
 
     Quadratic hinges: zero inside the allowed region, so a fit that beats
     the defaults WITHIN physical ranges pays nothing."""
@@ -206,6 +211,9 @@ def _regularizer(tree):
     hw = sig(tree["hw"])
     arch = jnp.asarray([int(SvcF.OOM), int(SvcF.IMAGE),
                         int(SvcF.CONFIG), int(SvcF.PENDING)])
+    soft = jnp.asarray([int(SvcF.ERROR_RATE), int(SvcF.LATENCY),
+                        int(SvcF.EVENTS), int(SvcF.LOG_ERRORS),
+                        int(SvcF.RESOURCE)])
     return (
         jnp.maximum(0.4 - decay, 0.0) ** 2
         + jnp.maximum(0.7 - hw[SvcF.CRASH], 0.0) ** 2
@@ -215,6 +223,8 @@ def _regularizer(tree):
         # gradient settle the weight epsilon BELOW it (observed: 0.498)
         + (jnp.maximum(0.55 - aw[arch], 0.0) ** 2).sum()
         + (jnp.maximum(0.45 - hw[arch], 0.0) ** 2).sum()
+        # soft-channel CEILING sits a margin BELOW the gate's 0.6 check
+        + (jnp.maximum(hw[soft] - 0.55, 0.0) ** 2).sum()
     )
 
 
@@ -376,6 +386,17 @@ def shippability_report(
             and float(p.hard_weights[ch]) >= 0.4
             for ch in chans
         )
+        # ...and soft symptoms must stay OUT of hard evidence: a fit with
+        # hw[EVENTS] ~ 1.0 calls every real cluster's background event
+        # churn "hard broken" (works only inside the generator)
+        soft_chans = (SvcF.ERROR_RATE, SvcF.LATENCY, SvcF.EVENTS,
+                      SvcF.LOG_ERRORS, SvcF.RESOURCE)
+        channels_ok = channels_ok and all(
+            float(p.hard_weights[ch]) <= 0.6 for ch in soft_chans
+        )
+        soft_hard_max = round(
+            max(float(p.hard_weights[ch]) for ch in soft_chans), 3
+        )
         return {
             "five_svc_top2": sorted(five),
             "five_svc_ok": five == {"database", "api-gateway"},
@@ -384,6 +405,7 @@ def shippability_report(
             ),
             "archetype_hits": archetypes,
             "channel_floors": channel_floor,
+            "soft_hard_max": soft_hard_max,
             "archetypes_ok": bool(
                 all(v == 3 for v in archetypes.values()) and channels_ok
             ),

@@ -170,10 +170,19 @@ def test_initialize_distributed_single_process_noop(monkeypatch):
 
 
 @pytest.mark.parametrize(
-    "n,spec",
-    [(50, "sp=4,dp=2"), (300, "auto"), (63, "sp=8"), (5, "sp=2,dp=1")],
+    "n,spec,mode,fault_mix",
+    [
+        (50, "sp=4,dp=2", "standard", "crash"),
+        (300, "auto", "standard", "crash"),
+        (63, "sp=8", "standard", "crash"),
+        (5, "sp=2,dp=1", "standard", "crash"),
+        # hard cascade: adversarial + mixed archetypes exercises the
+        # degree-normalized impact, background-median masking, and every
+        # evidence channel through the sharded psum_scatter path
+        (120, "sp=4,dp=2", "adversarial", "mixed"),
+    ],
 )
-def test_sharded_engine_matches_dense_engine(n, spec):
+def test_sharded_engine_matches_dense_engine(n, spec, mode, fault_mix):
     """ShardedGraphEngine is the dense engine's drop-in twin: identical
     scores AND diagnostics (anomaly/upstream/impact) and identical ranked
     components on the same case — the property the analyze boundary relies
@@ -182,7 +191,10 @@ def test_sharded_engine_matches_dense_engine(n, spec):
 
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")
-    case = synthetic_cascade_arrays(n, n_roots=min(2, max(1, n // 30)), seed=3)
+    case = synthetic_cascade_arrays(
+        n, n_roots=min(2, max(1, n // 30)), seed=3,
+        mode=mode, fault_mix=fault_mix,
+    )
     dense = GraphEngine().analyze_case(case, k=5)
     sh = ShardedGraphEngine(spec=spec).analyze_case(case, k=5)
     np.testing.assert_allclose(sh.score, dense.score, rtol=1e-5, atol=1e-6)
