@@ -150,6 +150,32 @@ class K8sApiClient:
         self._connect()
         return self._connected
 
+    def _load_kubeconfigs(self, op: str):
+        """(path, parsed) per readable kubeconfig file in the multi-file
+        KUBECONFIG order, plus the resolved active context name — the ONE
+        merge implementation the repair flow and the context picker share.
+        Unreadable files are skipped with the failure recorded under
+        ``op`` so a partial view is never silent."""
+        import yaml
+
+        raw = self._kubeconfig or os.path.expanduser("~/.kube/config")
+        configs = []
+        for path in [p for p in raw.split(os.pathsep) if p]:
+            if not os.path.exists(path):
+                continue
+            try:
+                with open(path) as f:
+                    configs.append((path, yaml.safe_load(f) or {}))
+            except Exception as exc:
+                self._record_error(
+                    op, f"{path}: {type(exc).__name__}: {exc}"
+                )
+        current = self._context or next(
+            (c.get("current-context") for _, c in configs
+             if c.get("current-context")), None,
+        )
+        return configs, current
+
     def update_server_url(self, new_server_url: str) -> bool:
         """Rewrite the CURRENT context's cluster ``server`` and reconnect —
         the endpoint-repair flow for tunneled clusters whose public URL
@@ -158,24 +184,13 @@ class K8sApiClient:
         kubeconfig's other entries intact).  Honors the colon-separated
         multi-file ``KUBECONFIG`` form by repairing the file that defines
         the target cluster, and leaves a ``<file>.bak`` of the original."""
-        raw = self._kubeconfig or os.path.expanduser("~/.kube/config")
-        paths = [p for p in raw.split(os.pathsep) if p]
         try:
             import yaml
 
             # pass 1 — merged view, the way the kubernetes lib reads the
             # multi-file form: resolve the active context, then the cluster
             # it points at, across ALL files
-            configs = []
-            for path in paths:
-                if not os.path.exists(path):
-                    continue
-                with open(path) as f:
-                    configs.append((path, yaml.safe_load(f) or {}))
-            ctx_name = self._context or next(
-                (c.get("current-context") for _, c in configs
-                 if c.get("current-context")), None,
-            )
+            configs, ctx_name = self._load_kubeconfigs("update_server_url")
             target = next(
                 ((ctx.get("context") or {}).get("cluster")
                  for _, c in configs
@@ -215,8 +230,9 @@ class K8sApiClient:
                 return self.reload_config()
             self._record_error(
                 "update_server_url",
-                f"no kubeconfig in {paths} defines the active context's "
-                "cluster (or has a server entry to rewrite)",
+                "no kubeconfig file defines the active context's cluster "
+                "(or has a server entry to rewrite): "
+                + ", ".join(p for p, _ in configs),
             )
             return False
         except Exception as exc:
@@ -224,6 +240,55 @@ class K8sApiClient:
                 "update_server_url", f"{type(exc).__name__}: {exc}"
             )
             return False
+
+    def list_contexts(self) -> Dict[str, Any]:
+        """Contexts defined across the kubeconfig file(s) plus the active
+        one — the sidebar's context picker reads this (reference:
+        components/sidebar.py namespace/context pickers).  Honors the
+        colon-separated multi-file ``KUBECONFIG`` form; unreadable files
+        are skipped with the failure recorded, so the listing is as
+        complete as the readable files allow."""
+        configs, current = self._load_kubeconfigs("list_contexts")
+        names: List[str] = []
+        for _, cfg in configs:
+            for ctx in cfg.get("contexts", []) or []:
+                name = ctx.get("name")
+                if name and name not in names:
+                    names.append(name)
+        return {"contexts": names, "current": current}
+
+    def switch_context(self, context: str) -> bool:
+        """Reconnect against another kubeconfig context (reference:
+        components/sidebar.py context picker).  Leaves the kubeconfig file
+        untouched — the choice is per-client.  In kubectl-only mode (no
+        kubernetes lib) the switch validates the target context with a
+        bounded kubectl probe instead of the lib reconnect."""
+        previous = self._context
+        self._context = context
+        self._connect()
+        if self._connected:
+            return True
+        if not HAVE_K8S_LIB and self._kubectl:
+            # kubectl-only clients can still serve data for the new
+            # context (run_kubectl passes --context); validate it works
+            cmd = [self._kubectl]
+            if self._kubeconfig:
+                cmd += ["--kubeconfig", self._kubeconfig]
+            cmd += ["--context", context, "get", "namespaces",
+                    "-o", "name", "--request-timeout=5s"]
+            try:
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=10,
+                    check=False,
+                )
+                if proc.returncode == 0:
+                    return True
+            except Exception:
+                pass
+        # restore rather than strand the client on a broken context
+        self._context = previous
+        self._connect()
+        return False
 
     # ---- helpers ---------------------------------------------------------
     def _sanitize(self, obj: Any) -> Any:
@@ -612,6 +677,11 @@ class K8sApiClient:
         cmd = [self._kubectl]
         if self._kubeconfig:
             cmd += ["--kubeconfig", self._kubeconfig]
+        if self._context:
+            # every kubectl-backed surface (top metrics, HPA fallback,
+            # escape hatch) must follow a context switch, not silently
+            # keep serving the previous cluster's data
+            cmd += ["--context", self._context]
         cmd += args
         try:
             proc = subprocess.run(

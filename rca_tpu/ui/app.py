@@ -111,6 +111,28 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                             "Repair failed: "
                             + (errs[-1]["error"] if errs else "unknown error")
                         )
+        # kube-context picker for live clients (reference: sidebar.py
+        # namespace/context pickers) — only when more than one exists.
+        # Switching is behind an EXPLICIT button: auto-switch-on-change
+        # would fire on plain render when no current-context is set, and
+        # would retry a failed (blocking) connect on every rerun
+        if hasattr(client, "list_contexts"):
+            ctxs = client.list_contexts()
+            if len(ctxs.get("contexts", [])) > 1:
+                chosen = st.selectbox(
+                    "Context", ctxs["contexts"],
+                    index=(
+                        ctxs["contexts"].index(ctxs["current"])
+                        if ctxs.get("current") in ctxs["contexts"] else 0
+                    ),
+                )
+                if chosen != ctxs.get("current") and st.button(
+                    f"Switch to {chosen}"
+                ):
+                    if client.switch_context(chosen):
+                        st.rerun()
+                    else:
+                        st.error(f"Could not connect to context {chosen!r}")
         namespaces = client.get_namespaces() or ["default"]
         namespace = st.selectbox("Namespace", namespaces)
         if st.button("New investigation"):

@@ -318,6 +318,57 @@ def test_quantity_parsers():
     assert parse_memory("500K") == 500_000.0
 
 
+def test_list_and_switch_contexts(tmp_path):
+    """Context picker surface (reference: components/sidebar.py pickers):
+    contexts listed across multi-file KUBECONFIG with the active one
+    identified; switching to an unreachable context restores the previous
+    one instead of stranding the client."""
+    import os as _os
+
+    import yaml
+
+    a = tmp_path / "a.yaml"
+    a.write_text(yaml.safe_dump({
+        "current-context": "dev",
+        "contexts": [{"name": "dev", "context": {"cluster": "c1"}}],
+        "clusters": [], "users": [],
+    }))
+    b = tmp_path / "b.yaml"
+    b.write_text(yaml.safe_dump({
+        "contexts": [{"name": "prod", "context": {"cluster": "c2"}}],
+        "clusters": [], "users": [],
+    }))
+    client = K8sApiClient(
+        kubeconfig=f"{a}{_os.pathsep}{b}"
+    )
+    ctxs = client.list_contexts()
+    assert ctxs["contexts"] == ["dev", "prod"]
+    assert ctxs["current"] == "dev"
+    # no live cluster here: the switch fails and restores the previous
+    # context rather than stranding the client on a broken one
+    assert client.switch_context("prod") is False
+    assert client._context is None or client._context != "prod"
+    # unparseable kubeconfig degrades to empty with the error recorded
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("{unclosed")
+    client2 = K8sApiClient(kubeconfig=str(bad))
+    out = client2.list_contexts()
+    assert out["contexts"] == []
+    assert any(
+        e["op"] == "list_contexts"
+        for e in client2.collect_errors(clear=False)
+    )
+    # good + bad multi-file: the readable file's contexts survive AND the
+    # bad file's failure is recorded — a partial view is never silent
+    client3 = K8sApiClient(kubeconfig=f"{a}{_os.pathsep}{bad}")
+    out3 = client3.list_contexts()
+    assert out3["contexts"] == ["dev"]
+    assert any(
+        e["op"] == "list_contexts" and "bad.yaml" in e["error"]
+        for e in client3.collect_errors(clear=False)
+    )
+
+
 def test_update_server_url_scoped_to_active_context(tmp_path):
     """Endpoint repair rewrites ONLY the current context's cluster (an
     unrelated prod cluster in the same file must keep its URL), leaves a
