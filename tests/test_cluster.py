@@ -318,6 +318,23 @@ def test_quantity_parsers():
     assert parse_memory("500K") == 500_000.0
 
 
+def test_created_ago_annotation(five_svc_client):
+    """Resource details carry the reference's createdAgo humanization
+    (reference: utils/k8s_client.py:949-1013) without mutating the stored
+    world object."""
+    from rca_tpu.findings import humanize_age
+
+    assert humanize_age("2026-01-01T00:00:00Z", "2026-01-03T05:00:00Z") == "2d ago"
+    assert humanize_age("2026-01-01T00:00:00Z", "2026-01-01T03:30:00Z") == "3h ago"
+    assert humanize_age("2026-01-01T00:00:00Z", "2026-01-01T00:05:10Z") == "5m ago"
+    assert humanize_age("garbage", "2026-01-01T00:00:00Z") == ""
+
+    details = five_svc_client.get_resource_details(NS, "Deployment", "database")
+    assert "createdAgo" in details
+    stored = five_svc_client.world.deployments[NS][0]
+    assert "createdAgo" not in stored  # annotation never leaks into the world
+
+
 def test_list_and_switch_contexts(tmp_path):
     """Context picker surface (reference: components/sidebar.py pickers):
     contexts listed across multi-file KUBECONFIG with the active one
