@@ -331,7 +331,10 @@ def test_created_ago_annotation(five_svc_client):
 
     details = five_svc_client.get_resource_details(NS, "Deployment", "database")
     assert "createdAgo" in details
-    stored = five_svc_client.world.deployments[NS][0]
+    stored = next(
+        d for d in five_svc_client.world.deployments[NS]
+        if d["metadata"]["name"] == "database"
+    )
     assert "createdAgo" not in stored  # annotation never leaks into the world
 
 
