@@ -161,12 +161,15 @@ def cmd_suggest(args) -> int:
 
 def cmd_bench(args) -> int:
     from rca_tpu.cluster.generator import synthetic_cascade_arrays
-    from rca_tpu.engine import GraphEngine
+    from rca_tpu.engine import make_engine
 
     case = synthetic_cascade_arrays(
         args.services, n_roots=args.roots, seed=args.seed
     )
-    result = GraphEngine().analyze_case(case, k=5, timed=True)
+    # reuse the analyze boundary's engine selection (RCA_SHARD / device
+    # count), so `rca bench` measures what `rca analyze` would actually run
+    engine = make_engine()
+    result = engine.analyze_case(case, k=5, timed=True)
     truth = {case.names[r] for r in case.roots.tolist()}
     print(
         json.dumps(
@@ -175,6 +178,7 @@ def cmd_bench(args) -> int:
                 "n_edges": result.n_edges,
                 "latency_ms": round(result.latency_ms, 3),
                 "top1_hit": result.ranked[0]["component"] in truth,
+                "engine": result.engine,
                 "ranked": result.ranked[:5],
             },
             default=str,

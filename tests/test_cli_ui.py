@@ -391,6 +391,9 @@ def test_cli_bench_small(capsys):
     assert out["latency_ms"] > 0
     assert isinstance(out["top1_hit"], bool)
     assert len(out["ranked"]) == 5
+    # rca bench measures what rca analyze would run: the analyze-boundary
+    # engine selection (sharded on the 8-device test mesh)
+    assert out["engine"].startswith(("single", "sharded("))
 
 
 def test_cli_train_tiny(capsys, tmp_path):
