@@ -18,18 +18,22 @@ via shard_map/ppermute for large topologies.
 
 Layering (bottom-up; see SURVEY.md §7):
 
-- :mod:`rca_tpu.cluster`      typed snapshot layer (real + mock backends)
+- :mod:`rca_tpu.cluster`      typed snapshot layer (real + mock backends,
+                              watch-driven incremental change feeds)
 - :mod:`rca_tpu.features`     vectorized feature extraction → device arrays
-- :mod:`rca_tpu.graph`        topology construction → typed COO/CSR arrays
-- :mod:`rca_tpu.engine`       jit'd causal propagation + root-cause ranking
-- :mod:`rca_tpu.models`       learnable CausalGNN scorer (flax)
-- :mod:`rca_tpu.ops`          Pallas TPU kernels + XLA fallbacks
+- :mod:`rca_tpu.graph`        topology construction → typed COO arrays;
+                              accelerator Brandes for SPOF centrality
+- :mod:`rca_tpu.engine`       jit'd causal propagation + ranking, learned
+                              weights (optax/orbax, shippability-gated),
+                              Pallas kernels, streaming sessions, the
+                              sharded multi-device engine selector
 - :mod:`rca_tpu.parallel`     mesh / sharding / collective utilities
 - :mod:`rca_tpu.agents`       deterministic + LLM agent families
 - :mod:`rca_tpu.coordinator`  orchestration, chat, suggestions, hypotheses
 - :mod:`rca_tpu.llm`          LLM backend with a real tool-execution loop
 - :mod:`rca_tpu.store`        investigation persistence (file-locked JSON)
 - :mod:`rca_tpu.obslog`       evidence / prompt audit logs
+- :mod:`rca_tpu.native`       C/C++ hot-path twins (log scan, sanitizer)
 - :mod:`rca_tpu.ui`           Streamlit UI surface (import-gated)
 """
 
