@@ -243,8 +243,16 @@ def merge_llm_structured(
     rd = llm_out.get("response_data")
     if isinstance(rd, dict) and rd.get("points"):
         merged["response_data"] = rd
-    if isinstance(llm_out.get("summary"), str) and llm_out["summary"].strip():
-        merged["summary"] = llm_out["summary"].strip()
+    summary = llm_out.get("summary")
+    if (
+        isinstance(summary, str)
+        and summary.strip()
+        # the hermetic provider's canned placeholder must not displace the
+        # counts-derived deterministic summary ("3 of 6 pods unhealthy...")
+        # the backfill computed — placeholder text is worse than backfill
+        and not summary.strip().lower().startswith("offline deterministic")
+    ):
+        merged["summary"] = summary.strip()
     sugg = llm_out.get("suggestions")
     if isinstance(sugg, list) and sugg:
         cleaned = []

@@ -316,6 +316,38 @@ def test_check_logs_classifies_error_patterns(coord, ctx):
     assert any("exception" in k for k in out["key_findings"])
 
 
+def test_merge_llm_structured_backfill_semantics():
+    """Deterministic backfill survives weak/absent LLM fields (reference:
+    mcp_coordinator.py:1370-1567), including the hermetic provider's
+    canned placeholder summary — placeholder text must not displace the
+    counts-derived summary a user can act on."""
+    from rca_tpu.coordinator.structured import merge_llm_structured
+
+    base = {
+        "response_data": {"points": ["det point"], "sections": []},
+        "summary": "2 pod(s) show problems; most severe: db-0",
+        "suggestions": [{"text": "det", "priority": "high",
+                         "reasoning": "", "action": {"type": "query"}}],
+        "key_findings": ["det finding"],
+    }
+    # None / non-dict → base unchanged
+    assert merge_llm_structured(base, None) == base
+    # the offline provider's canned summary is NOT an improvement
+    out = merge_llm_structured(
+        base, {"summary": "offline deterministic analysis"}
+    )
+    assert out["summary"] == base["summary"]
+    # a real summary IS taken; malformed suggestions are dropped in favor
+    # of the deterministic list
+    out = merge_llm_structured(
+        base,
+        {"summary": "  db-0 is crash-looping  ",
+         "suggestions": [{"no_text": True}]},
+    )
+    assert out["summary"] == "db-0 is crash-looping"
+    assert out["suggestions"] == base["suggestions"]
+
+
 def test_update_suggestions_drops_taken_action(coord, ctx):
     taken = {"type": "run_agent", "agent_type": "comprehensive"}
     fresh = coord.update_suggestions_after_action(taken, {}, NS, ctx=ctx)
