@@ -115,6 +115,22 @@ class K8sApiClient:
     def _connect(self) -> None:
         """(Re)build API clients and probe the connection."""
         self._connected = False
+        # tear down watch pumps bound to the PREVIOUS connection: their
+        # threads captured the old CoreV1Api at construction, so leaving
+        # them running would keep serving the old cluster's change feed
+        # (with still-valid tokens) while list/get calls hit the new one —
+        # a streaming session would then patch new-cluster snapshots from
+        # old-cluster churn (round-3 advisor finding).  Clearing the
+        # registry makes every existing cursor read as expired, which
+        # forces the one correct recovery: a full resync against the new
+        # connection.
+        with self._pumps_registry() as pumps_by_ns:
+            for pumps in pumps_by_ns.values():
+                try:
+                    pumps.stop()
+                except Exception:
+                    pass
+            pumps_by_ns.clear()
         if not HAVE_K8S_LIB:
             return
         try:
@@ -291,6 +307,26 @@ class K8sApiClient:
         return False
 
     # ---- helpers ---------------------------------------------------------
+    def _pumps_registry(self):
+        """Locked access to the namespace→WatchPumpSet registry.  The lock
+        and dict are created lazily (and atomically, via ``setdefault`` on
+        ``__dict__``) because concurrent sessions call ``watch_changes``
+        from their own threads — an unlocked check-then-create would let
+        two openers race and orphan a started pump set whose watch threads
+        nothing ever stops."""
+        import contextlib
+        import threading
+
+        lock = self.__dict__.setdefault("_pumps_lock", threading.Lock())
+        pumps = self.__dict__.setdefault("_pumps", {})
+
+        @contextlib.contextmanager
+        def held():
+            with lock:
+                yield pumps
+
+        return held()
+
     def _sanitize(self, obj: Any) -> Any:
         return self._api_client.sanitize_for_serialization(obj)
 
@@ -638,38 +674,60 @@ class K8sApiClient:
         queue ``(kind, name)`` notifications; each call drains the queue
         without blocking — the poll loop never waits on the API server.
 
-        ``cursor=None`` (re)starts the pumps for this namespace.  A pump
-        death (410 Gone, queue overflow, network error) reports
-        ``expired`` — the caller resyncs from a full list exactly as a
-        real watch consumer re-lists, then reopens with ``cursor=None``.
-        Without the kubernetes lib (kubectl-only clients) this surface is
+        ``cursor=None`` registers a NEW consumer on the namespace's shared
+        pump set (creating the set on first use) and returns its token —
+        any number of sessions share the same two watch streams, each with
+        its own read position, so concurrent sessions on one namespace no
+        longer thrash each other's feed (round-3 advisor finding).  A pump
+        death (410 Gone, network error), a consumer lagging past the
+        journal window, or an unknown/stale token reports ``expired`` —
+        the caller resyncs from a full list exactly as a real watch
+        consumer re-lists, then reopens with ``cursor=None``.  Without the
+        kubernetes lib (kubectl-only clients) this surface is
         ``supported: False`` and callers keep the full-sweep path."""
         if not HAVE_K8S_LIB or not self._connected:
             return {"supported": False, "cursor": None,
                     "expired": False, "changes": []}
         from rca_tpu.cluster.watch_pump import WatchPumpSet
 
-        # one pump set PER NAMESPACE: two sessions sharing this client
-        # (different namespaces) must not thrash each other's feed into a
-        # mutual expire/resync loop (round-3 review finding)
-        pumps_by_ns: Dict[str, WatchPumpSet] = getattr(self, "_pumps", None)
-        if pumps_by_ns is None:
-            pumps_by_ns = self._pumps = {}
-        pumps = pumps_by_ns.get(namespace)
-        if cursor is None or pumps is None:
-            if pumps is not None:
-                pumps.stop()
-            pumps = pumps_by_ns[namespace] = WatchPumpSet(
-                self._core, namespace
-            )
-            pumps.start()
-            return {"supported": True, "cursor": pumps.token,
-                    "expired": False, "changes": []}
-        if cursor != pumps.token or pumps.expired:
-            return {"supported": True, "cursor": pumps.token,
+        # one pump set PER NAMESPACE, shared by all consumers of it
+        with self._pumps_registry() as pumps_by_ns:
+            pumps = pumps_by_ns.get(namespace)
+            if cursor is None:
+                if pumps is None or pumps.expired:
+                    # a dead set is replaced; live consumers of the old set
+                    # observe expiry on their next drain and reopen here too
+                    if pumps is not None:
+                        pumps.stop()
+                    pumps = pumps_by_ns[namespace] = WatchPumpSet(
+                        self._core, namespace
+                    )
+                    # register BEFORE starting so nothing the pumps deliver
+                    # can land ahead of the first consumer's read position
+                    token = pumps.register()
+                    pumps.start()
+                else:
+                    token = pumps.register()
+                return {"supported": True, "cursor": token,
+                        "expired": False, "changes": []}
+        changes = pumps.drain(cursor) if pumps is not None else None
+        if changes is None:
+            return {"supported": True, "cursor": cursor,
                     "expired": True, "changes": []}
-        return {"supported": True, "cursor": pumps.token,
-                "expired": False, "changes": pumps.drain()}
+        return {"supported": True, "cursor": cursor,
+                "expired": False, "changes": changes}
+
+    def watch_close(self, namespace: str, cursor: Optional[str]) -> None:
+        """Release a consumer token acquired from :meth:`watch_changes`.
+        Sessions call this when they abandon a cursor (resync acquires a
+        fresh one) — an orphaned token would otherwise pin the shared
+        journal's trim floor at its frozen read position forever."""
+        if cursor is None:
+            return
+        with self._pumps_registry() as pumps_by_ns:
+            pumps = pumps_by_ns.get(namespace)
+        if pumps is not None:
+            pumps.deregister(cursor)
 
     def run_kubectl(self, args: List[str]) -> str:
         if not self._kubectl:

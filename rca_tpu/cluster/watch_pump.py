@@ -4,23 +4,44 @@ The live :meth:`K8sApiClient.watch_changes` surface must never block the
 1 Hz streaming poll loop on the API server, so watches run in daemon
 threads: each pump holds one long ``kubernetes.watch.Watch`` stream (pods,
 events) and appends ``{"kind", "name"}`` notifications to a bounded
-thread-safe queue; :meth:`WatchPumpSet.drain` empties it without blocking.
+thread-safe journal; consumers drain it without blocking.
+
+One :class:`WatchPumpSet` is shared by every consumer of a namespace: the
+journal is an append-only window with absolute sequence numbers, and each
+consumer holds a **token** mapping to its own read position
+(:meth:`register` / :meth:`drain`).  Two streaming sessions over the same
+namespace therefore share two watch streams total instead of thrashing a
+single token back and forth — the round-3 design replaced the whole set on
+every reopen, so the other session's next poll saw a cursor mismatch and
+degraded every poll into a full sweep+resync loop (round-3 advisor
+finding).
 
 Each pump pins its stream to a **resourceVersion**: an initial ``limit=1``
 list yields the collection RV, every delivered event (and every bookmark —
 ``allow_watch_bookmarks``) advances it, and stream renewals resume FROM
 that RV — without this, every 30 s renewal would replay the whole
 collection as synthetic ADDED events and a 10k-pod namespace would
-overflow the queue into a permanent expire/resync loop (round-3 review
+overflow the journal into a permanent expire/resync loop (round-3 review
 finding).
 
 Failure semantics mirror a real watch consumer's contract:
 
-- **410 Gone** (the server compacted past our resourceVersion), queue
-  overflow, or any stream error marks the pump set ``expired`` — the
-  caller re-lists (full resync) and reopens with ``cursor=None``;
+- **410 Gone** (the server compacted past our resourceVersion) or any
+  stream error expires the whole pump set — every consumer re-lists (full
+  resync) and reopens with ``cursor=None``;
+- a consumer that falls further behind than the journal window retains
+  expires **individually**; other consumers keep draining;
 - a normal end of stream (server-side timeout) is NOT an expiry: the
   stream reopens at the tracked RV with no replay and no gap.
+
+``stop()`` calls ``watch.Watch.stop()`` on each pump's stream handle in
+addition to setting the stop event, so a stream terminates at its next
+delivered event instead of looping into another 30 s renewal (round-3
+advisor finding).  This is best-effort, not instant: the real kubernetes
+client only checks the stop flag between yielded events, so a pump blocked
+in a quiet HTTP read still lingers until the server-side
+``timeout_seconds=30`` close — bounded, and harmless: a stopped pump's
+late pushes land in an orphaned journal no consumer reads.
 
 Tested hermetically with a stub ``kubernetes`` module
 (tests/test_watch.py) — the same technique as the provider contract tests.
@@ -30,9 +51,12 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 QUEUE_CAP = 10_000
+# registry bound: dropping a consumer record is always safe (an unknown
+# token reads as expired, which forces the one correct recovery — resync)
+MAX_CONSUMERS = 256
 
 # resource kinds pumped: churn in these drives streaming features; other
 # kinds (services, deployments, config) change topology and are handled by
@@ -59,11 +83,15 @@ class _Pump(threading.Thread):
         self.owner = owner
         self.kind = kind
         self.list_method = list_method
+        self.watch_handle: Optional[Any] = None
 
     def run(self) -> None:
         from kubernetes import watch
 
         w = watch.Watch()
+        # published so WatchPumpSet.stop() can break the blocking stream
+        # iteration promptly instead of waiting out the server timeout
+        self.watch_handle = w
         list_fn = getattr(self.owner.core, self.list_method)
         try:
             # initial list pins the stream start (collection RV): the
@@ -108,6 +136,11 @@ class _Pump(threading.Thread):
                         self.owner.push(self.kind, name)
                 # normal stream end (server timeout): reopen at tracked RV
         except Exception:
+            if self.owner._stop.is_set():
+                # a teardown-induced stream break is a shutdown, not a 410:
+                # expiring here would force every consumer of the NEXT
+                # connection's feed into a spurious resync
+                return
             # 410 Gone / network error / anything: the consumer must
             # re-list; a dead pump silently dropping changes would be the
             # one unrecoverable failure mode
@@ -117,17 +150,20 @@ class _Pump(threading.Thread):
 
 
 class WatchPumpSet:
-    """One pump per watched kind for a single namespace."""
+    """Shared pumps + change journal for one namespace, many consumers."""
 
     _counter = 0
 
     def __init__(self, core_api: Any, namespace: str):
         self.core = core_api
         self.namespace = namespace
-        WatchPumpSet._counter += 1
-        self.token = f"pumps-{WatchPumpSet._counter}"
         self._lock = threading.Lock()
-        self._queue: collections.deque = collections.deque()
+        # journal window: _journal[i] has absolute sequence _base + i
+        self._journal: collections.deque = collections.deque()
+        self._base = 0
+        self._next = 0
+        # token -> absolute read position
+        self._consumers: Dict[str, int] = {}
         self._stop = threading.Event()
         self._expired = threading.Event()
         self._threads = [_Pump(self, k, m) for k, m in _PUMPED]
@@ -138,26 +174,78 @@ class WatchPumpSet:
 
     def stop(self) -> None:
         self._stop.set()
+        for t in self._threads:
+            w = t.watch_handle
+            if w is not None:
+                try:
+                    w.stop()
+                except Exception:
+                    pass
+
+    # -- consumer registry --------------------------------------------------
+    def register(self) -> str:
+        """New consumer token positioned at the journal head (changes that
+        predate the registration are the caller's resync's problem)."""
+        with self._lock:
+            WatchPumpSet._counter += 1
+            token = f"pumps-{WatchPumpSet._counter}"
+            self._consumers[token] = self._next
+            if len(self._consumers) > MAX_CONSUMERS:
+                # evict the most-behind token (likely abandoned by a
+                # resync); if its owner ever polls again the unknown token
+                # reads as expired — the correct recovery either way
+                victim = min(self._consumers, key=self._consumers.get)
+                del self._consumers[victim]
+            return token
+
+    def deregister(self, token: str) -> None:
+        """Drop a consumer whose owner is done with it (e.g. a session
+        acquiring a fresh token on resync).  Without this, an abandoned
+        token pins the journal's trim floor at its frozen position and the
+        window sits at ``QUEUE_CAP`` entries forever on a busy namespace."""
+        with self._lock:
+            self._consumers.pop(token, None)
+            floor = min(self._consumers.values(), default=self._next)
+            while self._journal and self._base < floor:
+                self._journal.popleft()
+                self._base += 1
 
     def push(self, kind: str, name: str) -> None:
         with self._lock:
-            if len(self._queue) >= QUEUE_CAP:
-                # overflow: the consumer fell too far behind to trust a
-                # drain — same contract as a compacted resourceVersion
-                self._expired.set()
-                return
-            self._queue.append({"kind": kind, "name": name})
+            self._journal.append({"kind": kind, "name": name})
+            self._next += 1
+            # trim what every consumer has already read
+            floor = min(self._consumers.values(), default=self._next)
+            while self._journal and self._base < floor:
+                self._journal.popleft()
+                self._base += 1
+            # cap the window regardless: consumers lagging past the cap
+            # expire individually on their next drain
+            while len(self._journal) > QUEUE_CAP:
+                self._journal.popleft()
+                self._base += 1
 
-    def drain(self) -> List[Dict[str, str]]:
+    def drain(self, token: str) -> Optional[List[Dict[str, str]]]:
+        """Changes since this consumer's position, deduped; ``None`` means
+        the consumer (or the whole set) expired and must resync."""
         with self._lock:
+            if self._expired.is_set():
+                self._consumers.pop(token, None)
+                return None
+            pos = self._consumers.get(token)
+            if pos is None or pos < self._base:
+                # unknown token or lagged past the retained window
+                self._consumers.pop(token, None)
+                return None
             seen = set()
             out = []
-            while self._queue:
-                c = self._queue.popleft()
+            for i in range(pos - self._base, len(self._journal)):
+                c = self._journal[i]
                 key = (c["kind"], c["name"])
                 if key not in seen:
                     seen.add(key)
                     out.append(c)
+            self._consumers[token] = self._next
             return out
 
     @property

@@ -92,6 +92,12 @@ class LiveStreamingSession:
         self._polls = 0
         self.resyncs = -1  # first _resync is initialization, not a resync
         self._cursor: Optional[str] = None
+        # set when a poll drained the feed but then failed to apply the
+        # changes (sweep raised, or the capture came back partial): the
+        # notifications are gone from the feed, so the next poll must
+        # recover them with a full resync instead of serving stale rows
+        # until the next periodic sweep (round-3 advisor finding)
+        self._pending_resync = False
         # optimistic: _resync's _reopen_feed does the one real probe —
         # probing here too would open a second feed (on a live cluster,
         # a second pair of watch-pump threads) just to throw it away
@@ -115,6 +121,14 @@ class LiveStreamingSession:
         src, dst = edges if edges is not None else service_dependency_edges(
             snap, fs
         )
+        if self._watch and snap.errors:
+            # a resync built from a PARTIAL capture has not actually
+            # recovered: whatever the failing calls missed is still stale,
+            # so keep the recovery flag set and try again next poll (the
+            # flake clearing ends the loop; while it persists this is the
+            # same capture-every-poll cost as sweep mode, degraded but
+            # correct) — round-4 review finding
+            self._pending_resync = True
         self._snap = snap if self._watch else None
         self._names = list(fs.service_names)
         self._edge_key = (src.tobytes(), dst.tobytes())
@@ -129,6 +143,18 @@ class LiveStreamingSession:
 
     def _reopen_feed(self) -> None:
         if self._watch:
+            # release the superseded cursor first: an abandoned consumer
+            # token would pin the shared journal's trim floor at its frozen
+            # position, holding the window at its cap forever (round-4
+            # review finding).  Optional surface — the mock's seq cursors
+            # don't pin anything and define no watch_close.
+            if self._cursor is not None:
+                close = getattr(self.client, "watch_close", None)
+                if close is not None:
+                    try:
+                        close(self.namespace, self._cursor)
+                    except Exception:
+                        pass
             try:
                 probe = self.client.watch_changes(self.namespace, None)
             except (AttributeError, TypeError):
@@ -240,6 +266,14 @@ class LiveStreamingSession:
         if not self._watch:
             return self._poll_sweep()
         t0 = time.perf_counter()
+        if self._pending_resync:
+            # the previous poll drained notifications it could not apply;
+            # a fresh full capture re-covers whatever they described
+            self._pending_resync = False
+            self._resync()
+            return self._finish(
+                t0, changed=len(self._names), resynced=True, quiet=False,
+            )
         if self._polls % self.topology_check_every == 0:
             # periodic full check: trace data (edges AND error-rate/latency
             # features) can drift invisibly to the feed; drain it first so
@@ -250,7 +284,13 @@ class LiveStreamingSession:
             self._cursor = resp.get("cursor")
             if resp.get("expired"):
                 self._reopen_feed()
-            return self._poll_sweep(check_edges=True)
+            try:
+                return self._poll_sweep(check_edges=True)
+            except Exception:
+                # the drained changes are gone from the feed and the sweep
+                # that superseded them never landed
+                self._pending_resync = True
+                raise
         resp = self.client.watch_changes(self.namespace, self._cursor)
         if not resp.get("supported"):
             self._watch = False
@@ -264,28 +304,35 @@ class LiveStreamingSession:
         changes = resp.get("changes", [])
         if not changes:
             return self._finish(t0, changed=0, resynced=False, quiet=True)
-        if any(c["kind"] in _TOPOLOGY_KINDS for c in changes):
-            self._resync()
-            return self._finish(
-                t0, changed=len(self._names), resynced=True, quiet=False,
-            )
-        snap = self._patch_snapshot(changes)
-        fs = extract_features(snap)
-        if list(fs.service_names) != self._names:
-            self._resync(snap=snap, fs=fs)
-            return self._finish(
-                t0, changed=len(self._names), resynced=True, quiet=False,
-            )
-        if any(c["kind"] == "traces" for c in changes):
-            # trace dependencies shape the session's device-pinned edges:
-            # a journaled trace change must re-derive them and resync on
-            # drift (feature-only trace changes fall through to the diff)
-            edges = service_dependency_edges(snap, fs)
-            if (edges[0].tobytes(), edges[1].tobytes()) != self._edge_key:
-                self._resync(snap=snap, fs=fs, edges=edges)
+        try:
+            if any(c["kind"] in _TOPOLOGY_KINDS for c in changes):
+                self._resync()
                 return self._finish(
                     t0, changed=len(self._names), resynced=True, quiet=False,
                 )
+            snap = self._patch_snapshot(changes)
+            fs = extract_features(snap)
+            if list(fs.service_names) != self._names:
+                self._resync(snap=snap, fs=fs)
+                return self._finish(
+                    t0, changed=len(self._names), resynced=True, quiet=False,
+                )
+            if any(c["kind"] == "traces" for c in changes):
+                # trace dependencies shape the session's device-pinned
+                # edges: a journaled trace change must re-derive them and
+                # resync on drift (feature-only trace changes fall through
+                # to the diff)
+                edges = service_dependency_edges(snap, fs)
+                if (edges[0].tobytes(), edges[1].tobytes()) != self._edge_key:
+                    self._resync(snap=snap, fs=fs, edges=edges)
+                    return self._finish(
+                        t0, changed=len(self._names), resynced=True,
+                        quiet=False,
+                    )
+        except Exception:
+            # changes were drained but never applied — recover next poll
+            self._pending_resync = True
+            raise
         self._snap = snap
         changed = self._upload_diff(fs)
         return self._finish(t0, changed=changed, resynced=False, quiet=False)
@@ -347,5 +394,12 @@ class LiveStreamingSession:
             # retaining a 10k-service snapshot in pure-sweep mode would
             # pin pods+logs+events for the session lifetime for nothing
             self._snap = snap
+            if snap.errors:
+                # PARTIAL capture standing in for drained (and therefore
+                # discarded) notifications: the objects the capture missed
+                # may be exactly the ones that changed — schedule a
+                # recovery resync rather than serving stale rows until the
+                # next periodic sweep (round-3 advisor finding)
+                self._pending_resync = True
         changed = self._upload_diff(fs)
         return self._finish(t0, changed=changed, resynced=False, quiet=False)
