@@ -456,7 +456,75 @@ def train(
     return pytree_to_params(tree, steps=cfg.steps), history
 
 
-# -- checkpointing (orbax) --------------------------------------------------
+# -- checkpointing (orbax + packaged JSON) ----------------------------------
+
+# The SHIPPED default checkpoint (VERDICT r3 item 2): a gate-passing
+# artifact committed with the repo, loaded by GraphEngine construction
+# unless RCA_WEIGHTS overrides it (see rca_tpu.engine.runner.resolve_params).
+# JSON, not orbax: the artifact is ~30 floats — human-diffable in review,
+# no checkpointer dependency at import time.
+PACKAGED_WEIGHTS = Path(__file__).with_name("default_weights.json")
+
+
+def _require_formula_version(version: int, path: str) -> None:
+    if version != SCORE_FORMULA_VERSION:
+        raise ValueError(
+            f"checkpoint {path} was trained against score formula "
+            f"v{version}, but this engine computes v{SCORE_FORMULA_VERSION} "
+            "(rca_tpu.engine.propagate.SCORE_FORMULA_VERSION) — weights "
+            "fitted to a different objective mis-rank silently; retrain "
+            "with `rca train`."
+        )
+
+
+def save_params_json(
+    params: PropagationParams, path: str, provenance: Optional[Dict] = None
+) -> None:
+    """Single-file JSON checkpoint (the packaged-artifact format).
+    ``provenance`` (training config, gate report, dataset description) is
+    stored verbatim so the shipped file documents how it was produced."""
+    import json
+
+    data = {
+        "format": "rca-weights-v1",
+        "formula_version": SCORE_FORMULA_VERSION,
+        "anomaly_weights": [float(x) for x in params.anomaly_weights],
+        "hard_weights": [float(x) for x in params.hard_weights],
+        "steps": int(params.steps),
+        "decay": float(params.decay),
+        "explain_strength": float(params.explain_strength),
+        "impact_bonus": float(params.impact_bonus),
+        "provenance": provenance or {},
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+
+
+def load_params_json(path: str) -> PropagationParams:
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    _require_formula_version(int(data.get("formula_version", 1)), path)
+    n = NUM_SERVICE_FEATURES
+    return PropagationParams(
+        anomaly_weights=tuple(float(x) for x in data["anomaly_weights"][:n]),
+        hard_weights=tuple(float(x) for x in data["hard_weights"][:n]),
+        steps=int(data["steps"]),
+        decay=float(data["decay"]),
+        explain_strength=float(data["explain_strength"]),
+        impact_bonus=float(data["impact_bonus"]),
+    )
+
+
+def packaged_params() -> Optional[PropagationParams]:
+    """The committed default checkpoint, or None when absent (source
+    checkouts before the artifact landed, or deliberately stripped)."""
+    if PACKAGED_WEIGHTS.exists():
+        return load_params_json(str(PACKAGED_WEIGHTS))
+    return None
+
 
 def save_params(params: PropagationParams, path: str) -> None:
     import orbax.checkpoint as ocp
@@ -475,19 +543,16 @@ def save_params(params: PropagationParams, path: str) -> None:
 
 
 def load_params(path: str) -> PropagationParams:
+    """Load either checkpoint format: a JSON file (packaged artifact) or
+    an orbax checkpoint directory (``rca train --out``)."""
+    p = Path(path)
+    if p.is_file():
+        return load_params_json(path)
     import orbax.checkpoint as ocp
 
     ckptr = ocp.PyTreeCheckpointer()
-    tree = ckptr.restore(Path(path).absolute())
-    version = int(tree.get("formula_version", 1))
-    if version != SCORE_FORMULA_VERSION:
-        raise ValueError(
-            f"checkpoint {path} was trained against score formula "
-            f"v{version}, but this engine computes v{SCORE_FORMULA_VERSION} "
-            "(rca_tpu.engine.propagate.SCORE_FORMULA_VERSION) — weights "
-            "fitted to a different objective mis-rank silently; retrain "
-            "with `rca train`."
-        )
+    tree = ckptr.restore(p.absolute())
+    _require_formula_version(int(tree.get("formula_version", 1)), path)
     n = NUM_SERVICE_FEATURES
     aw = tuple(float(x) for x in np.asarray(tree["anomaly_weights"])[:n])
     hw = tuple(float(x) for x in np.asarray(tree["hard_weights"])[:n])
