@@ -39,7 +39,11 @@ from rca_tpu.cluster.world import (
 # Feature channels shared with the extractor (rca_tpu.features.schema.SvcF);
 # generated cascades and extracted worlds feed the same engine arrays.
 from rca_tpu.features.schema import NUM_SERVICE_FEATURES as NUM_FEATURES  # noqa: E402
-from rca_tpu.features.schema import SvcF  # noqa: E402
+from rca_tpu.features.schema import (  # noqa: E402
+    NUM_RAW_SERVICE_FEATURES as NUM_RAW,
+    SvcF,
+    derive_silent_channel,
+)
 
 F_CRASH = int(SvcF.CRASH)
 F_ERROR_RATE = int(SvcF.ERROR_RATE)
@@ -226,6 +230,9 @@ def synthetic_cascade_arrays(
     feats = np.zeros((n_services, NUM_FEATURES), dtype=np.float32)
 
     correlated = mode in ("correlated_noise", "adversarial")
+    # all rng draws cover only the RAW (observed) channels: the derived
+    # SILENT channel is computed afterwards with no randomness of its own,
+    # so every pre-existing seed's raw channels stay byte-stable
     if correlated:
         # low-rank noise: a few shared factors load onto every service
         # (scrape jitter, a hot node) — raises the background floor in a
@@ -234,21 +241,21 @@ def synthetic_cascade_arrays(
         # error rates / event counts, it does not fabricate OOM kills or
         # image-pull failures.
         n_factors = 3
-        soft = np.zeros(NUM_FEATURES, dtype=np.float32)
+        soft = np.zeros(NUM_RAW, dtype=np.float32)
         soft[[F_ERROR_RATE, F_LATENCY, F_EVENTS, F_LOG_ERRORS, F_RESOURCE]] = 1.0
         loadings = rng.uniform(0, 1, (n_services, n_factors)).astype(np.float32)
         factors = (
-            rng.uniform(0, 0.25, (n_factors, NUM_FEATURES)).astype(np.float32)
+            rng.uniform(0, 0.25, (n_factors, NUM_RAW)).astype(np.float32)
             * soft[None, :]
         )
         background = loadings @ factors + rng.uniform(
-            0.0, noise, size=(n_services, NUM_FEATURES)
+            0.0, noise, size=(n_services, NUM_RAW)
         ).astype(np.float32)
     else:
         background = rng.uniform(
-            0.0, noise, size=(n_services, NUM_FEATURES)
+            0.0, noise, size=(n_services, NUM_RAW)
         ).astype(np.float32)
-    feats += background
+    feats[:, :NUM_RAW] += background
 
     is_root = np.zeros(n_services, dtype=bool)
     is_root[roots] = True
@@ -369,10 +376,15 @@ def synthetic_cascade_arrays(
     if mode in ("missing_signals", "adversarial"):
         # per-(service, channel) dropout of the fault signals: each channel
         # is observed with probability ``dropout_keep`` (background survives
-        # — missing data looks like *quiet*, not like zeroed noise)
-        keep = rng.random((n_services, NUM_FEATURES)) < dropout_keep
-        feats = np.where(keep, feats, background).astype(np.float32)
+        # — missing data looks like *quiet*, not like zeroed noise).  Only
+        # the RAW channels drop: SILENT is the analyzer's own derivation
+        # from whatever WAS observed, not an independent observation.
+        keep = rng.random((n_services, NUM_RAW)) < dropout_keep
+        feats[:, :NUM_RAW] = np.where(
+            keep, feats[:, :NUM_RAW], background
+        ).astype(np.float32)
 
+    derive_silent_channel(feats)
     anomaly = feats.max(axis=1)
     names = None
     if n_services <= 4096:

@@ -85,6 +85,11 @@ def default_params(steps: int = 8) -> PropagationParams:
     aw[SvcF.CONFIG] = 0.9
     aw[SvcF.PENDING] = 0.7
     aw[SvcF.OOM] = 0.95
+    # absence evidence: down-but-silent (never started) is root evidence
+    # comparable to the archetype channels it stands in for when dropout
+    # hides them (VERDICT r3 item 4; tuned on band 3000, validated on the
+    # disjoint band-7000 archetype study — see PERF.md)
+    aw[SvcF.SILENT] = 0.6
     hw = np.zeros(NUM_SERVICE_FEATURES, dtype=np.float32)
     hw[SvcF.CRASH] = 1.0
     hw[SvcF.IMAGE] = 0.9
@@ -97,6 +102,7 @@ def default_params(steps: int = 8) -> PropagationParams:
     # is dropped (missing_signals mode) — without it the root can't
     # suppress its blast radius and a high-impact victim outranks it
     hw[SvcF.NOT_READY] = 0.5
+    hw[SvcF.SILENT] = 0.6
     return PropagationParams(
         anomaly_weights=tuple(float(x) for x in aw),
         hard_weights=tuple(float(x) for x in hw),

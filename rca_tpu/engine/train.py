@@ -209,8 +209,13 @@ def _regularizer(tree):
     decay = sig(tree["decay"])
     aw = sig(tree["aw"])
     hw = sig(tree["hw"])
+    # SILENT rides the archetype floors: it is the absence-evidence twin of
+    # these channels (what identifies their roots when dropout hides the
+    # defining signal), and a fit on crash-heavy data would zero it for
+    # exactly the same reason it zeroed them in round 3
     arch = jnp.asarray([int(SvcF.OOM), int(SvcF.IMAGE),
-                        int(SvcF.CONFIG), int(SvcF.PENDING)])
+                        int(SvcF.CONFIG), int(SvcF.PENDING),
+                        int(SvcF.SILENT)])
     soft = jnp.asarray([int(SvcF.ERROR_RATE), int(SvcF.LATENCY),
                         int(SvcF.EVENTS), int(SvcF.LOG_ERRORS),
                         int(SvcF.RESOURCE)])
@@ -370,7 +375,8 @@ def shippability_report(
         # evidence (for a lone 1.0 channel the noisy-OR IS the weight —
         # this is exactly what the observed crash-only round-3 fit
         # violated: image/config/pending/oom all fitted to ~0.03)
-        chans = (SvcF.OOM, SvcF.IMAGE, SvcF.CONFIG, SvcF.PENDING)
+        chans = (SvcF.OOM, SvcF.IMAGE, SvcF.CONFIG, SvcF.PENDING,
+                 SvcF.SILENT)
         channel_floor = {
             ch.name.lower(): {
                 "a": round(float(p.anomaly_weights[ch]), 3),
@@ -508,6 +514,14 @@ def load_params_json(path: str) -> PropagationParams:
         data = json.load(f)
     _require_formula_version(int(data.get("formula_version", 1)), path)
     n = NUM_SERVICE_FEATURES
+    short = min(len(data["anomaly_weights"]), len(data["hard_weights"]))
+    if short < n:
+        raise ValueError(
+            f"checkpoint {path} carries {short} weight channels but this "
+            f"engine's feature schema has {n} "
+            "(rca_tpu.features.schema.SvcF grew since it was trained) — "
+            "retrain with `rca train`."
+        )
     return PropagationParams(
         anomaly_weights=tuple(float(x) for x in data["anomaly_weights"][:n]),
         hard_weights=tuple(float(x) for x in data["hard_weights"][:n]),
@@ -554,6 +568,13 @@ def load_params(path: str) -> PropagationParams:
     tree = ckptr.restore(p.absolute())
     _require_formula_version(int(tree.get("formula_version", 1)), path)
     n = NUM_SERVICE_FEATURES
+    short = min(len(np.asarray(tree["anomaly_weights"])),
+                len(np.asarray(tree["hard_weights"])))
+    if short < n:
+        raise ValueError(
+            f"checkpoint {path} carries {short} weight channels but this "
+            f"engine's feature schema has {n} — retrain with `rca train`."
+        )
     aw = tuple(float(x) for x in np.asarray(tree["anomaly_weights"])[:n])
     hw = tuple(float(x) for x in np.asarray(tree["hard_weights"])[:n])
     return PropagationParams(

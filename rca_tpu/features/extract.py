@@ -30,6 +30,7 @@ from rca_tpu.features.schema import (
     NUM_SERVICE_FEATURES,
     PodF,
     SvcF,
+    derive_silent_channel,
 )
 
 _PHASES = {
@@ -247,6 +248,9 @@ def extract_features(snapshot: ClusterSnapshot) -> FeatureSet:
             )
             if not has_addr:
                 svc[j, SvcF.NOT_READY] = 1.0
+
+    # -- derived absence evidence (after endpoints finalize NOT_READY) -----
+    derive_silent_channel(svc)
 
     # -- traces: error rates + latency degradation -------------------------
     traces = snapshot.traces or {}

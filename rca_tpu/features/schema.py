@@ -65,8 +65,38 @@ class SvcF(enum.IntEnum):
     CONFIG = 9       # config/secret reference failure signal
     PENDING = 10     # unschedulable/pending fraction
     OOM = 11         # OOM-kill signal
+    # DERIVED absence-evidence channel (VERDICT r3 item 4): not-ready with
+    # zero crash/restart/log evidence.  A crashing pod proves it STARTED;
+    # an image-pull / unschedulable / config-error root never does — its
+    # victims crash and log while the root itself is silent, so "down but
+    # silent" is evidence of being a root in its own right, surviving
+    # adversarial dropout of the archetype's defining channel.  Computed by
+    # :func:`derive_silent_channel` in BOTH the extractor and the
+    # generator; never observed directly, so dropout never applies to it.
+    SILENT = 12
 
 
+# raw (observed) channels: everything before the derived block
+NUM_RAW_SERVICE_FEATURES = int(SvcF.SILENT)
 NUM_SERVICE_FEATURES = len(SvcF)
 
 SERVICE_FEATURE_NAMES = [f.name.lower() for f in SvcF]
+
+
+def derive_silent_channel(svc_features) -> None:
+    """Fill ``SvcF.SILENT`` in-place from the raw channels of a
+    ``[S, NUM_SERVICE_FEATURES]`` float array: the not-ready level damped
+    by every channel that proves the workload actually ran (crashes,
+    restarts, log output).  Quiet healthy services score ~0 (their
+    not_ready is ~0); crash/oom roots score ~0 (their crash channel is
+    high); an image/pending/config root whose pod never started scores
+    near its not_ready level."""
+    import numpy as np
+
+    f = svc_features
+    ran = (
+        (1.0 - np.clip(f[:, SvcF.CRASH], 0.0, 1.0))
+        * (1.0 - np.clip(f[:, SvcF.RESTARTS], 0.0, 1.0))
+        * (1.0 - np.clip(f[:, SvcF.LOG_ERRORS], 0.0, 1.0))
+    )
+    f[:, SvcF.SILENT] = np.clip(f[:, SvcF.NOT_READY], 0.0, 1.0) * ran

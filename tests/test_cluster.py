@@ -73,8 +73,10 @@ def test_snapshot_capture(five_svc_client):
 
 
 def test_generator_arrays_ground_truth():
+    from rca_tpu.features.schema import NUM_SERVICE_FEATURES
+
     case = synthetic_cascade_arrays(200, n_roots=3, seed=1)
-    assert case.features.shape == (200, 12)
+    assert case.features.shape == (200, NUM_SERVICE_FEATURES)
     assert len(case.roots) == 3
     # roots carry a crash signal, non-roots essentially none
     crash = case.features[:, 0]
