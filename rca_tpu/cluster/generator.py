@@ -385,7 +385,11 @@ def synthetic_cascade_arrays(
         ).astype(np.float32)
 
     derive_silent_channel(feats)
-    anomaly = feats.max(axis=1)
+    # the naive max-anomaly baseline reads OBSERVED channels only: scoring
+    # the derived SILENT channel would credit "naive" with the analyzer's
+    # own engineered absence evidence (and break comparability with every
+    # pre-round-4 naive row)
+    anomaly = feats[:, :NUM_RAW].max(axis=1)
     names = None
     if n_services <= 4096:
         names = [f"svc-{i:05d}" for i in range(n_services)]

@@ -51,7 +51,7 @@ import numpy as np
 
 from rca_tpu.cluster.snapshot import ClusterSnapshot
 from rca_tpu.engine.runner import GraphEngine
-from rca_tpu.engine.streaming import StreamingSession
+from rca_tpu.engine.streaming import StreamingSession, make_streaming_session
 from rca_tpu.features.extract import extract_features
 from rca_tpu.graph.build import service_dependency_edges
 
@@ -85,9 +85,14 @@ class LiveStreamingSession:
         self.client = client
         self.namespace = namespace
         self.k = k
-        # single-device by design: see StreamingSession.__init__ — the
-        # donated-buffer delta-scatter session has no sharded twin yet
-        self.engine = engine or GraphEngine()
+        # engine selection follows the analyze boundary (RCA_SHARD +
+        # visible devices): a sharded engine gets the sharded streaming
+        # session with its sp-sharded resident buffer (VERDICT r3 item 3)
+        if engine is None:
+            from rca_tpu.engine.sharded_runner import make_engine
+
+            engine = make_engine()
+        self.engine = engine
         self.topology_check_every = max(1, int(topology_check_every))
         self._polls = 0
         self.resyncs = -1  # first _resync is initialization, not a resync
@@ -133,7 +138,7 @@ class LiveStreamingSession:
         self._names = list(fs.service_names)
         self._edge_key = (src.tobytes(), dst.tobytes())
         self._features = np.array(fs.service_features, np.float32)
-        self.session = StreamingSession(
+        self.session = make_streaming_session(
             self._names, src, dst,
             num_features=self._features.shape[1],
             engine=self.engine, k=self.k,

@@ -59,7 +59,97 @@ def _flush_propagate_ranked(
     return features, vals, topi
 
 
-class StreamingSession:
+def make_streaming_session(
+    names: Sequence[str],
+    dep_src: np.ndarray,
+    dep_dst: np.ndarray,
+    num_features: int,
+    engine=None,
+    k: int = 5,
+):
+    """Streaming session matched to the engine kind: a
+    :class:`rca_tpu.parallel.streaming.ShardedStreamingSession` when the
+    engine is sharded (VERDICT r3 item 3 — 50k live ticks on the mesh),
+    else the single-device :class:`StreamingSession`."""
+    from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+    if isinstance(engine, ShardedGraphEngine):
+        from rca_tpu.parallel.streaming import ShardedStreamingSession
+
+        return ShardedStreamingSession(
+            names, dep_src, dep_dst, num_features=num_features,
+            engine=engine, k=k,
+        )
+    return StreamingSession(
+        names, dep_src, dep_dst, num_features=num_features,
+        engine=engine, k=k,
+    )
+
+
+class StreamingHostState:
+    """Host-side state every streaming session shares (dense and sharded):
+    the pending-delta dict, the padded delta packing, the upload-rows
+    accounting, and the ranked-output rendering.  One definition so the
+    documented invariants — rows copied on update (callers reuse scratch
+    buffers), deltas cleared only AFTER the dispatch is accepted, set_all's
+    bulk upload reported by the next tick — cannot drift between the two
+    session kinds."""
+
+    # set by subclasses: names, k, _n, _n_pad, _num_features
+    def _init_host_state(self) -> None:
+        # pending row updates, keyed by service index (last write wins, so
+        # the scatter never carries duplicate indices)
+        self._pending: Dict[int, np.ndarray] = {}
+        self.ticks = 0
+        self.last_upload_rows = 0  # padded rows uploaded by the last flush
+        self._bulk_upload = 0      # set by set_all; reported by next tick
+
+    def update(self, service_index: int, features: np.ndarray) -> None:
+        """Replace one service's feature row (delta update between ticks)."""
+        # copy: callers may reuse one scratch buffer across update() calls
+        self._pending[int(service_index)] = np.array(features, np.float32)
+
+    def update_many(self, rows: Dict[int, np.ndarray]) -> None:
+        for i, f in rows.items():
+            self.update(i, f)
+
+    def _pack_pending(self, drop_index: int):
+        """Pending deltas as power-of-two-padded (count, idx, rows); pad
+        slots point at ``drop_index`` (the dense session's dummy row / the
+        sharded session's out-of-bounds sentinel)."""
+        u = len(self._pending)
+        u_pad = 1 << max(0, (u - 1).bit_length()) if u else 1
+        idx_h = np.full(u_pad, drop_index, np.int32)
+        rows_h = np.zeros((u_pad, self._num_features), np.float32)
+        for j, (i, f) in enumerate(self._pending.items()):
+            idx_h[j] = i
+            rows_h[j] = f
+        return u, u_pad, idx_h, rows_h
+
+    def _account_upload(self, uploaded_rows: int) -> int:
+        """Drop the applied deltas and fold in any preceding set_all.
+        Call only once the dispatch is accepted — a raise before this must
+        leave the deltas retryable."""
+        self._pending.clear()
+        total = uploaded_rows + self._bulk_upload
+        self._bulk_upload = 0
+        self.last_upload_rows = total
+        return total
+
+    def _render_tick(self, vals, idx, latency_ms: float) -> Dict[str, object]:
+        ranked: List[dict] = []
+        for j, i in enumerate(np.asarray(idx).tolist()):
+            if i >= self._n or len(ranked) >= self.k:
+                continue
+            ranked.append(
+                {"component": self.names[i], "score": float(np.asarray(vals)[j])}
+            )
+        self.ticks += 1
+        return {"ranked": ranked, "latency_ms": latency_ms,
+                "tick": self.ticks, "upload_rows": self.last_upload_rows}
+
+
+class StreamingSession(StreamingHostState):
     def __init__(
         self,
         names: Sequence[str],
@@ -69,13 +159,6 @@ class StreamingSession:
         engine: Optional[GraphEngine] = None,
         k: int = 5,
     ):
-        # deliberately the SINGLE-device engine even when RCA_SHARD is set:
-        # a streaming session's whole design is a device-resident feature
-        # buffer updated by donated-argument scatters, which has no sharded
-        # twin yet — a sharded session would need a per-shard delta scatter
-        # and a sharded resident buffer (future work, not a one-line swap;
-        # make_engine() returns engines without the _aw/_hw weight handles
-        # this class scatters with)
         self.engine = engine or GraphEngine()
         self.names = list(names)
         self.k = k
@@ -96,23 +179,8 @@ class StreamingSession:
         # hybrid layout's upstream table, built once for the session
         self._up_ell = up_ell_for(self._n_pad, dep_src, dep_dst)
         self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
-        # pending row updates, keyed by service index (last write wins, so
-        # the scatter never carries duplicate indices)
-        self._pending: Dict[int, np.ndarray] = {}
         self._kk = min(k + 8, self._n_pad)
-        self.ticks = 0
-        self.last_upload_rows = 0  # padded rows uploaded by the last flush
-        self._bulk_upload = 0  # set by set_all; reported by the next tick
-
-    # -- host-side incremental state --------------------------------------
-    def update(self, service_index: int, features: np.ndarray) -> None:
-        """Replace one service's feature row (delta update between ticks)."""
-        # copy: callers may reuse one scratch buffer across update() calls
-        self._pending[int(service_index)] = np.array(features, np.float32)
-
-    def update_many(self, rows: Dict[int, np.ndarray]) -> None:
-        for i, f in rows.items():
-            self.update(i, f)
+        self._init_host_state()
 
     def set_all(self, features: np.ndarray) -> None:
         """Full re-upload (session start or resync) — the one bulk path.
@@ -132,13 +200,7 @@ class StreamingSession:
         t0 = time.perf_counter()
         if self._pending:
             # fused path: scatter + propagate + top-k in a single dispatch
-            u = len(self._pending)
-            u_pad = 1 << max(0, (u - 1).bit_length())
-            idx_h = np.full(u_pad, self._n_pad - 1, np.int32)
-            rows_h = np.zeros((u_pad, self._num_features), np.float32)
-            for j, (i, f) in enumerate(self._pending.items()):
-                idx_h[j] = i
-                rows_h[j] = f
+            _, u_pad, idx_h, rows_h = self._pack_pending(self._n_pad - 1)
             self._features, vals, idx = _flush_propagate_ranked(
                 self._features, jnp.asarray(idx_h), jnp.asarray(rows_h),
                 self._edges, self.engine._aw, self.engine._hw,
@@ -147,13 +209,9 @@ class StreamingSession:
             )
             # only drop the deltas once the dispatch is accepted — a raise
             # above (fresh-tier compile failure) must leave them retryable
-            self._pending.clear()
-            # count a set_all that preceded this tick as well
-            self.last_upload_rows = u_pad + self._bulk_upload
-            self._bulk_upload = 0
+            self._account_upload(u_pad)
         else:
-            self.last_upload_rows = self._bulk_upload
-            self._bulk_upload = 0
+            self._account_upload(0)
             stacked, vals, idx = _propagate_ranked(
                 self._features, self._edges,
                 self.engine._aw, self.engine._hw,
@@ -164,13 +222,4 @@ class StreamingSession:
         # enqueue time on tunneled backends, under-measuring the tick
         vals, idx = jax.device_get((vals, idx))
         latency_ms = (time.perf_counter() - t0) * 1e3
-        ranked: List[dict] = []
-        for j, i in enumerate(idx.tolist()):
-            if i >= self._n or len(ranked) >= self.k:
-                continue
-            ranked.append(
-                {"component": self.names[i], "score": float(vals[j])}
-            )
-        self.ticks += 1
-        return {"ranked": ranked, "latency_ms": latency_ms,
-                "tick": self.ticks, "upload_rows": self.last_upload_rows}
+        return self._render_tick(vals, idx, latency_ms)

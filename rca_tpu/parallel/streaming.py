@@ -1,0 +1,171 @@
+"""Sharded streaming session: delta ticks over an sp-sharded resident buffer.
+
+Round-3's :class:`rca_tpu.engine.streaming.StreamingSession` pinned the
+feature matrix on ONE device and admitted it had no sharded twin
+(VERDICT r3 item 3) — so 50k live ticks could not use the sharded engine
+and the streaming row of BASELINE stopped at 10k single-chip.  This class
+is that twin:
+
+- the feature buffer lives sharded ``P("sp", None)`` across the mesh — no
+  device ever holds the full [n_pad, C] matrix;
+- each tick ships the (tiny, power-of-two-padded) delta rows replicated to
+  every device; each shard applies the subset landing in its node block
+  with a donated in-place scatter (out-of-block rows drop);
+- propagation runs the same per-block kernel as the sharded analyze path
+  (:func:`rca_tpu.parallel.sharded._propagate_block` — all_gather +
+  psum_scatter over ICI), so streaming and one-shot scores cannot drift;
+- the top-k is merged ON DEVICE: each shard reduces its block to k local
+  candidates, one small all_gather over 'sp' carries k·sp candidates, and
+  every device merges — the full score vector never leaves its shard;
+- scatter + propagate + top-k run as ONE jitted dispatch per tick, same
+  as the dense session (on tunneled TPUs each dispatch pays a host RTT).
+
+Tick results are parity-locked to the dense session by
+tests/test_parallel.py (same deltas → same ranking at 10k on the virtual
+8-device mesh) and exercised by ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rca_tpu.config import bucket_for
+from rca_tpu.parallel.sharded import ShardedGraph, _propagate_block
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_tick_fn(
+    mesh: Mesh, steps: int, decay: float, mu: float, beta: float,
+    kk: int, block: int,
+):
+    """One compiled scatter+propagate+top-k per (mesh, params, k, block);
+    delta width and edge shapes key jit's shape cache underneath."""
+
+    def per_device(f_blk, idx, rows, src_l, src_g, dst_g, mask, n_live,
+                   aw, hw):
+        # f_blk: [block, C] this shard's node rows (donated).
+        # idx/rows: [U] / [U, C], replicated; rows outside this shard's
+        # block are redirected to an out-of-bounds index and dropped.
+        src_l, src_g = src_l[0], src_g[0]
+        dst_g, mask = dst_g[0], mask[0]
+        blk = jax.lax.axis_index("sp")
+        local = idx - blk * block
+        inside = (local >= 0) & (local < block)
+        safe = jnp.where(inside, local, block)       # block == OOB
+        f_blk = f_blk.at[safe].set(rows, mode="drop")
+        stack = _propagate_block(
+            f_blk, src_l, src_g, dst_g, mask, n_live, aw, hw,
+            steps=steps, decay=decay, mu=mu, beta=beta,
+        )
+        score_blk = stack[3]
+        # distributed top-k merge (same shape as sharded.sharded_topk,
+        # inlined so the whole tick is one dispatch)
+        k_local = min(kk, block)
+        v, i = jax.lax.top_k(score_blk, k_local)
+        gi = i + blk * block
+        vg = jax.lax.all_gather(v, "sp", tiled=True)     # [sp * k_local]
+        ig = jax.lax.all_gather(gi, "sp", tiled=True)
+        vv, pos = jax.lax.top_k(vg, kk)
+        return f_blk, vv, jnp.take(ig, pos)
+
+    shard_fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(
+            P("sp", None),               # resident features
+            P(), P(),                    # delta idx / rows (replicated)
+            P("sp", None), P("sp", None), P("sp", None), P("sp", None),
+            P(), P(), P(),
+        ),
+        out_specs=(P("sp", None), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn, donate_argnums=(0,))
+
+
+from rca_tpu.engine.streaming import StreamingHostState
+
+
+class ShardedStreamingSession(StreamingHostState):
+    """Drop-in twin of :class:`rca_tpu.engine.streaming.StreamingSession`
+    running on a :class:`rca_tpu.engine.sharded_runner.ShardedGraphEngine`
+    mesh."""
+
+    def __init__(
+        self,
+        names: Sequence[str],
+        dep_src: np.ndarray,
+        dep_dst: np.ndarray,
+        num_features: int,
+        engine=None,
+        k: int = 5,
+    ):
+        from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+        self.engine = engine or ShardedGraphEngine()
+        self.names = list(names)
+        self.k = k
+        n = len(self.names)
+        self._n = n
+        self._num_features = num_features
+        self.mesh = self.engine._exec_mesh
+        graph: ShardedGraph = self.engine._shard(
+            n, np.asarray(dep_src, np.int32), np.asarray(dep_dst, np.int32)
+        )
+        self._graph = graph
+        self._n_pad = graph.n_pad
+        self._block = graph.block
+        self._n_live = jnp.asarray(n, jnp.int32)
+        self._kk = min(k + 8, graph.n_pad)
+        edge_sharding = NamedSharding(self.mesh, P("sp", None))
+        self._edge_args = tuple(
+            jax.device_put(jnp.asarray(x), edge_sharding)
+            for x in (graph.src_local, graph.src_global,
+                      graph.dst_global, graph.mask)
+        )
+        p = self.engine.params
+        self._aw, self._hw = (jnp.asarray(w) for w in p.weight_arrays())
+        self._fn = _jitted_tick_fn(
+            self.mesh, p.steps, p.decay, p.explain_strength, p.impact_bonus,
+            self._kk, self._block,
+        )
+        self._feat_sharding = NamedSharding(self.mesh, P("sp", None))
+        self._features = jax.device_put(
+            jnp.zeros((self._n_pad, num_features), jnp.float32),
+            self._feat_sharding,
+        )
+        self._init_host_state()
+
+    def set_all(self, features: np.ndarray) -> None:
+        f = np.zeros((self._n_pad, self._num_features), np.float32)
+        f[: len(features)] = features
+        self._features = jax.device_put(
+            jnp.asarray(f), self._feat_sharding
+        )
+        self._pending.clear()
+        self._bulk_upload = self._n_pad
+
+    # -- tick ---------------------------------------------------------------
+    def tick(self) -> Dict[str, object]:
+        t0 = time.perf_counter()
+        # pad slots target index n_pad: out of range for EVERY shard, so
+        # the scatter drops them (quiet ticks run the same executable)
+        u, u_pad, idx_h, rows_h = self._pack_pending(self._n_pad)
+        with self.mesh:
+            self._features, vals, idx = self._fn(
+                self._features, jnp.asarray(idx_h), jnp.asarray(rows_h),
+                *self._edge_args, self._n_live, self._aw, self._hw,
+            )
+        # deltas drop only once the dispatch is accepted (retryable on a
+        # compile failure), matching the dense session's contract
+        self._account_upload(u_pad if u else 0)
+        vals, idx = jax.device_get((vals, idx))
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        return self._render_tick(vals, idx, latency_ms)

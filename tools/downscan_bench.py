@@ -1,0 +1,296 @@
+"""Down-scan (impact) step-cost breakdown + candidate kernels at 10k/50k.
+
+The 50k propagation is bound by the 8 serial down-scan steps
+(PERF.md edge-layout study: ~33 ns/edge attributed to TPU scatter
+serialization).  VERDICT r3 item 1 asks for either a log-depth operator
+doubling or a Pallas dst-sorted segment-scan.  Doubling loses on paper —
+reaching depth 8 needs |A^<=8| = 13.9x the edges at 50k (measured on the
+generator), and scatter cost is per-edge — so before building anything
+this script ATTRIBUTES the step cost:
+
+- ``coo``        : the production step (gather src + scatter-add dst).
+- ``gather_only``: same chain with the scatter replaced by a cheap
+  reduction — isolates the E-sized gather's share.
+- ``scatter_only``: same chain with the gather replaced by a broadcast —
+  isolates the scatter's share.
+- ``xla_cumsum`` : dst-sorted edges, jnp.cumsum + boundary gather
+  (the round-3 rejected candidate, as the XLA reference point).
+- ``pallas_cumsum``: dst-sorted edges, single-pass in-VMEM Pallas cumsum
+  + boundary gather (the round-4 candidate: one kernel, no log-depth HBM
+  passes, no per-edge serialization).
+
+Every variant runs the REAL 8-step serial recursion (each step consumes
+the previous step's m), timed by the marginal method (t_2R - t_R)/R with
+fori_loop reps and per-dispatch salt, synced through a fetch — the same
+methodology as bench.py / PERF.md.  Parity vs the coo step is asserted
+before timing (1e-4 tolerance; cumsum reassociates float adds).
+
+Run on the real TPU:  python tools/downscan_bench.py --n 50000
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.append(_REPO_ROOT)
+
+import jax
+import jax.numpy as jnp
+
+from rca_tpu.cluster.generator import synthetic_cascade_arrays
+from rca_tpu.config import RCAConfig, bucket_for
+
+LANES = 128
+SUBLANES = 8
+
+
+# ---------------------------------------------------------------------------
+# Pallas single-pass cumsum over a VMEM-resident [R, 128] layout
+# ---------------------------------------------------------------------------
+
+def _segscan_kernel(x_ref, f_ref, out_ref):
+    """SEGMENTED inclusive scan over row-major [R, 128]: ``S[e]`` is the
+    running sum since the last segment boundary (``f == 1`` marks a
+    segment's FIRST element).  Unlike a global cumsum + boundary diff,
+    accumulation never crosses a segment, so float error is bounded by the
+    longest segment (the max in-degree hub), not by the whole edge array —
+    the global-cumsum variant measured 5e-3 off after 8 chained steps at
+    50k, this one ~1e-6.
+
+    Flagged Hillis-Steele at two levels, all static shapes and all vector
+    ops (no scalar VMEM stores — Mosaic forbids them):
+
+    1. lane-level (7 shift-add passes along lanes): for shift k,
+       ``v += shifted(v) * (1 - f)`` and ``f |= shifted(f)`` — a value
+       never absorbs across a boundary at or before it;
+    2. row-level: the same flagged scan over the [R, 1] row aggregates
+       along the SUBLANE axis (log2(R) passes) yields each row's
+       inclusive carry; shifting it down one row gives the carry entering
+       each row, which lands on the lanes before the row's first boundary.
+    """
+    v = x_ref[...]                       # [R, 128] f32
+    f = f_ref[...]                       # [R, 128] f32, 1 = segment start
+    R = v.shape[0]
+
+    for k in (1, 2, 4, 8, 16, 32, 64):
+        # shift in a virtual prefix of (v=0, f=1): nothing flows in from
+        # before the row; row carry is applied at level 2
+        v_s = jnp.pad(v, ((0, 0), (k, 0)))[:, :-k]
+        # zero-pad BOTH: the virtual prefix carries no boundary (padding a
+        # boundary flag in would poison the final (1 - f) carry gate at
+        # every row start) and no value (so nothing is absorbed across the
+        # row edge regardless of the flag)
+        f_s = jnp.pad(f, ((0, 0), (k, 0)))[:, :-k]
+        v = v + v_s * (1.0 - f)
+        f = jnp.maximum(f, f_s)
+
+    # row-level flagged scan on FULL-LANE broadcasts: Mosaic cannot concat
+    # 1-lane [R, 1] vectors along sublanes ("offset mismatch on non-concat
+    # dimension"), but [R, 128] full-lane shifts lower fine and the extra
+    # lanes are free VPU width
+    zero_row = jnp.zeros((1, LANES), dtype=v.dtype)
+    cv = v[:, -1:] + zero_row            # [R, 128], all lanes equal
+    cf = f[:, -1:] + zero_row
+    k = 1
+    while k < R:
+        v_s = jnp.pad(cv, ((k, 0), (0, 0)))[:-k, :]
+        f_s = jnp.pad(cf, ((k, 0), (0, 0)))[:-k, :]
+        cv = cv + v_s * (1.0 - cf)
+        cf = jnp.maximum(cf, f_s)
+        k *= 2
+    # inclusive row carry, shifted down one row = carry ENTERING each row
+    carry_in = jnp.pad(cv, ((1, 0), (0, 0)))[:-1, :]
+    out_ref[...] = v + (1.0 - f) * carry_in
+
+
+def pallas_segscan(x_flat: jnp.ndarray, flags_flat: jnp.ndarray) -> jnp.ndarray:
+    """Segmented inclusive scan of a flat [N] array (N % 128 == 0)."""
+    from jax.experimental import pallas as pl
+
+    N = x_flat.shape[0]
+    R = N // LANES
+    out = pl.pallas_call(
+        _segscan_kernel,
+        out_shape=jax.ShapeDtypeStruct((R, LANES), jnp.float32),
+        interpret=os.environ.get("SEGSCAN_INTERPRET") == "1",
+    )(x_flat.reshape(R, LANES), flags_flat.reshape(R, LANES))
+    return out.reshape(N)
+
+
+# ---------------------------------------------------------------------------
+# step variants (all compute m_{k+1} from m_k with the SAME semantics)
+# ---------------------------------------------------------------------------
+
+def make_variants(n_pad, e_pad, case):
+    """Returns dict name -> (step_fn(m, aux) -> m_new, aux) plus the
+    dst-sorted metadata shared by the cumsum variants."""
+    dummy = n_pad - 1
+    src = np.full(e_pad, dummy, np.int32)
+    dst = np.full(e_pad, dummy, np.int32)
+    src[: len(case.dep_src)] = case.dep_src
+    dst[: len(case.dep_dst)] = case.dep_dst
+
+    # dst-sorted copies + per-service boundary rows (padded edges land in
+    # the dummy service's run, whose output row is zeroed anyway)
+    order = np.argsort(dst, kind="stable")
+    src_sorted = src[order]
+    dst_sorted = dst[order]
+    counts = np.bincount(dst_sorted, minlength=n_pad)
+    ends = np.cumsum(counts)            # [n_pad] end position per service
+    starts = ends - counts
+
+    rng = np.random.default_rng(0)
+    a_ex = jnp.asarray(
+        np.maximum(rng.uniform(-0.5, 0.8, n_pad), 0.0), jnp.float32
+    )
+    deg = np.maximum(counts, 1.0).astype(np.float32)
+    inv_deg = jnp.asarray(1.0 / deg)
+    decay = 0.7
+
+    sj = jnp.asarray(src)
+    dj = jnp.asarray(dst)
+    ssj = jnp.asarray(src_sorted)
+    startsj = jnp.asarray(np.maximum(ends - counts, 0).astype(np.int32))
+    endsj = jnp.asarray((ends - 1).clip(0).astype(np.int32))
+    has_edges = jnp.asarray((counts > 0).astype(np.float32))
+
+    def coo_step(m):
+        vals = a_ex[sj] + decay * m[sj]
+        return jnp.zeros_like(m).at[dj].add(vals) * inv_deg
+
+    def gather_only_step(m):
+        vals = a_ex[sj] + decay * m[sj]
+        # fold the gathered values without a scatter: keeps the serial
+        # dependence and the gather, drops the scatter
+        return (m + vals.sum() * 1e-9) * (inv_deg * 0 + 1.0) * 0.99 + (
+            a_ex * 0.01
+        )
+
+    def scatter_only_step(m):
+        # no gather: edge values derived from a scalar of m (serial dep)
+        vals = a_ex[:e_pad] if e_pad <= n_pad else jnp.pad(
+            a_ex, (0, e_pad - n_pad)
+        )
+        vals = vals + m.sum() * 1e-9
+        return jnp.zeros_like(m).at[dj].add(vals) * inv_deg
+
+    def xla_cumsum_step(m):
+        vals = a_ex[ssj] + decay * m[ssj]
+        c = jnp.cumsum(vals)
+        seg = jnp.where(
+            has_edges > 0, c[endsj] - jnp.where(startsj > 0,
+                                                c[startsj - 1], 0.0), 0.0
+        )
+        return seg * inv_deg
+
+    # segment-start flags for the segmented scan (first edge of each
+    # service's dst-sorted run)
+    flags = np.zeros(e_pad, np.float32)
+    flags[np.maximum(ends - counts, 0)[counts > 0]] = 1.0
+    flagsj = jnp.asarray(flags)
+
+    def pallas_segscan_step(m):
+        vals = a_ex[ssj] + decay * m[ssj]
+        s = pallas_segscan(vals, flagsj)
+        # S at each segment's LAST edge is the segment total — no
+        # subtraction, no cross-segment accumulation
+        seg = jnp.where(has_edges > 0, s[endsj], 0.0)
+        return seg * inv_deg
+
+    return {
+        "coo": coo_step,
+        "gather_only": gather_only_step,
+        "scatter_only": scatter_only_step,
+        "xla_cumsum": xla_cumsum_step,
+        "pallas_segscan": pallas_segscan_step,
+    }, a_ex
+
+
+def chain(step_fn, steps=8):
+    """reps x (8-step chain) inside one jit — the tunnel RTT (~90-115 ms
+    per dispatch) dwarfs device compute, so only the marginal
+    (t_2R - t_R)/R isolates the chain cost (PERF.md methodology)."""
+    def make(reps):
+        @jax.jit
+        def run(m0, salt):
+            def rep_body(j, m):
+                def body(i, m):
+                    return step_fn(m * (1.0 + salt + j * 1e-9 + i * 1e-9))
+                return jax.lax.fori_loop(0, steps, body, m0 + m * 1e-9)
+            return jax.lax.fori_loop(0, reps, rep_body, m0)
+        return run
+    return make
+
+
+def marginal_chain_ms(make, m0, reps=8, outer=8):
+    """Marginal cost of ONE 8-step chain: (min t_2R - min t_R) / R."""
+
+    def min_total(r):
+        run = make(r)
+        jax.device_get(run(m0, jnp.float32(1e-7))[:4])
+        outs = []
+        for j in range(outer):
+            salt = jnp.float32((j + 2) * 1e-7)
+            t0 = time.perf_counter()
+            jax.device_get(run(m0, salt)[:4])
+            outs.append((time.perf_counter() - t0) * 1e3)
+        return float(np.min(outs))
+
+    for _ in range(3):
+        t_r = min_total(reps)
+        t_2r = min_total(2 * reps)
+        if t_2r > t_r:
+            return (t_2r - t_r) / reps
+        reps *= 4
+    return float("nan")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=50_000)
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    print(f"backend: {jax.devices()[0].platform} ({jax.devices()[0]})")
+    case = synthetic_cascade_arrays(args.n, n_roots=3, seed=0)
+    buckets = RCAConfig().shape_buckets
+    n_pad = bucket_for(args.n + 1, buckets)
+    e_pad = bucket_for(len(case.dep_src), buckets)
+    print(f"n={args.n} n_pad={n_pad} E={len(case.dep_src)} e_pad={e_pad}")
+
+    variants, a_ex = make_variants(n_pad, e_pad, case)
+    m0 = jnp.zeros(n_pad, jnp.float32)
+
+    # parity vs coo (gather_only / scatter_only are attribution probes,
+    # not candidates — they are exempt)
+    ref = np.asarray(
+        chain(variants["coo"], args.steps)(1)(m0, jnp.float32(0))
+    )
+    for name in ("xla_cumsum", "pallas_segscan"):
+        got = np.asarray(
+            chain(variants[name], args.steps)(1)(m0, jnp.float32(0))
+        )
+        err = np.abs(got - ref).max()
+        print(f"parity {name}: max|diff|={err:.3e}")
+        # xla_cumsum is the round-3 REJECTED reference: its global
+        # accumulation error (measured 5e-3 after 8 steps at 50k) is one
+        # of the reasons it was rejected — report, don't assert
+        if name == "pallas_segscan":
+            assert err < 1e-4, (name, err)
+
+    for name, step in variants.items():
+        ms = marginal_chain_ms(chain(step, args.steps), m0)
+        print(f"{name:14s}: marginal {args.steps}-step chain {ms:8.3f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
