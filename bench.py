@@ -137,7 +137,8 @@ def main(skip_accuracy: bool = False) -> int:
 
     from rca_tpu.engine.runner import up_ell_for
 
-    def make_many_prop_for(n_live, prop_fn, up_ell=None):
+    def make_many_prop_for(n_live, prop_fn, up_ell=None,
+                           down_seg=None, up_seg=None):
         def make_many(reps):
             @jax.jit
             def many(f, s, d, salt):
@@ -145,18 +146,27 @@ def main(skip_accuracy: bool = False) -> int:
                     # scale features per rep so XLA cannot hoist the body
                     score = prop_fn(
                         f * (1.0 + salt + i * 1e-7), s, d, n_live=n_live,
-                        up_ell=up_ell,
+                        up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
                     )[4]
                     return acc + score
                 return jax.lax.fori_loop(0, reps, body, jnp.zeros(f.shape[0]))
             return many
         return make_many
 
-    # measure the engine's REAL layout (hybrid by default)
-    big_up_ell = up_ell_for(bf.shape[0], big.dep_src, big.dep_dst)
+    # measure the engine's REAL layout: segscan when engaged for the tier
+    # (round 4 — the 50k default), hybrid up-table otherwise
+    from rca_tpu.engine.segscan import seg_layouts_for
+
+    big_down_seg, big_up_seg = seg_layouts_for(
+        bf.shape[0], len(bs), big.dep_src, big.dep_dst
+    )
+    big_up_ell = (
+        None if big_up_seg is not None
+        else up_ell_for(bf.shape[0], big.dep_src, big.dep_dst)
+    )
     big_ms = amort_min_ms(
-        make_many_prop_for(big_n, prop, big_up_ell), (bfj, bsj, bdj),
-        reps_in_jit=10,
+        make_many_prop_for(big_n, prop, big_up_ell, big_down_seg, big_up_seg),
+        (bfj, bsj, bdj), reps_in_jit=10,
     )
 
     # batched multi-hypothesis scoring (BASELINE.md 10k streaming row):
@@ -282,6 +292,19 @@ def main(skip_accuracy: bool = False) -> int:
     sweep_caps = [sweep_sess.poll()["capture_ms"] for _ in range(3)]
     live_quiet_ms = float(np.median(quiet_caps))
     live_sweep_ms = float(np.median(sweep_caps))
+
+    # forced feed expiry at 10k (VERDICT r3 item 6): trim the journal past
+    # the session's cursor and measure the GRACEFUL recovery capture — one
+    # pod re-list + value diff instead of the old full resync (which cost
+    # the sweep figure above)
+    old_cap = lw.journal_cap
+    lw.journal_cap = 2
+    for i in range(5):
+        lw.touch("pod", "live10k", f"ghost-{i}")
+    lw.journal_cap = old_cap
+    rec = lsess.poll()
+    live_recovery_ms = float(rec["capture_ms"])
+    live_recovered = bool(rec.get("recovered"))
 
     # -- 50k sharded STREAMING dryrun tick (VERDICT r3 item 3): the
     # sp-sharded resident-buffer session validated at full scale on the
@@ -415,10 +438,13 @@ def main(skip_accuracy: bool = False) -> int:
         "tick_upload_rows_10k": tick_upload_rows,
         "live_quiet_capture_ms_10k": round(live_quiet_ms, 3),
         "live_sweep_capture_ms_10k": round(live_sweep_ms, 3),
+        "live_recovery_capture_ms_10k": round(live_recovery_ms, 3),
+        "live_recovery_graceful": live_recovered,
         "sharded_stream_tick_50k_dryrun": shard_tick,
         "live_watch_capture_speedup": round(
             live_sweep_ms / max(live_quiet_ms, 1e-3), 1
         ),
+        "segscan_engaged_50k": big_down_seg is not None,
         "pallas_supported": bool(pallas_ok),
         "pallas_engaged": bool(pallas_enabled()),  # reflects RCA_PALLAS env
         "xla_noisyor_50k_ms": r(xla_nor_ms),

@@ -103,6 +103,11 @@ class LiveStreamingSession:
         # recover them with a full resync instead of serving stale rows
         # until the next periodic sweep (round-3 advisor finding)
         self._pending_resync = False
+        # set by expiry recovery: the lost notifications may have included
+        # topology/trace kinds the cheap recovery cannot verify, so the
+        # NEXT poll runs the full topology check instead of waiting up to
+        # ``topology_check_every`` polls
+        self._force_topology_check = False
         # optimistic: _resync's _reopen_feed does the one real probe —
         # probing here too would open a second feed (on a live cluster,
         # a second pair of watch-pump threads) just to throw it away
@@ -166,6 +171,96 @@ class LiveStreamingSession:
                 probe = {"supported": False}
             self._watch = bool(probe.get("supported"))
             self._cursor = probe.get("cursor")
+
+    def _refetch_pod_logs(self, pod: dict, name: str) -> Dict[str, str]:
+        """Per-container tail refetch — the ONE log-fetch policy shared by
+        the busy-poll patch path and expiry recovery."""
+        per_container: Dict[str, str] = {}
+        for c in pod.get("spec", {}).get("containers", []) or []:
+            try:
+                per_container[c["name"]] = self.client.get_pod_logs(
+                    self.namespace, name, container=c["name"],
+                    tail_lines=200,
+                )
+            except Exception:
+                per_container[c["name"]] = ""
+        return per_container
+
+    # -- expiry recovery ----------------------------------------------------
+    def _recover_from_expiry(self, t0: float) -> Dict[str, Any]:
+        """Graceful feed-expiry recovery (VERDICT r3 item 6): re-list the
+        pods ONCE, value-diff against the retained snapshot, refetch logs
+        only for pods that actually changed, and refresh the one-call
+        event/metric/trace payloads.  Recovery cost scales with drift, not
+        graph size — the previous behavior was a full resync (~726 ms
+        capture at 10k, BENCH_r03) for what is usually a handful of stale
+        rows.
+
+        The lost notifications may also have included topology or
+        trace-dependency kinds, which this cheap path cannot verify (the
+        edge rebuild is the most expensive host step) — so recovery FORCES
+        the full topology check on the very next poll instead of waiting
+        out ``topology_check_every``: the stale-edge window is bounded at
+        one tick regardless of the cadence setting."""
+        from rca_tpu.cluster.sanitize import sanitize_objects
+
+        snap = self._snap
+        self._reopen_feed()
+        if not self._watch:
+            # feed gone for good (client reconnected without support):
+            # fall back to the sweep strategy from here on
+            return self._poll_sweep()
+        new_pods = sanitize_objects(self.client.get_pods(self.namespace))
+        old_by_name = {
+            p.get("metadata", {}).get("name"): p for p in snap.pods
+        }
+        new_by_name = {
+            p.get("metadata", {}).get("name"): p for p in new_pods
+        }
+        changed = [
+            n for n, p in new_by_name.items() if old_by_name.get(n) != p
+        ]
+        removed = [n for n in old_by_name if n not in new_by_name]
+        logs = dict(snap.logs)
+        for n in removed:
+            logs.pop(n, None)
+        for n in changed:
+            logs[n] = self._refetch_pod_logs(new_by_name[n], n)
+        try:
+            traces = {
+                "latency": self.client.get_service_latency_stats(
+                    self.namespace),
+                "error_rates": self.client.get_error_rate_by_service(
+                    self.namespace),
+                "dependencies": self.client.get_service_dependencies(
+                    self.namespace),
+                "slow_ops": self.client.find_slow_operations(self.namespace),
+            }
+        except Exception:
+            traces = snap.traces
+        snap2 = dataclasses.replace(
+            snap,
+            captured_at=self.client.get_current_time(),
+            pods=new_pods,
+            logs=logs,
+            events=sanitize_objects(self.client.get_events(self.namespace)),
+            pod_metrics=self.client.get_pod_metrics(self.namespace) or {},
+            traces=traces,
+        )
+        self._force_topology_check = True
+        fs = extract_features(snap2)
+        if list(fs.service_names) != self._names:
+            # the service set itself moved while we were blind: full rebuild
+            self._resync(snap=snap2, fs=fs)
+            return self._finish(
+                t0, changed=len(self._names), resynced=True, quiet=False,
+            )
+        self._snap = snap2
+        n_changed = self._upload_diff(fs)
+        out = self._finish(t0, changed=n_changed, resynced=False, quiet=False)
+        out["recovered"] = True
+        out["drift_pods"] = len(changed) + len(removed)
+        return out
 
     # -- snapshot patching --------------------------------------------------
     def _patch_snapshot(self, changes: List[Dict[str, str]]) -> ClusterSnapshot:
@@ -238,16 +333,7 @@ class LiveStreamingSession:
                 if pod is None:
                     logs.pop(name, None)
                     continue
-                per_container: Dict[str, str] = {}
-                for c in pod.get("spec", {}).get("containers", []) or []:
-                    try:
-                        per_container[c["name"]] = self.client.get_pod_logs(
-                            self.namespace, name, container=c["name"],
-                            tail_lines=200,
-                        )
-                    except Exception:
-                        per_container[c["name"]] = ""
-                logs[name] = per_container
+                logs[name] = self._refetch_pod_logs(pod, name)
             patch["logs"] = logs
         if events_touched or pod_names:
             patch["events"] = sanitize_objects(
@@ -279,12 +365,17 @@ class LiveStreamingSession:
             return self._finish(
                 t0, changed=len(self._names), resynced=True, quiet=False,
             )
-        if self._polls % self.topology_check_every == 0:
+        if self._force_topology_check or (
+            self._polls % self.topology_check_every == 0
+        ):
             # periodic full check: trace data (edges AND error-rate/latency
             # features) can drift invisibly to the feed; drain it first so
             # the cursor stays current — and if the feed expired, reopen
             # it NOW (a sticky pump expiry would otherwise force a full
-            # resync on the very next poll, right after this sweep)
+            # resync on the very next poll, right after this sweep).
+            # ``_force_topology_check`` is expiry recovery pulling this
+            # check forward: lost notifications may have been topology.
+            self._force_topology_check = False
             resp = self.client.watch_changes(self.namespace, self._cursor)
             self._cursor = resp.get("cursor")
             if resp.get("expired"):
@@ -302,10 +393,13 @@ class LiveStreamingSession:
             return self._poll_sweep()
         self._cursor = resp.get("cursor")
         if resp.get("expired"):
-            self._resync()
-            return self._finish(
-                t0, changed=len(self._names), resynced=True, quiet=False,
-            )
+            try:
+                return self._recover_from_expiry(t0)
+            except Exception:
+                # recovery itself failed mid-flight: fall back to the full
+                # resync next poll (same contract as a failed sweep)
+                self._pending_resync = True
+                raise
         changes = resp.get("changes", [])
         if not changes:
             return self._finish(t0, changed=0, resynced=False, quiet=True)

@@ -168,13 +168,15 @@ def propagate(
     impact_bonus: float,
     n_live=None,            # real-service count; slots beyond are padding
     up_ell=None,            # optional (idx, mask, ovf_seg, ovf_other)
+    down_seg=None,          # optional engine.segscan.SegLayout
+    up_seg=None,            # optional engine.segscan.SegLayout
 ):
     """Returns (anomaly, hard, upstream, impact, score), all [S]."""
     a = _noisy_or(features, anomaly_w)
     h = _noisy_or(features, hard_w)
     return propagate_core(
         a, h, dep_src, dep_dst, steps, decay, explain_strength, impact_bonus,
-        n_live=n_live, up_ell=up_ell,
+        n_live=n_live, up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
     )
 
 
@@ -189,6 +191,8 @@ def propagate_core(
     impact_bonus: float,
     n_live=None,            # real-service count; slots beyond are padding
     up_ell=None,            # optional (idx, mask, ovf_seg, ovf_other)
+    down_seg=None,          # optional engine.segscan.SegLayout
+    up_seg=None,            # optional engine.segscan.SegLayout
 ):
     """Propagation given precomputed evidence vectors (lets the fused
     Pallas noisy-OR feed the same core).
@@ -204,12 +208,19 @@ def propagate_core(
     edges (dependents past the width cap) go through one small scatter-max.
     """
 
-    if up_ell is not None:
-        from rca_tpu.engine.ell import ell_up_step
-
-        up_idx, up_mask, up_ovf_seg, up_ovf_other = up_ell
+    if up_seg is not None:
+        # Pallas segmented-MAX layout (engine.segscan): one E-gather per
+        # step vs the ELL table's [S, 8] gathers; bit-identical (fp32 max
+        # is order-invariant)
+        from rca_tpu.engine.segscan import up_seg_step as _up_seg_step
 
         def up_step(u, _):
+            return _up_seg_step(u, h, decay, up_seg), None
+    elif up_ell is not None:
+        from rca_tpu.engine.ell import ell_up_step
+
+        def up_step(u, _):
+            up_idx, up_mask, up_ovf_seg, up_ovf_other = up_ell
             return ell_up_step(
                 u, h, decay, up_idx, up_mask, up_ovf_seg, up_ovf_other
             ), None
@@ -229,9 +240,19 @@ def propagate_core(
     deg = jnp.zeros_like(a).at[dep_dst].add(1.0)
     inv_deg = 1.0 / jnp.maximum(deg, 1.0)
 
-    def imp_step(m, _):
-        vals = a_ex[dep_src] + decay * m[dep_src]
-        return jnp.zeros_like(m).at[dep_dst].add(vals) * inv_deg, None
+    if down_seg is not None:
+        # Pallas segmented-scan layout (engine.segscan): replaces the
+        # per-edge-serialized scatter at large tiers — 12.5 -> 8.4 ms for
+        # the 8-step chain at 50k on v5e
+        from rca_tpu.engine.segscan import down_seg_step
+
+        def imp_step(m, _):
+            return down_seg_step(m, a_ex, decay, down_seg, inv_deg), None
+    else:
+
+        def imp_step(m, _):
+            vals = a_ex[dep_src] + decay * m[dep_src]
+            return jnp.zeros_like(m).at[dep_dst].add(vals) * inv_deg, None
 
     m, _ = jax.lax.scan(imp_step, jnp.zeros_like(a), None, length=steps)
 

@@ -114,6 +114,7 @@ def _propagate_ranked(
     features, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, use_pallas: bool = False, n_live=None, up_ell=None,
+    down_seg=None, up_seg=None,
 ):
     """One dispatch, minimal transfers: edges arrive as one [2, E] buffer;
     diagnostics leave as one stacked [4, S] buffer plus the top-k pair.
@@ -131,17 +132,46 @@ def _propagate_ranked(
         out = propagate_core(
             a, h, edges[0], edges[1],
             steps, decay, explain_strength, impact_bonus, n_live=n_live,
-            up_ell=up_ell,
+            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
         )
         a, h, u, m, score = out
     else:
         a, h, u, m, score = propagate(
             features, edges[0], edges[1], anomaly_w, hard_w,
             steps, decay, explain_strength, impact_bonus, n_live=n_live,
-            up_ell=up_ell,
+            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
         )
     vals, idx = jax.lax.top_k(score, k)
     return jnp.stack([a, u, m, score]), vals, idx
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus", "k",
+    ),
+)
+def _propagate_ranked_batch(
+    features_b, edges, anomaly_w, hard_w,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    k: int, n_live=None, up_ell=None, down_seg=None, up_seg=None,
+):
+    """Hypothesis batch over ONE graph in ONE dispatch: vmap of the
+    propagation + per-hypothesis top-k (BASELINE.json "pmap over fault
+    candidates" — on a single device the batch rides vmap lanes; the
+    sharded engine's dp axis covers multi-device batches)."""
+    from rca_tpu.engine.propagate import propagate
+
+    def one(f):
+        a, h, u, m, score = propagate(
+            f, edges[0], edges[1], anomaly_w, hard_w,
+            steps, decay, explain_strength, impact_bonus, n_live=n_live,
+            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        )
+        vals, idx = jax.lax.top_k(score, k)
+        return jnp.stack([a, u, m, score]), vals, idx
+
+    return jax.vmap(one)(features_b)
 
 
 @functools.partial(
@@ -290,6 +320,17 @@ class EngineAPI:
                        k=None, timed=False) -> "EngineResult":
         raise NotImplementedError
 
+    def analyze_batch(self, features_batch, dep_src, dep_dst, names=None,
+                      k=None) -> List["EngineResult"]:
+        """Score a batch of fault-hypothesis feature sets over ONE graph
+        in one dispatch (the multi-hypothesis path; VERDICT r3 item 7).
+        Default: loop analyze_arrays — engines override with a real
+        batched executable."""
+        return [
+            self.analyze_arrays(f, dep_src, dep_dst, names, k=k)
+            for f in features_batch
+        ]
+
     def analyze_case(self, case, k: Optional[int] = None, timed: bool = False):
         """Analyze a :class:`rca_tpu.cluster.generator.CascadeArrays`."""
         return self.analyze_arrays(
@@ -380,7 +421,22 @@ class GraphEngine(EngineAPI):
                 )
         else:
             ej = jnp.asarray(np.stack([s, d]))  # one [2, E] upload
-            up_ell = up_ell_for(f.shape[0], dep_src, dep_dst)
+            from rca_tpu.engine.segscan import seg_layouts_for
+
+            # segscan upgrades only the DEFAULT layout: an explicit
+            # RCA_EDGE_LAYOUT=coo stays pure COO (it is the documented
+            # A/B knob for the PERF.md layout study)
+            down_seg, up_seg = (
+                seg_layouts_for(f.shape[0], len(s), dep_src, dep_dst)
+                if layout == "hybrid" else (None, None)
+            )
+            # ...and replaces the hybrid up-table when engaged (one
+            # E-gather per step beats the [S, 8] table's gathers 2.5x at
+            # 50k; see PERF.md round-4 segscan study)
+            up_ell = (
+                None if up_seg is not None
+                else up_ell_for(f.shape[0], dep_src, dep_dst)
+            )
             from rca_tpu.engine.pallas_kernels import (
                 BLOCK_S,
                 pallas_enabled,
@@ -399,7 +455,7 @@ class GraphEngine(EngineAPI):
                 return _propagate_ranked(
                     fj, ej, self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
-                    use_pallas, n_live, up_ell,
+                    use_pallas, n_live, up_ell, down_seg, up_seg,
                 )
 
         stacked, vals, idx, latency_ms = timed_fetch(run, timed)
@@ -407,3 +463,55 @@ class GraphEngine(EngineAPI):
             stacked, vals, idx, names, n, k, latency_ms,
             int(len(dep_src)), engine="single",
         )
+
+    def analyze_batch(
+        self,
+        features_batch: np.ndarray,   # [B, S, C], one graph
+        dep_src: np.ndarray,
+        dep_dst: np.ndarray,
+        names: Optional[Sequence[str]] = None,
+        k: Optional[int] = None,
+    ) -> List[EngineResult]:
+        import time as _time
+
+        if edge_layout() == "ell":
+            # the pure-ELL executable has no batched twin; the base-class
+            # loop keeps batched scores identical to single analyses under
+            # that (measurement-only) layout
+            return super().analyze_batch(
+                features_batch, dep_src, dep_dst, names, k=k
+            )
+        B, n = features_batch.shape[0], features_batch.shape[1]
+        k = k or min(self.config.top_k_root_causes, n)
+        f0, s, d = self._pad(features_batch[0], dep_src, dep_dst)
+        fb = np.zeros((B, *f0.shape), np.float32)
+        fb[:, :n] = features_batch
+        ej = jnp.asarray(np.stack([s, d]))
+        from rca_tpu.engine.segscan import seg_layouts_for
+
+        # same layout selection as analyze_arrays (segscan upgrades only
+        # the hybrid default; up_ell_for is None for non-hybrid layouts)
+        down_seg, up_seg = (
+            seg_layouts_for(f0.shape[0], len(s), dep_src, dep_dst)
+            if edge_layout() == "hybrid" else (None, None)
+        )
+        up_ell = (
+            None if up_seg is not None
+            else up_ell_for(f0.shape[0], dep_src, dep_dst)
+        )
+        p = self.params
+        kk = min(k + 8, f0.shape[0])
+        t0 = _time.perf_counter()
+        stacked, vals, idx = jax.device_get(_propagate_ranked_batch(
+            jnp.asarray(fb), ej, self._aw, self._hw,
+            p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
+            jnp.asarray(n, jnp.int32), up_ell, down_seg, up_seg,
+        ))
+        latency_ms = (_time.perf_counter() - t0) * 1e3
+        return [
+            render_result(
+                stacked[b], vals[b], idx[b], names, n, k,
+                latency_ms / B, int(len(dep_src)), engine="single-batch",
+            )
+            for b in range(B)
+        ]

@@ -181,6 +181,44 @@ class ShardedGraphEngine(EngineAPI):
             engine=self.engine_tag,
         )
 
+    def analyze_batch(
+        self,
+        features_batch: np.ndarray,   # [B, S, C], one graph
+        dep_src: np.ndarray,
+        dep_dst: np.ndarray,
+        names=None,
+        k=None,
+    ):
+        """Hypothesis batch on the FULL mesh: hypotheses shard over 'dp'
+        (BASELINE.json "pmap over fault candidates"), nodes over 'sp'.
+        The batch pads up to a multiple of dp with zero hypotheses that
+        are dropped from the result."""
+        import time as _time
+
+        from rca_tpu.parallel.sharded import sharded_topk, stage_sharded
+
+        B, n = features_batch.shape[0], features_batch.shape[1]
+        k = k or min(self.config.top_k_root_causes, n)
+        graph = self._shard(n, dep_src, dep_dst)
+        B_pad = -(-B // self.dp) * self.dp
+        fb = np.zeros((B_pad, graph.n_pad, features_batch.shape[2]),
+                      np.float32)
+        fb[:B, :n] = features_batch
+        kk = min(k + 8, graph.n_pad)
+        t0 = _time.perf_counter()
+        stack = stage_sharded(self.mesh, fb, graph, self.params)()
+        vals, idx = sharded_topk(self.mesh, stack[:, 3], kk)
+        stack, vals, idx = jax.device_get((stack, vals, idx))
+        latency_ms = (_time.perf_counter() - t0) * 1e3
+        return [
+            render_result(
+                stack[b], vals[b], idx[b], names, n, k,
+                latency_ms / B, int(len(dep_src)),
+                engine=self.engine_tag + "-batch",
+            )
+            for b in range(B)
+        ]
+
 
 def shard_requested() -> Tuple[bool, Optional[str]]:
     """(use sharded engine?, spec) from ``RCA_SHARD`` + visible devices.

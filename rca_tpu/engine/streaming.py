@@ -41,7 +41,7 @@ from rca_tpu.engine.runner import GraphEngine, _propagate_ranked, up_ell_for
 def _flush_propagate_ranked(
     features, idx, rows, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
-    k: int, n_live, up_ell=None,
+    k: int, n_live, up_ell=None, down_seg=None, up_seg=None,
 ):
     """Whole tick in ONE dispatch: scatter the delta rows into the donated
     resident buffer, propagate, top-k.  On tunneled TPUs every dispatch pays
@@ -53,7 +53,7 @@ def _flush_propagate_ranked(
     a, h, u, m, score = propagate(
         features, edges[0], edges[1], anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, n_live=n_live,
-        up_ell=up_ell,
+        up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
     )
     vals, topi = jax.lax.top_k(score, k)
     return features, vals, topi
@@ -176,8 +176,20 @@ class StreamingSession(StreamingHostState):
         d[: len(dep_dst)] = dep_dst
         # edges + weights + FEATURES live on device for the whole session
         self._edges = jnp.asarray(np.stack([s, d]))
-        # hybrid layout's upstream table, built once for the session
-        self._up_ell = up_ell_for(self._n_pad, dep_src, dep_dst)
+        # segscan layouts at large tiers (same gate as the one-shot
+        # engine: hybrid default only; replaces the hybrid up-table when
+        # engaged), built once for the session's pinned edges
+        from rca_tpu.engine.runner import edge_layout
+        from rca_tpu.engine.segscan import seg_layouts_for
+
+        self._down_seg, self._up_seg = (
+            seg_layouts_for(self._n_pad, e_pad, dep_src, dep_dst)
+            if edge_layout() == "hybrid" else (None, None)
+        )
+        self._up_ell = (
+            None if self._up_seg is not None
+            else up_ell_for(self._n_pad, dep_src, dep_dst)
+        )
         self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
         self._kk = min(k + 8, self._n_pad)
         self._init_host_state()
@@ -205,7 +217,8 @@ class StreamingSession(StreamingHostState):
                 self._features, jnp.asarray(idx_h), jnp.asarray(rows_h),
                 self._edges, self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
-                self._kk, self._n_live, self._up_ell,
+                self._kk, self._n_live, self._up_ell, self._down_seg,
+                self._up_seg,
             )
             # only drop the deltas once the dispatch is accepted — a raise
             # above (fresh-tier compile failure) must leave them retryable
@@ -216,7 +229,8 @@ class StreamingSession(StreamingHostState):
                 self._features, self._edges,
                 self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
-                self._kk, False, self._n_live, self._up_ell,
+                self._kk, False, self._n_live, self._up_ell, self._down_seg,
+                self._up_seg,
             )
         # sync through the fetch: block_until_ready alone can return at
         # enqueue time on tunneled backends, under-measuring the tick
