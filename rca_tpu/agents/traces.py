@@ -127,12 +127,19 @@ class TracesAgent(Agent):
                     "alerts and capacity",
                 )
 
-        # viz payload: per-service latency percentiles (reference:
-        # components/visualization.py latency charts per service)
+        # viz payload: per-service latency percentiles + the dependency map
+        # (reference: components/visualization.py latency charts per service
+        # and the :516-646 service-dependency digraph)
         if lat:
             r.data["latency"] = {
                 name: stats for name, stats in sorted(lat.items())
                 if isinstance(stats, dict)
+            }
+        deps_map = traces.get("dependencies") or {}
+        if deps_map:
+            r.data["dependencies"] = {
+                src: sorted(dsts) for src, dsts in sorted(deps_map.items())
+                if dsts
             }
 
         summarize(r, "trace")

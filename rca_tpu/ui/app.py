@@ -14,6 +14,7 @@ import os
 
 from rca_tpu.ui.render import (
     analysis_chart_series,
+    comprehensive_chart_series,
     analysis_viz_data,
     correlated_markdown,
     diagnostic_timeline_markdown,
@@ -259,6 +260,10 @@ def main() -> None:  # pragma: no cover - needs streamlit runtime
                 st.markdown(
                     root_causes_markdown(results.get("correlated", {}))
                 )
+                # cross-agent overview (reference: visualization.py:38-236)
+                for chart in comprehensive_chart_series(results):
+                    st.caption(chart["title"])
+                    _render_chart(st, chart)
                 with st.expander("Full report"):
                     st.markdown(report_markdown(results))
             with sub[1]:
@@ -438,14 +443,20 @@ def _render_chart(st, chart) -> None:
     kind = chart.get("kind")
     if kind == "bar":
         thresholds = chart.get("thresholds") or []
-        if thresholds:
+        colors = chart.get("colors") or {}
+        if thresholds or colors:
             try:
                 import plotly.graph_objects as go
 
                 data = chart["data"]
-                fig = go.Figure(
-                    go.Bar(x=list(data.keys()), y=list(data.values()))
-                )
+                bar = go.Bar(x=list(data.keys()), y=list(data.values()))
+                if colors:
+                    bar.marker = {
+                        "color": [
+                            colors.get(k, "#888888") for k in data.keys()
+                        ]
+                    }
+                fig = go.Figure(bar)
                 for t in thresholds:
                     fig.add_hline(
                         y=t["value"], line_dash="dash",
@@ -454,10 +465,11 @@ def _render_chart(st, chart) -> None:
                 st.plotly_chart(fig, use_container_width=True)
                 return
             except ImportError:
-                st.caption(
-                    "thresholds: "
-                    + ", ".join(t.get("label", "") for t in thresholds)
-                )
+                if thresholds:
+                    st.caption(
+                        "thresholds: "
+                        + ", ".join(t.get("label", "") for t in thresholds)
+                    )
         st.bar_chart(chart["data"])
     elif kind == "findings_table":
         st.dataframe(
@@ -468,6 +480,69 @@ def _render_chart(st, chart) -> None:
             ],
             use_container_width=True,
         )
+    elif kind == "pie":
+        try:
+            import plotly.express as px
+
+            fig = px.pie(
+                values=list(chart["data"].values()),
+                names=list(chart["data"].keys()),
+                hole=chart.get("hole", 0),
+            )
+            st.plotly_chart(fig, use_container_width=True)
+        except ImportError:
+            st.bar_chart(chart["data"])
+    elif kind == "sunburst":
+        try:
+            import plotly.graph_objects as go
+
+            rows = chart["data"]
+            fig = go.Figure(go.Sunburst(
+                ids=[r["id"] for r in rows],
+                parents=[r["parent"] for r in rows],
+                values=[r["value"] for r in rows],
+                marker={"colors": [r["color"] for r in rows]},
+                branchvalues="total",
+            ))
+            st.plotly_chart(fig, use_container_width=True)
+        except ImportError:
+            # leaf rows only: component/severity -> count
+            st.dataframe([r for r in chart["data"] if r["parent"]])
+    elif kind == "bar_grouped":
+        series = chart.get("series", {})
+        try:
+            import plotly.graph_objects as go
+
+            fig = go.Figure([
+                go.Bar(name=name, x=list(vals.keys()),
+                       y=list(vals.values()))
+                for name, vals in series.items() if vals
+            ])
+            for t in chart.get("thresholds") or []:
+                fig.add_hline(
+                    y=t["value"], line_dash="dash",
+                    annotation_text=t.get("label", str(t["value"])),
+                )
+            fig.update_layout(barmode="group")
+            st.plotly_chart(fig, use_container_width=True)
+        except ImportError:
+            # wide-form rows: one column per series
+            keys = sorted({k for vals in series.values() for k in vals})
+            st.dataframe([
+                {"component": k,
+                 **{name: vals.get(k) for name, vals in series.items()}}
+                for k in keys
+            ])
+    elif kind == "digraph":
+        sev_icon = {"critical": "🔴", "high": "🟠", "medium": "🟡",
+                    "low": "🔵", "info": "⚪"}
+        st.dataframe([
+            {"from": f"{sev_icon.get(e.get('source_severity'), '⚪')} "
+                     f"{e['source']}",
+             "to": f"{sev_icon.get(e.get('target_severity'), '⚪')} "
+                   f"{e['target']}"}
+            for e in chart["data"]
+        ], use_container_width=True)
     else:
         st.dataframe(chart["data"])
 

@@ -11,11 +11,28 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List
 
+from rca_tpu.findings import SEVERITY_ORDER as _SEVERITY_ASC  # noqa: N811
 from rca_tpu.findings import max_severity
 
 SEVERITY_ICONS = {
     "critical": "🔴", "high": "🟠", "medium": "🟡", "low": "🔵", "info": "⚪",
 }
+
+# severity + node-type palettes mirror the reference's per-type renderers
+# (reference: components/visualization.py:424-431 severity color map,
+# :692-699 node-type colors) so chart specs carry the same visual language
+SEVERITY_COLORS = {
+    "critical": "#FF0000", "high": "#FF6B6B", "medium": "#FFAC4B",
+    "low": "#4B93FF", "info": "#6BCB77",
+}
+NODE_TYPE_COLORS = {
+    "service": "#00BFFF", "workload": "#FF6B6B", "deployment": "#FF6B6B",
+    "ingress": "#FFAC4B", "configmap": "#6BCB77", "secret": "#9775FA",
+    "unknown": "#CCCCCC",
+}
+# display order (most severe first) DERIVED from the canonical
+# ascending order in rca_tpu.findings — one source of severity truth
+SEVERITY_DISPLAY_ORDER = list(reversed(_SEVERITY_ASC))
 
 
 def initial_suggestions(namespace: str) -> List[Dict[str, Any]]:
@@ -99,24 +116,42 @@ def topology_plot_data(graph_dict: Dict[str, Any]) -> Dict[str, Any]:
         for k, i in enumerate(members):
             theta = 2 * math.pi * k / max(len(members), 1)
             pos[nodes[i]["id"]] = (r * math.cos(theta), r * math.sin(theta))
+    # node-type coloring + per-type/per-relation legends (reference:
+    # components/visualization.py:647-764 draws one colored scatter trace
+    # per node type and per edge type, with a legend entry each)
+    drawn = [
+        e for e in edges if e["source"] in pos and e["target"] in pos
+    ]
+    relation_counts: Dict[str, int] = {}
+    for e in drawn:
+        rel = e.get("relation", "") or "related"
+        relation_counts[rel] = relation_counts.get(rel, 0) + 1
     return {
         "nodes": [
             {"id": node["id"], "type": node.get("type", ""),
+             "color": NODE_TYPE_COLORS.get(
+                 node.get("type", ""), NODE_TYPE_COLORS["unknown"]),
              "x": pos[node["id"]][0], "y": pos[node["id"]][1]}
             for node in nodes
         ],
         "edges": [
             {
                 "source": e["source"], "target": e["target"],
-                "relation": e.get("relation", ""),
-                "x0": pos.get(e["source"], (0, 0))[0],
-                "y0": pos.get(e["source"], (0, 0))[1],
-                "x1": pos.get(e["target"], (0, 0))[0],
-                "y1": pos.get(e["target"], (0, 0))[1],
+                # same normalized label the legend counts, so legend
+                # entries always match drawable edge rows
+                "relation": e.get("relation", "") or "related",
+                "x0": pos[e["source"]][0],
+                "y0": pos[e["source"]][1],
+                "x1": pos[e["target"]][0],
+                "y1": pos[e["target"]][1],
             }
-            for e in edges
-            if e["source"] in pos and e["target"] in pos
+            for e in drawn
         ],
+        "node_legend": {
+            ntype: NODE_TYPE_COLORS.get(ntype, NODE_TYPE_COLORS["unknown"])
+            for ntype in sorted(by_type)
+        },
+        "edge_legend": dict(sorted(relation_counts.items())),
     }
 
 
@@ -145,13 +180,19 @@ def analysis_viz_data(agent_type: str, result: Dict[str, Any]) -> Dict[str, Any]
         out["pod_buckets"] = result.get("data", {}).get("pod_buckets", {})
     elif agent_type == "logs":
         patterns: Dict[str, int] = {}
+        comp_sev: Dict[str, Dict[str, int]] = {}
         for f in findings:
             ev = f.get("evidence")
             if isinstance(ev, dict) and ev.get("pattern"):
                 patterns[ev["pattern"]] = (
                     patterns.get(ev["pattern"], 0) + int(ev.get("count", 1))
                 )
+            comp = str(f.get("component", "unknown"))
+            sev = str(f.get("severity", "info")).lower()
+            comp_sev.setdefault(comp, {})
+            comp_sev[comp][sev] = comp_sev[comp].get(sev, 0) + 1
         out["pattern_counts"] = patterns
+        out["component_severity"] = comp_sev
     elif agent_type == "topology":
         out["graph"] = result.get("data", {}).get("graph", {})
         out["service_pod_mapping"] = result.get("data", {}).get(
@@ -166,9 +207,16 @@ def analysis_viz_data(agent_type: str, result: Dict[str, Any]) -> Dict[str, Any]
             and "error_rate" in f["evidence"]
         ]
         out["latency"] = result.get("data", {}).get("latency", {})
+        out["dependencies"] = result.get("data", {}).get("dependencies", {})
     elif agent_type == "events":
         out["reason_counts"] = result.get("data", {}).get("reason_counts", {})
         out["type_counts"] = result.get("data", {}).get("type_counts", {})
+        kind_counts: Dict[str, int] = {}
+        for f in findings:
+            comp = str(f.get("component", "unknown"))
+            kind = comp.split("/", 1)[0] if "/" in comp else comp
+            kind_counts[kind] = kind_counts.get(kind, 0) + 1
+        out["component_kind_counts"] = kind_counts
     # severity-tagged findings rows: the table every tab can render with
     # per-row severity coloring (reference: report/resource tables)
     out["finding_rows"] = [
@@ -195,13 +243,38 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
     charts: List[Dict[str, Any]] = []
     sev = viz.get("severity_histogram") or {}
     if sev:
-        order = ["critical", "high", "medium", "low", "info"]
         charts.append({
             "title": "Findings by severity", "kind": "bar",
-            "data": {s: sev[s] for s in order if s in sev},
+            "data": {s: sev[s] for s in SEVERITY_DISPLAY_ORDER if s in sev},
+            "colors": {
+                s: SEVERITY_COLORS[s] for s in SEVERITY_DISPLAY_ORDER if s in sev
+            },
         })
     agent = viz.get("agent_type", "")
     if agent == "metrics" and viz.get("utilization"):
+        # CPU-vs-memory grouped view (reference: visualization.py:258-330
+        # "Resource Usage Issues" splits the two resources into parallel
+        # series over the affected pods)
+        cpu = {
+            row["component"]: row.get("usage_percentage", 0)
+            for row in viz["utilization"]
+            if str(row.get("resource", "")).lower() == "cpu"
+        }
+        mem = {
+            row["component"]: row.get("usage_percentage", 0)
+            for row in viz["utilization"]
+            if str(row.get("resource", "")).lower() in ("memory", "mem")
+        }
+        if cpu or mem:
+            charts.append({
+                "title": "Resource usage issues (CPU vs memory)",
+                "kind": "bar_grouped",
+                "series": {"cpu": cpu, "memory": mem},
+                "thresholds": [
+                    {"value": 80, "label": "warn (80%)"},
+                    {"value": 90, "label": "critical (90%)"},
+                ],
+            })
         # one component can carry several metrics findings (cpu AND memory)
         # — key by component+resource so neither overwrites the other.
         # Thresholds mirror the rule engine's 80%/90% utilization ladder
@@ -221,11 +294,33 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
                 {"value": 90, "label": "critical (90%)"},
             ],
         })
-    elif agent == "logs" and viz.get("pattern_counts"):
-        charts.append({
-            "title": "Log error classes", "kind": "bar",
-            "data": dict(viz["pattern_counts"]),
-        })
+    elif agent == "logs":
+        if viz.get("pattern_counts"):
+            charts.append({
+                "title": "Log error classes", "kind": "bar",
+                "data": dict(viz["pattern_counts"]),
+            })
+        if viz.get("component_severity"):
+            # component -> severity two-ring sunburst (reference:
+            # components/visualization.py:399-447 builds exactly this
+            # hierarchy with the severity color map)
+            rows = []
+            for comp, sevs in sorted(viz["component_severity"].items()):
+                rows.append({
+                    "id": comp, "parent": "",
+                    "value": sum(sevs.values()), "color": "#CCCCCC",
+                })
+                for s in SEVERITY_DISPLAY_ORDER:
+                    if s in sevs:
+                        rows.append({
+                            "id": f"{comp}/{s}", "parent": comp,
+                            "value": sevs[s],
+                            "color": SEVERITY_COLORS[s],
+                        })
+            charts.append({
+                "title": "Log issues by component and severity",
+                "kind": "sunburst", "data": rows,
+            })
     elif agent == "resources" and viz.get("pod_buckets"):
         charts.append({
             "title": "Pod status buckets", "kind": "bar",
@@ -245,6 +340,14 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
                 "title": "Events by type", "kind": "bar",
                 "data": dict(viz["type_counts"]),
             })
+        if viz.get("component_kind_counts"):
+            # donut of issues by component KIND (reference:
+            # components/visualization.py:833-843, px.pie hole=0.4 over
+            # the component-type split)
+            charts.append({
+                "title": "Event issues by component type", "kind": "pie",
+                "hole": 0.4, "data": dict(viz["component_kind_counts"]),
+            })
     elif agent == "traces":
         if viz.get("error_rates"):
             charts.append({
@@ -263,6 +366,27 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
                     for name, stats in lat.items()
                 },
             })
+        deps = viz.get("dependencies") or {}
+        if deps:
+            # directed service-dependency edges with per-service issue
+            # severity (reference: components/visualization.py:545-646
+            # draws the dependency digraph with issue-colored nodes)
+            by_comp: Dict[str, List[str]] = {}
+            for row in viz.get("finding_rows", []):
+                by_comp.setdefault(
+                    row["component"].split("/", 1)[-1], []
+                ).append(row["severity"])
+            max_sev = {c: max_severity(s) for c, s in by_comp.items()}
+            charts.append({
+                "title": "Service dependencies", "kind": "digraph",
+                "data": [
+                    {"source": src, "target": dst,
+                     "source_severity": max_sev.get(src, "info"),
+                     "target_severity": max_sev.get(dst, "info")}
+                    for src, dsts in sorted(deps.items())
+                    for dst in dsts
+                ],
+            })
     elif agent == "topology" and viz.get("service_pod_mapping"):
         charts.append({
             "title": "Service → pod mapping", "kind": "table",
@@ -279,6 +403,41 @@ def analysis_chart_series(viz: Dict[str, Any]) -> List[Dict[str, Any]]:
         charts.append({
             "title": "Findings", "kind": "findings_table",
             "data": viz["finding_rows"],
+        })
+    return charts
+
+
+def comprehensive_chart_series(results: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cross-agent overview specs (reference: visualization.py:38-236
+    _render_comprehensive_visualizations — severity distribution over ALL
+    agents' findings plus a findings-per-agent bar)."""
+    sev_counts: Dict[str, int] = {}
+    per_agent: Dict[str, int] = {}
+    for agent_type, result in results.items():
+        if not isinstance(result, dict) or "findings" not in result:
+            continue
+        findings = result.get("findings") or []
+        if findings:
+            per_agent[agent_type] = len(findings)
+        for f in findings:
+            sev = str(f.get("severity", "info")).lower()
+            sev_counts[sev] = sev_counts.get(sev, 0) + 1
+    charts: List[Dict[str, Any]] = []
+    if sev_counts:
+        charts.append({
+            "title": "Distribution of findings by severity", "kind": "bar",
+            "data": {
+                s: sev_counts[s] for s in SEVERITY_DISPLAY_ORDER if s in sev_counts
+            },
+            "colors": {
+                s: SEVERITY_COLORS[s] for s in SEVERITY_DISPLAY_ORDER
+                if s in sev_counts
+            },
+        })
+    if per_agent:
+        charts.append({
+            "title": "Findings by agent", "kind": "bar",
+            "data": dict(sorted(per_agent.items())),
         })
     return charts
 
