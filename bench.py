@@ -314,6 +314,11 @@ def main(skip_accuracy: bool = False) -> int:
     import subprocess
 
     _dryrun_src = (
+        # config.update, not just the env var: a site hook may have
+        # force-registered an accelerator plugin (axon) that the env var
+        # alone does not override (same defense as tests/conftest.py)
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
         "import json, numpy as np\n"
         "from rca_tpu.cluster.generator import synthetic_cascade_arrays\n"
         "from rca_tpu.engine import ShardedGraphEngine\n"
