@@ -27,9 +27,11 @@ Measured 8-step chain at 50k: 12.5 ms (COO scatter) -> 8.4 ms (segscan);
 the residual is the per-step gather, which is shared by every layout.
 
 Engagement: TPU backend only (Mosaic kernel), graphs at or above
-``RCA_SEGSCAN_MIN`` padded nodes (default 8192 — at small tiers the
-scatter is already sub-millisecond and kernel call overhead would erase
-the win), edge tier divisible by 128.  ``RCA_SEGSCAN=0`` disables;
+``RCA_SEGSCAN_MIN`` padded nodes (default 1024: the same-session A/B
+showed segscan winning at EVERY measured tier — 0.63 vs 0.88 ms at 2k,
+1.6 vs 3.5 ms at 5k, 4.3 vs 9.3 ms at 10k, 18.6 vs 47.3 ms at 50k —
+so the floor only spares sub-millisecond micro-graphs the extra kernel
+compile), edge tier divisible by 128.  ``RCA_SEGSCAN=0`` disables;
 ``RCA_SEGSCAN=1`` forces it on any eligible tier.  Tests exercise the
 kernel hermetically on CPU via ``SEGSCAN_INTERPRET=1``.
 """
@@ -219,5 +221,5 @@ def segscan_engaged(n_pad: int, e_pad: int) -> bool:
         on_tpu = jax.devices()[0].platform == "tpu"
     except Exception:
         return False
-    min_npad = int(os.environ.get("RCA_SEGSCAN_MIN", "8192"))
+    min_npad = int(os.environ.get("RCA_SEGSCAN_MIN", "1024"))
     return on_tpu and n_pad >= min_npad
