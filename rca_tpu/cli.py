@@ -115,6 +115,13 @@ def cmd_hypotheses(args) -> int:
     cands = [
         r["component"] for r in base.ranked[: args.candidates]
     ]
+    if not cands:
+        print(json.dumps({
+            "namespace": namespace, "engine": base.engine,
+            "batch_width": 0, "hypotheses": [],
+            "note": "no ranked candidates (empty namespace?)",
+        }, indent=None if args.compact else 2))
+        return 0
     name_to_idx = {n_: i for i, n_ in enumerate(base.service_names)}
     feats = np.asarray(fs.service_features, np.float32)
     batch = np.repeat(feats[None], len(cands), axis=0)
