@@ -147,10 +147,21 @@ class JaegerTraceBackend:
         return False
 
     def _sample(self) -> Dict[str, List[dict]]:
-        """service -> its spans, across a bounded trace sample per service."""
+        """service -> its spans, across a bounded trace sample per service.
+
+        Traces are deduplicated by traceID across the per-service sweep: a
+        trace touching services A, B and C comes back from all three
+        queries, and counting its spans three times would skew error rates
+        and latency percentiles toward widely-shared traces (and emit
+        duplicate slow-operation rows)."""
         per_service: Dict[str, List[dict]] = {}
+        seen: set = set()
         for svc in self._services():
             for trace in self._traces_for(svc, self.trace_limit):
+                tid = trace.get("traceID")
+                if tid in seen:
+                    continue
+                seen.add(tid)
                 for sname, span in self._spans_by_service(trace):
                     sname = self._strip(sname)
                     if sname:

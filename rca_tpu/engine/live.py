@@ -210,6 +210,9 @@ class LiveStreamingSession:
             # feed gone for good (client reconnected without support):
             # fall back to the sweep strategy from here on
             return self._poll_sweep()
+        can_check_errors = hasattr(self.client, "collect_errors")
+        if can_check_errors:
+            self.client.collect_errors()  # drain stale errors
         new_pods = sanitize_objects(self.client.get_pods(self.namespace))
         old_by_name = {
             p.get("metadata", {}).get("name"): p for p in snap.pods
@@ -238,14 +241,30 @@ class LiveStreamingSession:
             }
         except Exception:
             traces = snap.traces
+        events = sanitize_objects(self.client.get_events(self.namespace))
+        metrics = self.client.get_pod_metrics(self.namespace) or {}
+        if can_check_errors and self.client.collect_errors():
+            # a fetch failed and was swallowed into the degraded channel:
+            # an empty pod list here means API flake, NOT mass deletion —
+            # interpreting it would wipe the ranking (every other path
+            # guards this via snap.errors / collect_errors; round-4 review
+            # finding).  Keep the retained state and retry with a full
+            # resync next poll.
+            self._pending_resync = True
+            out = self._finish(t0, changed=0, resynced=False, quiet=False)
+            out["recovered"] = False
+            return out
         snap2 = dataclasses.replace(
             snap,
             captured_at=self.client.get_current_time(),
             pods=new_pods,
             logs=logs,
-            events=sanitize_objects(self.client.get_events(self.namespace)),
-            pod_metrics=self.client.get_pod_metrics(self.namespace) or {},
+            events=events,
+            pod_metrics=metrics,
             traces=traces,
+            # this recovery's own (clean) fetch status, not the previous
+            # capture's stale error list
+            errors=[],
         )
         self._force_topology_check = True
         fs = extract_features(snap2)

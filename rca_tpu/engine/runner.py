@@ -270,7 +270,12 @@ def resolve_params(
     the trained weights beat the hand-set defaults OOD, so the default
     answer should be the stronger one).  ``RCA_WEIGHTS=off`` (also
     ``none``/``defaults``) opts back into the hand-set defaults;
-    ``RCA_WEIGHTS=<path>`` loads that checkpoint instead."""
+    ``RCA_WEIGHTS=<path>`` loads that checkpoint instead.
+
+    ``config.propagation_steps`` governs the propagation DEPTH in every
+    case: steps is a runtime graph-diameter cap, not a fitted weight, so
+    a checkpoint must not silently disable the documented config knob
+    (its recorded steps value is training metadata)."""
     if params is None:
         ckpt = os.environ.get("RCA_WEIGHTS")
         if ckpt and ckpt.lower() in ("off", "none", "defaults"):
@@ -278,6 +283,10 @@ def resolve_params(
         from rca_tpu.engine.train import load_params, packaged_params
 
         params = load_params(ckpt) if ckpt else packaged_params()
+        if params is not None and params.steps != config.propagation_steps:
+            params = dataclasses.replace(
+                params, steps=config.propagation_steps
+            )
     return params or default_params(config.propagation_steps)
 
 

@@ -195,3 +195,24 @@ def test_default_weight_resolution(tmp_path, monkeypatch):
     assert abs(got.decay - 0.777) < 1e-9
     # explicit params always win
     assert resolve_params(cfg, p) == p
+
+
+def test_config_steps_governs_loaded_checkpoints(tmp_path, monkeypatch):
+    """propagation_steps is a runtime depth knob: a loaded checkpoint's
+    recorded steps (training metadata) must not silently disable it
+    (round-4 review finding)."""
+    import dataclasses
+
+    from rca_tpu.config import RCAConfig
+    from rca_tpu.engine import train as train_mod
+    from rca_tpu.engine.runner import resolve_params
+    from rca_tpu.engine.train import save_params_json
+
+    marked = dataclasses.replace(default_params(steps=8), decay=0.777)
+    fake = tmp_path / "default_weights.json"
+    save_params_json(marked, str(fake))
+    monkeypatch.delenv("RCA_WEIGHTS", raising=False)
+    monkeypatch.setattr(train_mod, "PACKAGED_WEIGHTS", fake)
+    got = resolve_params(RCAConfig(propagation_steps=4), None)
+    assert got.steps == 4                   # config knob honored
+    assert abs(got.decay - 0.777) < 1e-9    # weights still the artifact's

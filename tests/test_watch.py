@@ -758,3 +758,41 @@ def test_partial_sweep_schedules_recovery_resync():
     assert out2["resynced"] is True
     out3 = live.poll()               # poll 5: back to normal quiet polls
     assert out3["quiet"] is True
+
+
+def test_expiry_recovery_rejects_degraded_fetch():
+    """Round-4 review finding: an API flake during expiry recovery must
+    not read as mass pod deletion — the recovery aborts, keeps the
+    retained state, and schedules a full resync instead of wiping the
+    ranking."""
+    world = five_service_world()
+    world.journal_cap = 5
+
+    class FlakyClient(MockClusterClient):
+        flake = False
+
+        def get_pods(self, namespace):
+            if self.flake:
+                return []          # what a swallowed API error looks like
+            return super().get_pods(namespace)
+
+        def collect_errors(self, clear=True):
+            if self.flake:
+                return [{"op": "list_namespaced_pod", "error": "boom"}]
+            return []
+
+    client = FlakyClient(world)
+    live = LiveStreamingSession(client, NS, k=3, topology_check_every=10_000)
+    baseline = [r["component"] for r in live.poll()["ranked"]]
+    for i in range(20):
+        world.touch("pod", NS, f"ghost-{i}")  # trim past the cursor
+    client.flake = True
+    out = live.poll()
+    assert out.get("recovered") is False
+    assert live._pending_resync is True
+    assert len(live._names) == len(baseline) or live._names  # state kept
+    assert [r["component"] for r in out["ranked"]] == baseline
+    client.flake = False
+    out2 = live.poll()                       # scheduled recovery resync
+    assert out2["resynced"] is True
+    assert [r["component"] for r in out2["ranked"]] == baseline

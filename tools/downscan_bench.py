@@ -52,77 +52,11 @@ LANES = 128
 SUBLANES = 8
 
 
-# ---------------------------------------------------------------------------
-# Pallas single-pass cumsum over a VMEM-resident [R, 128] layout
-# ---------------------------------------------------------------------------
-
-def _segscan_kernel(x_ref, f_ref, out_ref):
-    """SEGMENTED inclusive scan over row-major [R, 128]: ``S[e]`` is the
-    running sum since the last segment boundary (``f == 1`` marks a
-    segment's FIRST element).  Unlike a global cumsum + boundary diff,
-    accumulation never crosses a segment, so float error is bounded by the
-    longest segment (the max in-degree hub), not by the whole edge array —
-    the global-cumsum variant measured 5e-3 off after 8 chained steps at
-    50k, this one ~1e-6.
-
-    Flagged Hillis-Steele at two levels, all static shapes and all vector
-    ops (no scalar VMEM stores — Mosaic forbids them):
-
-    1. lane-level (7 shift-add passes along lanes): for shift k,
-       ``v += shifted(v) * (1 - f)`` and ``f |= shifted(f)`` — a value
-       never absorbs across a boundary at or before it;
-    2. row-level: the same flagged scan over the [R, 1] row aggregates
-       along the SUBLANE axis (log2(R) passes) yields each row's
-       inclusive carry; shifting it down one row gives the carry entering
-       each row, which lands on the lanes before the row's first boundary.
-    """
-    v = x_ref[...]                       # [R, 128] f32
-    f = f_ref[...]                       # [R, 128] f32, 1 = segment start
-    R = v.shape[0]
-
-    for k in (1, 2, 4, 8, 16, 32, 64):
-        # shift in a virtual prefix of (v=0, f=1): nothing flows in from
-        # before the row; row carry is applied at level 2
-        v_s = jnp.pad(v, ((0, 0), (k, 0)))[:, :-k]
-        # zero-pad BOTH: the virtual prefix carries no boundary (padding a
-        # boundary flag in would poison the final (1 - f) carry gate at
-        # every row start) and no value (so nothing is absorbed across the
-        # row edge regardless of the flag)
-        f_s = jnp.pad(f, ((0, 0), (k, 0)))[:, :-k]
-        v = v + v_s * (1.0 - f)
-        f = jnp.maximum(f, f_s)
-
-    # row-level flagged scan on FULL-LANE broadcasts: Mosaic cannot concat
-    # 1-lane [R, 1] vectors along sublanes ("offset mismatch on non-concat
-    # dimension"), but [R, 128] full-lane shifts lower fine and the extra
-    # lanes are free VPU width
-    zero_row = jnp.zeros((1, LANES), dtype=v.dtype)
-    cv = v[:, -1:] + zero_row            # [R, 128], all lanes equal
-    cf = f[:, -1:] + zero_row
-    k = 1
-    while k < R:
-        v_s = jnp.pad(cv, ((k, 0), (0, 0)))[:-k, :]
-        f_s = jnp.pad(cf, ((k, 0), (0, 0)))[:-k, :]
-        cv = cv + v_s * (1.0 - cf)
-        cf = jnp.maximum(cf, f_s)
-        k *= 2
-    # inclusive row carry, shifted down one row = carry ENTERING each row
-    carry_in = jnp.pad(cv, ((1, 0), (0, 0)))[:-1, :]
-    out_ref[...] = v + (1.0 - f) * carry_in
-
-
-def pallas_segscan(x_flat: jnp.ndarray, flags_flat: jnp.ndarray) -> jnp.ndarray:
-    """Segmented inclusive scan of a flat [N] array (N % 128 == 0)."""
-    from jax.experimental import pallas as pl
-
-    N = x_flat.shape[0]
-    R = N // LANES
-    out = pl.pallas_call(
-        _segscan_kernel,
-        out_shape=jax.ShapeDtypeStruct((R, LANES), jnp.float32),
-        interpret=os.environ.get("SEGSCAN_INTERPRET") == "1",
-    )(x_flat.reshape(R, LANES), flags_flat.reshape(R, LANES))
-    return out.reshape(N)
+# The production kernel (one definition): the engine's segmented scan.
+# This tool originally carried the prototype; it now measures the SAME
+# kernel the engine ships so the benchmark cannot drift from production
+# semantics (round-4 review finding).
+from rca_tpu.engine.segscan import pallas_segscan  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
