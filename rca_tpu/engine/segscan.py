@@ -100,6 +100,21 @@ def _make_segscan_kernel(op: str):
 _KERNELS = {"sum": _make_segscan_kernel("sum"), "max": _make_segscan_kernel("max")}
 
 
+def interpret_mode() -> bool:
+    """Pallas interpret-mode decision, made host-side at trace time: an
+    explicit ``SEGSCAN_INTERPRET`` wins (1 forces on, 0 forces off); unset,
+    interpret engages automatically when the default backend is not TPU, so
+    a forced ``RCA_SEGSCAN=1`` on CPU/GPU runs the kernel through the
+    interpreter instead of crashing at Mosaic dispatch (ADVICE r4)."""
+    env = (os.environ.get("SEGSCAN_INTERPRET") or "").strip()
+    if env:
+        return env == "1"
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
 def _segscan(x_flat, flags_flat, op: str):
     from jax.experimental import pallas as pl
 
@@ -108,7 +123,7 @@ def _segscan(x_flat, flags_flat, op: str):
     out = pl.pallas_call(
         _KERNELS[op],
         out_shape=jax.ShapeDtypeStruct((R, LANES), jnp.float32),
-        interpret=os.environ.get("SEGSCAN_INTERPRET") == "1",
+        interpret=interpret_mode(),
     )(x_flat.reshape(R, LANES), flags_flat.reshape(R, LANES))
     return out.reshape(N)
 
@@ -197,19 +212,61 @@ def up_seg_step(u, h, decay: float, seg: SegLayout):
     return jnp.maximum(u, upd)
 
 
+# Built layouts keyed by an edge-set digest: the host-side argsort+bincount
+# over the padded edge tier costs milliseconds at 50k/512k edges — paid on
+# every one-shot analyze exactly in the latency path the kernel optimizes
+# (ADVICE r4), while the streaming sessions build once per pinned edge set.
+# Digest keys (16 bytes) instead of the raw tobytes() so the cache does not
+# pin 4 MB of key material per 50k entry.  Insertion-ordered dict as FIFO.
+_LAYOUT_CACHE: dict = {}
+_LAYOUT_CACHE_MAX = 32
+
+
+def arrays_digest(ints, arrays) -> bytes:
+    """16-byte blake2b over shape scalars + array contents: the shared
+    layout-cache key (also used by the sharded layout cache, so the digest
+    inputs cannot drift between the two)."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.asarray(list(ints), np.int64).tobytes())
+    for arr in arrays:
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.digest()
+
+
+def cache_insert(cache: dict, key, value, maxsize: int = _LAYOUT_CACHE_MAX):
+    """Bounded insertion-ordered (FIFO) cache insert, shared with the
+    sharded layout cache."""
+    while len(cache) >= maxsize:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+
+
 def seg_layouts_for(n_pad: int, e_pad: int, dep_src, dep_dst):
     """(down_seg, up_seg) when the segscan layouts are engaged for this
-    tier, else (None, None) — the one gate callers share."""
+    tier, else (None, None) — the one gate callers share.  Layouts are
+    cached on the edge-set digest, so repeated analyses of the same graph
+    (the common live/bench pattern) pay the host-side sort once."""
     if not segscan_engaged(n_pad, e_pad):
         return None, None
-    return (
-        build_down_seg(n_pad, e_pad, dep_src, dep_dst),
-        build_up_seg(n_pad, e_pad, dep_src, dep_dst),
-    )
+    src = np.asarray(dep_src)
+    dst = np.asarray(dep_dst)
+    key = arrays_digest((n_pad, e_pad), (src, dst))
+    hit = _LAYOUT_CACHE.get(key)
+    if hit is None:
+        hit = (
+            build_down_seg(n_pad, e_pad, src, dst),
+            build_up_seg(n_pad, e_pad, src, dst),
+        )
+        cache_insert(_LAYOUT_CACHE, key, hit)
+    return hit
 
 
 def segscan_engaged(n_pad: int, e_pad: int) -> bool:
-    """Static host-side decision per (backend, tier, env)."""
+    """Static host-side decision per (backend, tier, env).  A forced
+    ``RCA_SEGSCAN=1`` is safe on any backend: off-TPU the kernel runs in
+    interpret mode automatically (:func:`interpret_mode`)."""
     mode = (os.environ.get("RCA_SEGSCAN") or "").strip()
     if mode == "0":
         return False

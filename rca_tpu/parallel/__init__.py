@@ -12,9 +12,11 @@ from rca_tpu.parallel.distributed import initialize_distributed
 from rca_tpu.parallel.mesh import make_mesh, make_multislice_mesh
 from rca_tpu.parallel.sharded import (
     ShardedGraph,
+    ShardedSegLayouts,
     shard_graph,
     sharded_propagate,
     sharded_propagate_full,
+    sharded_seg_layouts_for,
     sharded_topk,
 )
 
@@ -23,8 +25,10 @@ __all__ = [
     "make_mesh",
     "make_multislice_mesh",
     "ShardedGraph",
+    "ShardedSegLayouts",
     "shard_graph",
     "sharded_propagate",
     "sharded_propagate_full",
+    "sharded_seg_layouts_for",
     "sharded_topk",
 ]

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -89,21 +89,133 @@ def shard_graph(
     )
 
 
+class ShardedSegLayouts(NamedTuple):
+    """Per-shard segmented-scan layouts (round 5): the round-4 Pallas
+    segscan win (``rca_tpu.engine.segscan``, 2.5x at 50k single-device)
+    ported into the per-device block kernel.  Segments are LOCAL to each
+    shard's own edge partition — the down-scan's segment totals form this
+    shard's full-length contribution vector, and cross-shard reduction
+    still rides the existing ``psum_scatter``; the up-scan's segments are
+    source nodes, which by construction live inside this shard's block, so
+    its totals apply locally with no extra collective.  Comm volume is
+    therefore IDENTICAL to the scatter kernel — only the on-device
+    scatter/gather primitives change.
+
+    All arrays are stacked ``[sp, ...]`` host-side and enter ``shard_map``
+    under a ``P("sp", None)`` prefix spec.  Sort order differs per shard,
+    so each shard carries its own flags/ends/mask permutation."""
+
+    dn_other: np.ndarray   # int32 [sp, e_pad] — src, dst-sorted
+    dn_mask: np.ndarray    # f32 [sp, e_pad] — edge mask, dst-sorted
+    dn_flags: np.ndarray   # f32 [sp, e_pad] — 1 at each dst-run start
+    dn_ends: np.ndarray    # int32 [sp, n_pad] — last edge pos per dst
+    dn_has: np.ndarray     # f32 [sp, n_pad] — dst has local edges
+    up_other: np.ndarray   # int32 [sp, e_pad] — dst, src-local-sorted
+    up_mask: np.ndarray    # f32 [sp, e_pad]
+    up_flags: np.ndarray   # f32 [sp, e_pad]
+    up_ends: np.ndarray    # int32 [sp, block] — last edge pos per src
+    up_has: np.ndarray     # f32 [sp, block]
+
+
+def _seg_direction(seg, other, mask, n_seg: int):
+    """One shard, one scan direction: dst- (or src-) sorted edge layout.
+    Padded slots (mask 0) sort into the dummy segment ``n_seg - 1``; their
+    values are masked to the combine identity 0 in the kernel, so they are
+    harmless wherever they land (matches engine.segscan's convention)."""
+    seg = np.where(mask > 0, seg, n_seg - 1).astype(np.int64)
+    order = np.argsort(seg, kind="stable")
+    seg_s = seg[order]
+    counts = np.bincount(seg_s, minlength=n_seg)
+    ends = np.cumsum(counts)
+    starts = ends - counts
+    flags = np.zeros(len(seg), np.float32)
+    flags[starts[counts > 0]] = 1.0
+    return (
+        other[order].astype(np.int32),
+        mask[order].astype(np.float32),
+        flags,
+        (ends - 1).clip(0).astype(np.int32),
+        (counts > 0).astype(np.float32),
+    )
+
+
+# built layouts keyed on the graph's edge digest (same rationale as
+# engine.segscan._LAYOUT_CACHE: the per-shard argsort+bincount is host
+# milliseconds at the 50k tier, paid once per pinned edge set)
+_SHARD_LAYOUT_CACHE: dict = {}
+
+
+def build_sharded_seg_layouts(graph: ShardedGraph) -> ShardedSegLayouts:
+    """Host-side per-shard layouts for :class:`ShardedSegLayouts`."""
+    from rca_tpu.engine.segscan import arrays_digest, cache_insert
+
+    key = arrays_digest(
+        (graph.n_pad, graph.sp, graph.src_local.shape[1]),
+        (graph.src_global, graph.dst_global, graph.mask),
+    )
+    hit = _SHARD_LAYOUT_CACHE.get(key)
+    if hit is not None:
+        return hit
+    cols = [[] for _ in range(10)]
+    for k in range(graph.sp):
+        dn = _seg_direction(
+            graph.dst_global[k], graph.src_global[k], graph.mask[k],
+            graph.n_pad,
+        )
+        up = _seg_direction(
+            graph.src_local[k], graph.dst_global[k], graph.mask[k],
+            graph.block,
+        )
+        for i, arr in enumerate(dn + up):
+            cols[i].append(arr)
+    layouts = ShardedSegLayouts(*(np.stack(c) for c in cols))
+    cache_insert(_SHARD_LAYOUT_CACHE, key, layouts, maxsize=16)
+    return layouts
+
+
+def sharded_seg_layouts_for(graph: ShardedGraph) -> Optional[ShardedSegLayouts]:
+    """Engagement gate + builder: the sharded twin of
+    :func:`rca_tpu.engine.segscan.seg_layouts_for`, sharing its decision
+    (backend, ``RCA_SEGSCAN``, per-shard edge tier divisible by 128)."""
+    from rca_tpu.engine.segscan import segscan_engaged
+
+    if not segscan_engaged(graph.n_pad, graph.src_local.shape[1]):
+        return None
+    return build_sharded_seg_layouts(graph)
+
+
 def _propagate_block(
     f_blk, src_local, src_global, dst_global, mask, n_live,
-    aw, hw, steps: int, decay: float, mu: float, beta: float,
+    aw, hw, steps: int, decay: float, mu: float, beta: float, seg=None,
 ):
-    """Per-device kernel for ONE graph: f_blk is this shard's node block."""
+    """Per-device kernel for ONE graph: f_blk is this shard's node block.
+    ``seg`` (this shard's :class:`ShardedSegLayouts` slices) swaps the
+    scatter primitives for the Pallas segmented scans; collectives and
+    semantics are unchanged (sum order differs within a segment, so parity
+    is allclose ~1e-6 like the dense segscan; max is order-invariant)."""
     a_blk = _noisy_or(f_blk, aw)
     h_blk = _noisy_or(f_blk, hw)
     h_full = jax.lax.all_gather(h_blk, "sp", tiled=True)
     a_full = jax.lax.all_gather(a_blk, "sp", tiled=True)
 
-    def up_step(u_blk, _):
-        u_full = jax.lax.all_gather(u_blk, "sp", tiled=True)
-        vals = mask * jnp.maximum(h_full[dst_global], decay * u_full[dst_global])
-        scattered = jnp.zeros_like(u_blk).at[src_local].max(vals)
-        return jnp.maximum(u_blk, scattered), None
+    if seg is not None:
+        from rca_tpu.engine.segscan import pallas_segscan, pallas_segscan_max
+
+        def up_step(u_blk, _):
+            u_full = jax.lax.all_gather(u_blk, "sp", tiled=True)
+            # per-node signal computed DENSE once, then ONE e_pad-gather
+            w_full = jnp.maximum(h_full, decay * u_full)
+            vals = seg.up_mask * w_full[seg.up_other]
+            s = pallas_segscan_max(vals, seg.up_flags)
+            upd = jnp.where(seg.up_has > 0, s[seg.up_ends], 0.0)
+            return jnp.maximum(u_blk, upd), None
+    else:
+
+        def up_step(u_blk, _):
+            u_full = jax.lax.all_gather(u_blk, "sp", tiled=True)
+            vals = mask * jnp.maximum(h_full[dst_global], decay * u_full[dst_global])
+            scattered = jnp.zeros_like(u_blk).at[src_local].max(vals)
+            return jnp.maximum(u_blk, scattered), None
 
     u_blk, _ = jax.lax.scan(up_step, jnp.zeros_like(a_blk), None, length=steps)
 
@@ -113,20 +225,35 @@ def _propagate_block(
 
     # dependent count per node in THIS shard's block, for the impact mean:
     # local masked counts reduce-scattered exactly like the contributions
+    # (one-time cost outside the step loop — stays a scatter either way)
     deg_blk = jax.lax.psum_scatter(
         jnp.zeros_like(a_full).at[dst_global].add(mask),
         "sp", scatter_dimension=0, tiled=True,
     )
     inv_deg_blk = 1.0 / jnp.maximum(deg_blk, 1.0)
 
-    def imp_step(m_blk, _):
-        m_full = jax.lax.all_gather(m_blk, "sp", tiled=True)
-        vals = mask * (a_ex_full[src_global] + decay * m_full[src_global])
-        contrib_full = jnp.zeros_like(m_full).at[dst_global].add(vals)
-        # reduce-scatter: every shard receives its reduced block only
-        return jax.lax.psum_scatter(
-            contrib_full, "sp", scatter_dimension=0, tiled=True
-        ) * inv_deg_blk, None
+    if seg is not None:
+
+        def imp_step(m_blk, _):
+            m_full = jax.lax.all_gather(m_blk, "sp", tiled=True)
+            vals = seg.dn_mask * (
+                a_ex_full[seg.dn_other] + decay * m_full[seg.dn_other]
+            )
+            s = pallas_segscan(vals, seg.dn_flags)
+            contrib_full = jnp.where(seg.dn_has > 0, s[seg.dn_ends], 0.0)
+            return jax.lax.psum_scatter(
+                contrib_full, "sp", scatter_dimension=0, tiled=True
+            ) * inv_deg_blk, None
+    else:
+
+        def imp_step(m_blk, _):
+            m_full = jax.lax.all_gather(m_blk, "sp", tiled=True)
+            vals = mask * (a_ex_full[src_global] + decay * m_full[src_global])
+            contrib_full = jnp.zeros_like(m_full).at[dst_global].add(vals)
+            # reduce-scatter: every shard receives its reduced block only
+            return jax.lax.psum_scatter(
+                contrib_full, "sp", scatter_dimension=0, tiled=True
+            ) * inv_deg_blk, None
 
     m_blk, _ = jax.lax.scan(imp_step, jnp.zeros_like(a_blk), None, length=steps)
     # same hard-evidence-damped suppression + multiplicative impact as
@@ -140,7 +267,7 @@ def _propagate_block(
 @functools.lru_cache(maxsize=32)
 def _jitted_shard_fn(
     mesh: Mesh, steps: int, decay: float, mu: float, beta: float,
-    batch_axes: tuple = ("dp",),
+    batch_axes: tuple = ("dp",), use_segscan: bool = False,
 ):
     """One traced+compiled shard_map per (mesh, scalar-params); weight
     vectors are runtime args so repeated calls hit jit's shape cache
@@ -149,22 +276,29 @@ def _jitted_shard_fn(
     ``batch_axes`` names the mesh axes the hypothesis batch shards over —
     ``("dp",)`` single-slice, ``("slice", "dp")`` multi-slice (hypotheses
     spread over DCN, node shards over ICI; no cross-slice collective is
-    ever issued inside the propagation)."""
+    ever issued inside the propagation).  ``use_segscan`` appends the ten
+    :class:`ShardedSegLayouts` arrays as trailing runtime args."""
 
-    def per_device(f_loc, src_l, src_g, dst_g, mask, n_live, aw, hw):
+    def per_device(f_loc, src_l, src_g, dst_g, mask, n_live, aw, hw,
+                   *seg_flat):
         # f_loc: [B/dp, block, C]; edge arrays arrive [1, e_pad] — drop the
         # collapsed shard axis, then vmap the block kernel over the local batch
         src_l, src_g = src_l[0], src_g[0]
         dst_g, mask = dst_g[0], mask[0]
+        seg = (
+            ShardedSegLayouts(*(x[0] for x in seg_flat))
+            if seg_flat else None
+        )
         kernel = functools.partial(
             _propagate_block,
-            steps=steps, decay=decay, mu=mu, beta=beta,
+            steps=steps, decay=decay, mu=mu, beta=beta, seg=seg,
         )
         return jax.vmap(
             lambda f: kernel(f, src_l, src_g, dst_g, mask, n_live, aw=aw, hw=hw)
         )(f_loc)
 
     batch_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
+    n_seg = len(ShardedSegLayouts._fields) if use_segscan else 0
     shard_fn = jax.shard_map(
         per_device,
         mesh=mesh,
@@ -172,6 +306,7 @@ def _jitted_shard_fn(
             P(batch_spec, "sp", None),
             P("sp", None), P("sp", None), P("sp", None), P("sp", None),
             P(), P(), P(),
+            *([P("sp", None)] * n_seg),
         ),
         # [B, 4, n_pad]: diagnostic axis replicated, nodes sharded
         out_specs=P(batch_spec, None, "sp"),
@@ -246,9 +381,11 @@ def stage_sharded(
     reps, streaming-style reruns) pay dispatch only, the same methodology
     the dense engine times."""
     aw, hw = params.weight_arrays()
+    seg = sharded_seg_layouts_for(graph)
     fn = _jitted_shard_fn(
         mesh, params.steps, params.decay,
         params.explain_strength, params.impact_bonus, tuple(batch_axes),
+        use_segscan=seg is not None,
     )
     batch_spec = tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
     fb = jax.device_put(
@@ -260,12 +397,15 @@ def stage_sharded(
         jax.device_put(jnp.asarray(x), edge_sharding)
         for x in (graph.src_local, graph.src_global, graph.dst_global, graph.mask)
     )
+    seg_args = tuple(
+        jax.device_put(jnp.asarray(x), edge_sharding) for x in seg
+    ) if seg is not None else ()
     n_live = jnp.asarray(graph.n, jnp.int32)
     awj, hwj = jnp.asarray(aw), jnp.asarray(hw)
 
     def invoke() -> jax.Array:
         with mesh:
-            return fn(fb, *args, n_live, awj, hwj)
+            return fn(fb, *args, n_live, awj, hwj, *seg_args)
 
     return invoke
 

@@ -37,24 +37,36 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rca_tpu.config import bucket_for
-from rca_tpu.parallel.sharded import ShardedGraph, _propagate_block
+from rca_tpu.parallel.sharded import (
+    ShardedGraph,
+    ShardedSegLayouts,
+    _propagate_block,
+    sharded_seg_layouts_for,
+)
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_tick_fn(
     mesh: Mesh, steps: int, decay: float, mu: float, beta: float,
-    kk: int, block: int,
+    kk: int, block: int, use_segscan: bool = False,
 ):
     """One compiled scatter+propagate+top-k per (mesh, params, k, block);
-    delta width and edge shapes key jit's shape cache underneath."""
+    delta width and edge shapes key jit's shape cache underneath.
+    ``use_segscan`` appends the ten :class:`ShardedSegLayouts` arrays as
+    trailing args (built ONCE at session init — the streaming path never
+    pays the host-side layout sort per tick)."""
 
     def per_device(f_blk, idx, rows, src_l, src_g, dst_g, mask, n_live,
-                   aw, hw):
+                   aw, hw, *seg_flat):
         # f_blk: [block, C] this shard's node rows (donated).
         # idx/rows: [U] / [U, C], replicated; rows outside this shard's
         # block are redirected to an out-of-bounds index and dropped.
         src_l, src_g = src_l[0], src_g[0]
         dst_g, mask = dst_g[0], mask[0]
+        seg = (
+            ShardedSegLayouts(*(x[0] for x in seg_flat))
+            if seg_flat else None
+        )
         blk = jax.lax.axis_index("sp")
         local = idx - blk * block
         inside = (local >= 0) & (local < block)
@@ -62,7 +74,7 @@ def _jitted_tick_fn(
         f_blk = f_blk.at[safe].set(rows, mode="drop")
         stack = _propagate_block(
             f_blk, src_l, src_g, dst_g, mask, n_live, aw, hw,
-            steps=steps, decay=decay, mu=mu, beta=beta,
+            steps=steps, decay=decay, mu=mu, beta=beta, seg=seg,
         )
         score_blk = stack[3]
         # distributed top-k merge (same shape as sharded.sharded_topk,
@@ -75,6 +87,7 @@ def _jitted_tick_fn(
         vv, pos = jax.lax.top_k(vg, kk)
         return f_blk, vv, jnp.take(ig, pos)
 
+    n_seg = len(ShardedSegLayouts._fields) if use_segscan else 0
     shard_fn = jax.shard_map(
         per_device,
         mesh=mesh,
@@ -83,6 +96,7 @@ def _jitted_tick_fn(
             P(), P(),                    # delta idx / rows (replicated)
             P("sp", None), P("sp", None), P("sp", None), P("sp", None),
             P(), P(), P(),
+            *([P("sp", None)] * n_seg),
         ),
         out_specs=(P("sp", None), P(), P()),
         check_vma=False,
@@ -130,11 +144,17 @@ class ShardedStreamingSession(StreamingHostState):
             for x in (graph.src_local, graph.src_global,
                       graph.dst_global, graph.mask)
         )
+        # segscan layouts built ONCE per pinned edge set (round 5: the
+        # sharded tick inherits the round-4 segmented-scan kernels)
+        seg = sharded_seg_layouts_for(graph)
+        self._seg_args = tuple(
+            jax.device_put(jnp.asarray(x), edge_sharding) for x in seg
+        ) if seg is not None else ()
         p = self.engine.params
         self._aw, self._hw = (jnp.asarray(w) for w in p.weight_arrays())
         self._fn = _jitted_tick_fn(
             self.mesh, p.steps, p.decay, p.explain_strength, p.impact_bonus,
-            self._kk, self._block,
+            self._kk, self._block, use_segscan=seg is not None,
         )
         self._feat_sharding = NamedSharding(self.mesh, P("sp", None))
         self._features = jax.device_put(
@@ -162,6 +182,7 @@ class ShardedStreamingSession(StreamingHostState):
             self._features, vals, idx = self._fn(
                 self._features, jnp.asarray(idx_h), jnp.asarray(rows_h),
                 *self._edge_args, self._n_live, self._aw, self._hw,
+                *self._seg_args,
             )
         # deltas drop only once the dispatch is accepted (retryable on a
         # compile failure), matching the dense session's contract

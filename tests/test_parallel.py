@@ -145,24 +145,78 @@ def test_multislice_mesh_and_propagate():
     assert int(np.asarray(idx)[0, 0]) == top
 
 
-def test_sharded_engine_50k_scale():
+@pytest.mark.parametrize("segscan", ["0", "1"])
+def test_sharded_engine_50k_scale(segscan, monkeypatch):
     """BASELINE.md row 5's config at full scale on the virtual mesh: the
     sharded engine must analyze the 50k-service multi-root cascade with
     exact score parity and identical ranking vs the dense engine (v5e-8
     hardware is unavailable in this environment; this pins the functional
-    path at the real size, not just dryrun-tiny shapes)."""
+    path at the real size, not just dryrun-tiny shapes).  segscan="1"
+    forces the round-5 per-block segmented-scan layouts through BOTH
+    engines (Pallas interpret mode off-TPU), proving the flagship 50k
+    config is fast AND sharded — VERDICT r4 item 1."""
     from rca_tpu.engine.sharded_runner import ShardedGraphEngine
 
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")
+    monkeypatch.setenv("RCA_SEGSCAN", segscan)
     case = synthetic_cascade_arrays(50_000, n_roots=5, seed=0)
-    sh = ShardedGraphEngine(spec="sp=8").analyze_case(case, k=5)
+    sh_eng = ShardedGraphEngine(spec="sp=8")
+    if segscan == "1":
+        from rca_tpu.parallel.sharded import sharded_seg_layouts_for
+
+        graph = sh_eng._shard(case.n, case.dep_src, case.dep_dst)
+        assert sharded_seg_layouts_for(graph) is not None, (
+            "forced segscan must engage at the 50k tier"
+        )
+    sh = sh_eng.analyze_case(case, k=5)
     dense = GraphEngine().analyze_case(case, k=5)
     np.testing.assert_allclose(sh.score, dense.score, rtol=1e-5, atol=1e-6)
     assert [r["component"] for r in sh.ranked] == \
         [r["component"] for r in dense.ranked]
     roots = set(case.roots.tolist())
     assert roots <= set(np.argsort(-sh.score)[:5].tolist())
+
+
+def test_sharded_segscan_matches_scatter_kernel(monkeypatch):
+    """The per-block segmented-scan kernel is value-equivalent to the
+    scatter kernel on the SAME sharded graph and hypothesis batch (the
+    direct A/B the engagement gate switches between): allclose scores,
+    identical top-3 per hypothesis.  fp32 sum order differs within a
+    segment, hence allclose rather than byte equality."""
+    from rca_tpu.config import RCAConfig, bucket_for
+    from rca_tpu.parallel.sharded import sharded_seg_layouts_for
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    params = default_params()
+    case = synthetic_cascade_arrays(900, n_roots=2, seed=7)
+    buckets = RCAConfig().shape_buckets
+    graph = shard_graph(
+        case.n, case.dep_src, case.dep_dst, 4,
+        n_pad_to=bucket_for(case.n + 1, buckets),
+        e_pad_fn=lambda e: bucket_for(e, buckets),
+    )
+    assert graph.src_local.shape[1] % 128 == 0
+    B = 4
+    rng = np.random.default_rng(3)
+    batch = np.zeros((B, graph.n_pad, case.features.shape[1]), np.float32)
+    for b in range(B):
+        batch[b, : case.n] = np.clip(
+            case.features + rng.uniform(0, 0.05, case.features.shape), 0, 1
+        )
+    mesh = make_mesh([("dp", 2), ("sp", 4)])
+
+    monkeypatch.setenv("RCA_SEGSCAN", "0")
+    scatter = np.asarray(sharded_propagate(mesh, batch, graph, params))
+    monkeypatch.setenv("RCA_SEGSCAN", "1")
+    assert sharded_seg_layouts_for(graph) is not None
+    seg = np.asarray(sharded_propagate(mesh, batch, graph, params))
+
+    np.testing.assert_allclose(seg, scatter, rtol=1e-5, atol=1e-6)
+    for b in range(B):
+        assert np.argsort(-seg[b])[:3].tolist() == \
+            np.argsort(-scatter[b])[:3].tolist()
 
 
 def test_initialize_distributed_single_process_noop(monkeypatch):
@@ -291,11 +345,14 @@ def test_shard_spec_rejects_zero_and_misconfig_is_loud(monkeypatch):
 
 # -- sharded streaming (VERDICT r3 item 3) ----------------------------------
 
-def test_sharded_streaming_tick_parity_10k():
+@pytest.mark.parametrize("segscan", ["0", "1"])
+def test_sharded_streaming_tick_parity_10k(segscan, monkeypatch):
     """Tick parity vs the dense streaming session at 10k: same set_all,
     same deltas, same quiet tick -> identical rankings and scores.  The
     sharded session keeps its feature buffer sp-sharded and merges top-k
-    on device; parity means streaming and one-shot analyze cannot drift."""
+    on device; parity means streaming and one-shot analyze cannot drift.
+    segscan="1" forces the round-5 per-block segmented-scan tick kernel
+    (layouts built once at session init)."""
     import numpy as np
 
     from rca_tpu.engine import ShardedGraphEngine
@@ -305,6 +362,7 @@ def test_sharded_streaming_tick_parity_10k():
 
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")
+    monkeypatch.setenv("RCA_SEGSCAN", segscan)
     c = synthetic_cascade_arrays(10_000, n_roots=3, seed=4)
     names = [f"s{i}" for i in range(c.n)]
     dense = StreamingSession(
