@@ -203,21 +203,46 @@ def main(skip_accuracy: bool = False) -> int:
         reps.append((time.perf_counter() - t0) * 1e3)
     batch_ms = float(np.median(reps))
 
-    # marginal device cost per ADDED hypothesis (round 4, VERDICT item 7):
-    # the dispatch-time comparison above is tunnel-RTT-noise on both
-    # sides; (min t_B64 - min t_B1) / 63 isolates what an extra
-    # hypothesis actually costs on the chip
-    def batch_min_ms(width):
+    # marginal device cost per ADDED hypothesis (round 5, VERDICT r4
+    # item 2): measured with the SAME in-jit marginal-rep methodology as
+    # device_compute_ms_2k, per batch width — round 4 differenced dispatch
+    # walltimes through a tunnel with a ~134 ms floor and multi-ms jitter,
+    # which published a figure 20x off PERF.md's in-jit number.  Here each
+    # width's PURE DEVICE time per batch dispatch comes from the
+    # floor-cancelling (t_2R - t_R)/R form; the per-hypothesis marginal is
+    # their difference over the added width.  Repeated 3x for jitter bars.
+    def batch_device_ms(width, reps):
         fbw = jnp.asarray(batch[:1].repeat(width, 0))
-        jax.device_get(batched(fbw, sj, dj))
-        outs = []
-        for _ in range(6):
-            t0 = time.perf_counter()
-            jax.device_get(batched(fbw, sj, dj))
-            outs.append((time.perf_counter() - t0) * 1e3)
-        return float(np.min(outs))
 
-    batch_marginal_ms = (batch_min_ms(64) - batch_min_ms(1)) / 63.0
+        def make_many(reps_):
+            @jax.jit
+            def many(fb_, s_, d_, salt):
+                def body(i, acc):
+                    scores = jax.vmap(
+                        lambda f: prop(
+                            f * (1.0 + salt + i * 1e-7), s_, d_,
+                            n_live=n_services, up_ell=up_ell_2k,
+                            down_seg=ds_2k, up_seg=us_2k,
+                        )[4]
+                    )(fb_)
+                    return acc + scores.sum(0)
+                return jax.lax.fori_loop(
+                    0, reps_, body, jnp.zeros(fb_.shape[1])
+                )
+            return many
+
+        return amort_min_ms(make_many, (fbw, sj, dj), reps_in_jit=reps)
+
+    _marginals = []
+    for _ in range(3):
+        t1 = batch_device_ms(1, 32)
+        t64 = batch_device_ms(64, 4)
+        if t1 is not None and t64 is not None:
+            _marginals.append((t64 - t1) / 63.0)
+    batch_marginal_ms = float(np.median(_marginals)) if _marginals else None
+    batch_marginal_jitter_ms = (
+        float(np.max(_marginals) - np.min(_marginals)) if _marginals else None
+    )
 
     # pure device compute per 2k inference, amortized over an in-jit loop
     # (the headline ``value`` is single-shot end-to-end and so includes one
@@ -458,11 +483,23 @@ def main(skip_accuracy: bool = False) -> int:
         "n_services": n_services,
         "n_edges": result.n_edges,
         "sync_floor_ms": round(sync_floor_ms, 3),
+        # the headline minus the per-sync transport round trip (round 5,
+        # VERDICT r4 item 5): the <150 ms gate judged on WORK, not the
+        # tunnel RTT of the day — the raw floor varied 90-135 ms across
+        # rounds while device compute held still.  Raw `value` stays the
+        # honest end-to-end number a deployment pays.
+        "e2e_floor_subtracted_ms": round(
+            max(result.latency_ms - sync_floor_ms, 0.0), 3
+        ),
+        "vs_baseline_floor_subtracted": round(
+            target_ms / max(result.latency_ms - sync_floor_ms, 1e-6), 2
+        ),
         "device_compute_ms_2k": r(device_2k_ms),
         "latency_50k_amortized_ms": r(big_ms),
         "top1_hit_50k": bool(big_top1),
         "batch16_2k_dispatch_ms": round(batch_ms, 3),
-        "batch64_marginal_per_hypothesis_ms_2k": round(batch_marginal_ms, 4),
+        "batch64_marginal_per_hypothesis_ms_2k": r(batch_marginal_ms),
+        "batch64_marginal_jitter_ms": r(batch_marginal_jitter_ms),
         "tick_ms_10k": round(tick_ms_10k, 3),
         "tick_upload_rows_10k": tick_upload_rows,
         "live_quiet_capture_ms_10k": round(live_quiet_ms, 3),

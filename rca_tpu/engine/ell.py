@@ -35,6 +35,7 @@ from rca_tpu.engine.propagate import (
     _noisy_or,
     background_excess,
     combine_score,
+    fold_error_contrast,
 )
 
 DEFAULT_WIDTH_CAP = 32
@@ -144,7 +145,7 @@ def propagate_ell(
     dn_ovf_seg, dn_ovf_other,    # [Od]
     anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
-    n_live=None,
+    n_live=None, error_contrast: float = 0.0,
 ):
     """Scatter-free variant of :func:`rca_tpu.engine.propagate.propagate`.
 
@@ -153,8 +154,20 @@ def propagate_ell(
     row) carries zero features so padded lanes contribute the identity of
     each reduction (0 for max over nonnegatives, 0 for sum).
     """
+    from rca_tpu.features.schema import SvcF
+
     a = _noisy_or(features, anomaly_w)
     h = _noisy_or(features, hard_w)
+    if error_contrast:
+        # error-source contrast over the up table (dependencies per src):
+        # table lanes masked to the max identity 0, hub residue through
+        # the overflow scatter — same result as the COO form
+        e = jnp.clip(features[:, SvcF.ERROR_RATE], 0.0, 1.0)
+        dep_max = (e[up_idx] * up_mask).max(axis=1)
+        dep_max = dep_max.at[up_ovf_seg].max(e[up_ovf_other])
+        a = fold_error_contrast(
+            a, jnp.maximum(e - dep_max, 0.0), error_contrast
+        )
 
     def up_step(u, _):
         return ell_up_step(

@@ -59,6 +59,20 @@ class PropagationParams:
     # on tuning band 3000:3040, validated on disjoint bands 1000/2000:+60
     # (tools/accuracy_report.py; the v2 raw-sum formula capped β at 0.5)
     impact_bonus: float = 1.6
+    # error-SOURCE contrast (round 5, VERDICT r4 item 3): weight on the
+    # node's error rate IN EXCESS of its dependencies' max — errors flow
+    # downstream-to-upstream-of-the-call (a service failing because its
+    # dependency errors inherits that error rate, attenuated), so a node
+    # whose error rate exceeds every dependency's is an error SOURCE.
+    # This is the one channel that separated the round-4 adversarial_mixed
+    # miss (a config root with CONFIG and NOT_READY dropped: error_rate
+    # 0.58 vs its crashing hop-1 victim's 0.21 — PERF.md round-4 autopsy).
+    # 0.7 picked by sweep on bands 1000/7000 (PERF.md round-5 study:
+    # closes adversarial_mixed to 1.0, lifts every band-7000 archetype,
+    # regresses nothing); folded into the anomaly noisy-OR, so it is
+    # soft evidence amplified by impact and suppressed by explain-away
+    # like any other anomaly channel.
+    error_contrast: float = 0.7
 
     def weight_arrays(self):
         return (
@@ -138,6 +152,26 @@ def background_excess(a: jnp.ndarray, n_live=None) -> jnp.ndarray:
     return jnp.where(live, jnp.maximum(a - a_bg, 0.0), 0.0)
 
 
+def error_source_excess(features: jnp.ndarray, dep_src, dep_dst) -> jnp.ndarray:
+    """Per-node error rate in excess of the node's dependencies' max
+    (relu(e - max over edges (s,d) of e[d])), the round-5 error-SOURCE
+    contrast.  One gather + one scatter-max, outside the step loop.
+    Padded edges self-loop on the dummy slot whose error rate is 0, so
+    they contribute the max identity; a service with no dependencies
+    keeps its full error rate (a leaf that errors IS a source)."""
+    e = jnp.clip(features[:, SvcF.ERROR_RATE], 0.0, 1.0)
+    dep_max = jnp.zeros_like(e).at[dep_src].max(e[dep_dst])
+    return jnp.maximum(e - dep_max, 0.0)
+
+
+def fold_error_contrast(a, err_src, weight: float):
+    """Noisy-OR the contrast into the anomaly evidence — identical math
+    to a 14th feature channel with weight ``weight``, but computed where
+    the edges live (the contrast needs the graph, which the row-local
+    feature extractor never sees)."""
+    return 1.0 - (1.0 - a) * (1.0 - weight * err_src)
+
+
 def combine_score(a, h, u, m, explain_strength, impact_bonus):
     """Final root-cause score.  Explain-away suppresses *soft* symptoms
     (latency, error rates) that an anomalous upstream accounts for, damped
@@ -170,10 +204,16 @@ def propagate(
     up_ell=None,            # optional (idx, mask, ovf_seg, ovf_other)
     down_seg=None,          # optional engine.segscan.SegLayout
     up_seg=None,            # optional engine.segscan.SegLayout
+    error_contrast: float = 0.0,
 ):
     """Returns (anomaly, hard, upstream, impact, score), all [S]."""
     a = _noisy_or(features, anomaly_w)
     h = _noisy_or(features, hard_w)
+    if error_contrast:
+        a = fold_error_contrast(
+            a, error_source_excess(features, dep_src, dep_dst),
+            error_contrast,
+        )
     return propagate_core(
         a, h, dep_src, dep_dst, steps, decay, explain_strength, impact_bonus,
         n_live=n_live, up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,

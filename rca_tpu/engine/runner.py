@@ -107,14 +107,14 @@ def edge_layout() -> str:
     jax.jit,
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
-        "use_pallas",
+        "use_pallas", "error_contrast",
     ),
 )
 def _propagate_ranked(
     features, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, use_pallas: bool = False, n_live=None, up_ell=None,
-    down_seg=None, up_seg=None,
+    down_seg=None, up_seg=None, error_contrast: float = 0.0,
 ):
     """One dispatch, minimal transfers: edges arrive as one [2, E] buffer;
     diagnostics leave as one stacked [4, S] buffer plus the top-k pair.
@@ -127,8 +127,17 @@ def _propagate_ranked(
 
     if use_pallas:
         from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
+        from rca_tpu.engine.propagate import (
+            error_source_excess,
+            fold_error_contrast,
+        )
 
         a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
+        if error_contrast:
+            a = fold_error_contrast(
+                a, error_source_excess(features, edges[0], edges[1]),
+                error_contrast,
+            )
         out = propagate_core(
             a, h, edges[0], edges[1],
             steps, decay, explain_strength, impact_bonus, n_live=n_live,
@@ -140,6 +149,7 @@ def _propagate_ranked(
             features, edges[0], edges[1], anomaly_w, hard_w,
             steps, decay, explain_strength, impact_bonus, n_live=n_live,
             up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+            error_contrast=error_contrast,
         )
     vals, idx = jax.lax.top_k(score, k)
     return jnp.stack([a, u, m, score]), vals, idx
@@ -149,12 +159,14 @@ def _propagate_ranked(
     jax.jit,
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
+        "error_contrast",
     ),
 )
 def _propagate_ranked_batch(
     features_b, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live=None, up_ell=None, down_seg=None, up_seg=None,
+    error_contrast: float = 0.0,
 ):
     """Hypothesis batch over ONE graph in ONE dispatch: vmap of the
     propagation + per-hypothesis top-k (BASELINE.json "pmap over fault
@@ -167,6 +179,7 @@ def _propagate_ranked_batch(
             f, edges[0], edges[1], anomaly_w, hard_w,
             steps, decay, explain_strength, impact_bonus, n_live=n_live,
             up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+            error_contrast=error_contrast,
         )
         vals, idx = jax.lax.top_k(score, k)
         return jnp.stack([a, u, m, score]), vals, idx
@@ -176,19 +189,22 @@ def _propagate_ranked_batch(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("steps", "decay", "explain_strength", "impact_bonus", "k"),
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus", "k",
+        "error_contrast",
+    ),
 )
 def _propagate_ranked_ell(
     features, up_idx, up_mask, up_ovf, dn_idx, dn_mask, dn_ovf,
     anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
-    k: int, n_live=None,
+    k: int, n_live=None, error_contrast: float = 0.0,
 ):
     a, h, u, m, score = propagate_ell(
         features, up_idx, up_mask, up_ovf[0], up_ovf[1],
         dn_idx, dn_mask, dn_ovf[0], dn_ovf[1],
         anomaly_w, hard_w, steps, decay, explain_strength, impact_bonus,
-        n_live=n_live,
+        n_live=n_live, error_contrast=error_contrast,
     )
     vals, idx = jax.lax.top_k(score, k)
     return jnp.stack([a, u, m, score]), vals, idx
@@ -426,7 +442,7 @@ class GraphEngine(EngineAPI):
                     fj, up_idx, up_mask, up_ovf, dn_idx, dn_mask, dn_ovf,
                     self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
-                    n_live,
+                    n_live, error_contrast=p.error_contrast,
                 )
         else:
             ej = jnp.asarray(np.stack([s, d]))  # one [2, E] upload
@@ -465,6 +481,7 @@ class GraphEngine(EngineAPI):
                     fj, ej, self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
                     use_pallas, n_live, up_ell, down_seg, up_seg,
+                    error_contrast=p.error_contrast,
                 )
 
         stacked, vals, idx, latency_ms = timed_fetch(run, timed)
@@ -515,6 +532,7 @@ class GraphEngine(EngineAPI):
             jnp.asarray(fb), ej, self._aw, self._hw,
             p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
             jnp.asarray(n, jnp.int32), up_ell, down_seg, up_seg,
+            error_contrast=p.error_contrast,
         ))
         latency_ms = (_time.perf_counter() - t0) * 1e3
         return [

@@ -36,12 +36,14 @@ from rca_tpu.engine.runner import GraphEngine, _propagate_ranked, up_ell_for
     donate_argnums=(0,),
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
+        "error_contrast",
     ),
 )
 def _flush_propagate_ranked(
     features, idx, rows, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live, up_ell=None, down_seg=None, up_seg=None,
+    error_contrast: float = 0.0,
 ):
     """Whole tick in ONE dispatch: scatter the delta rows into the donated
     resident buffer, propagate, top-k.  On tunneled TPUs every dispatch pays
@@ -54,6 +56,7 @@ def _flush_propagate_ranked(
         features, edges[0], edges[1], anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, n_live=n_live,
         up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        error_contrast=error_contrast,
     )
     vals, topi = jax.lax.top_k(score, k)
     return features, vals, topi
@@ -218,7 +221,7 @@ class StreamingSession(StreamingHostState):
                 self._edges, self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
                 self._kk, self._n_live, self._up_ell, self._down_seg,
-                self._up_seg,
+                self._up_seg, error_contrast=p.error_contrast,
             )
             # only drop the deltas once the dispatch is accepted — a raise
             # above (fresh-tier compile failure) must leave them retryable
@@ -230,7 +233,7 @@ class StreamingSession(StreamingHostState):
                 self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
                 self._kk, False, self._n_live, self._up_ell, self._down_seg,
-                self._up_seg,
+                self._up_seg, error_contrast=p.error_contrast,
             )
         # sync through the fetch: block_until_ready alone can return at
         # enqueue time on tunneled backends, under-measuring the tick

@@ -187,14 +187,31 @@ def sharded_seg_layouts_for(graph: ShardedGraph) -> Optional[ShardedSegLayouts]:
 def _propagate_block(
     f_blk, src_local, src_global, dst_global, mask, n_live,
     aw, hw, steps: int, decay: float, mu: float, beta: float, seg=None,
+    error_contrast: float = 0.0,
 ):
     """Per-device kernel for ONE graph: f_blk is this shard's node block.
     ``seg`` (this shard's :class:`ShardedSegLayouts` slices) swaps the
     scatter primitives for the Pallas segmented scans; collectives and
     semantics are unchanged (sum order differs within a segment, so parity
     is allclose ~1e-6 like the dense segscan; max is order-invariant)."""
+    from rca_tpu.features.schema import SvcF
+
     a_blk = _noisy_or(f_blk, aw)
     h_blk = _noisy_or(f_blk, hw)
+    if error_contrast:
+        # error-source contrast (round 5): one extra one-time [block]
+        # all_gather; edges are partitioned by source shard, so the
+        # scatter-max of dependency error rates is block-local
+        from rca_tpu.engine.propagate import fold_error_contrast
+
+        e_blk = jnp.clip(f_blk[:, SvcF.ERROR_RATE], 0.0, 1.0)
+        e_full = jax.lax.all_gather(e_blk, "sp", tiled=True)
+        dep_max = jnp.zeros_like(e_blk).at[src_local].max(
+            mask * e_full[dst_global]
+        )
+        a_blk = fold_error_contrast(
+            a_blk, jnp.maximum(e_blk - dep_max, 0.0), error_contrast
+        )
     h_full = jax.lax.all_gather(h_blk, "sp", tiled=True)
     a_full = jax.lax.all_gather(a_blk, "sp", tiled=True)
 
@@ -268,6 +285,7 @@ def _propagate_block(
 def _jitted_shard_fn(
     mesh: Mesh, steps: int, decay: float, mu: float, beta: float,
     batch_axes: tuple = ("dp",), use_segscan: bool = False,
+    error_contrast: float = 0.0,
 ):
     """One traced+compiled shard_map per (mesh, scalar-params); weight
     vectors are runtime args so repeated calls hit jit's shape cache
@@ -292,6 +310,7 @@ def _jitted_shard_fn(
         kernel = functools.partial(
             _propagate_block,
             steps=steps, decay=decay, mu=mu, beta=beta, seg=seg,
+            error_contrast=error_contrast,
         )
         return jax.vmap(
             lambda f: kernel(f, src_l, src_g, dst_g, mask, n_live, aw=aw, hw=hw)
@@ -386,6 +405,7 @@ def stage_sharded(
         mesh, params.steps, params.decay,
         params.explain_strength, params.impact_bonus, tuple(batch_axes),
         use_segscan=seg is not None,
+        error_contrast=params.error_contrast,
     )
     batch_spec = tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
     fb = jax.device_put(

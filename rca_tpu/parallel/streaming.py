@@ -49,6 +49,7 @@ from rca_tpu.parallel.sharded import (
 def _jitted_tick_fn(
     mesh: Mesh, steps: int, decay: float, mu: float, beta: float,
     kk: int, block: int, use_segscan: bool = False,
+    error_contrast: float = 0.0,
 ):
     """One compiled scatter+propagate+top-k per (mesh, params, k, block);
     delta width and edge shapes key jit's shape cache underneath.
@@ -75,6 +76,7 @@ def _jitted_tick_fn(
         stack = _propagate_block(
             f_blk, src_l, src_g, dst_g, mask, n_live, aw, hw,
             steps=steps, decay=decay, mu=mu, beta=beta, seg=seg,
+            error_contrast=error_contrast,
         )
         score_blk = stack[3]
         # distributed top-k merge (same shape as sharded.sharded_topk,
@@ -155,6 +157,7 @@ class ShardedStreamingSession(StreamingHostState):
         self._fn = _jitted_tick_fn(
             self.mesh, p.steps, p.decay, p.explain_strength, p.impact_bonus,
             self._kk, self._block, use_segscan=seg is not None,
+            error_contrast=p.error_contrast,
         )
         self._feat_sharding = NamedSharding(self.mesh, P("sp", None))
         self._features = jax.device_put(
