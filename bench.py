@@ -25,7 +25,32 @@ import os
 import sys
 
 
-def main(skip_accuracy: bool = False) -> int:
+def chaos_metrics(seed: int = 7, ticks: int = 100) -> dict:
+    """Resilience row for the bench trajectory (``--chaos``): a seeded
+    chaos soak on the 50-service fixture — regression here means a fault
+    path stopped absorbing (see RESILIENCE.md; full knobs on the CLI:
+    ``python -m rca_tpu chaos``)."""
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.resilience.chaos import ChaosConfig, run_chaos_soak
+
+    summary = run_chaos_soak(
+        lambda: synthetic_cascade_world(50, n_roots=1, seed=0),
+        "synthetic", seed=seed, ticks=ticks, config=ChaosConfig(seed=seed),
+    )
+    return {
+        "ticks": summary["ticks"],
+        "uncaught_exceptions": summary["uncaught_exceptions"],
+        "all_classes_observed": summary["all_classes_observed"],
+        "parity_ok": summary["parity_ok"],
+        "parity_ticks_checked": summary["parity_ticks_checked"],
+        "degraded_ticks": summary["degraded_ticks"],
+        "sanitized_rows_total": summary["sanitized_rows_total"],
+        "resyncs_expired": summary["resyncs_expired"],
+        "resyncs_topology": summary["resyncs_topology"],
+    }
+
+
+def main(skip_accuracy: bool = False, with_chaos: bool = False) -> int:
     from rca_tpu.cluster.generator import synthetic_cascade_arrays
     from rca_tpu.engine import GraphEngine, make_engine
 
@@ -520,9 +545,16 @@ def main(skip_accuracy: bool = False) -> int:
     }
     if accuracy is not None:
         line["accuracy_by_mode"] = accuracy
+    if with_chaos:
+        line["chaos_soak_50svc"] = chaos_metrics(
+            seed=int(os.environ.get("RCA_CHAOS_SEED", "7"))
+        )
     print(json.dumps(line))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(skip_accuracy="--skip-accuracy" in sys.argv[1:]))
+    sys.exit(main(
+        skip_accuracy="--skip-accuracy" in sys.argv[1:],
+        with_chaos="--chaos" in sys.argv[1:],
+    ))

@@ -10,6 +10,7 @@
 - ``bench``     engine latency on a synthetic cascade
 - ``train``     fit propagation weights; save an orbax checkpoint
 - ``stream``    poll-driven live streaming analysis (1 Hz loop)
+- ``chaos``     seeded fault-injection soak over a synthetic world
 - ``investigations``  list / show persisted investigations
 - ``ui``        launch the Streamlit app (when streamlit is installed)
 
@@ -316,7 +317,7 @@ def cmd_stream(args) -> int:
     live = LiveStreamingSession(client, namespace, k=args.top)
     for i in range(args.ticks):
         out = live.poll()
-        print(json.dumps({
+        line = {
             "tick": out["tick"],
             "latency_ms": round(out["latency_ms"], 3),
             "capture_ms": out["capture_ms"],
@@ -325,10 +326,65 @@ def cmd_stream(args) -> int:
             "upload_rows": out["upload_rows"],
             "resynced": out["resynced"],
             "ranked": out["ranked"],
-        }, default=str), flush=True)
+        }
+        # resilience channel (RESILIENCE.md): only printed when something
+        # actually degraded, so the healthy stream output stays identical
+        health = out.get("health", {})
+        if out.get("degraded"):
+            line["degraded"] = True
+        if health.get("sanitized_rows"):
+            line["sanitized_rows"] = health["sanitized_rows"]
+        if health.get("degradation"):
+            line["degradation_rung"] = health["degradation_rung"]
+        print(json.dumps(line, default=str), flush=True)
         if args.interval > 0 and i + 1 < args.ticks:
             _time.sleep(args.interval)
     return 0
+
+
+def cmd_chaos(args) -> int:
+    """Seeded chaos soak (RESILIENCE.md): run a LiveStreamingSession over
+    a fault-injecting :class:`ChaosClusterClient` wrapper for N ticks and
+    score the resilience contract — zero uncaught exceptions, every fault
+    class observed in the health records, and fault-free ticks
+    bit-identical to a fault-free baseline session.  Exit 0 only when the
+    contract holds.  ``--seed`` (or ``RCA_CHAOS_SEED``) seeds the fault
+    schedule; ``--world-seed`` seeds the synthetic world."""
+    import os
+
+    from rca_tpu.resilience.chaos import ChaosConfig, run_chaos_soak
+
+    m = re.fullmatch(r"(\d+)svc", args.fixture or "50svc")
+    if not m:
+        raise SystemExit(
+            f"chaos needs a synthetic fixture (<N>svc), got {args.fixture!r}"
+        )
+    n_services = int(m.group(1))
+    seed = (
+        args.seed if args.seed is not None
+        else int(os.environ.get("RCA_CHAOS_SEED", "7"))
+    )
+
+    def make_world():
+        from rca_tpu.cluster.generator import synthetic_cascade_world
+
+        return synthetic_cascade_world(
+            n_services, n_roots=1, seed=args.world_seed,
+            fault_mix=args.fault_mix,
+        )
+
+    summary = run_chaos_soak(
+        make_world, "synthetic", seed=seed, ticks=args.ticks, k=args.top,
+        config=ChaosConfig(seed=seed),
+        topology_check_every=args.topology_check_every,
+    )
+    print(json.dumps(summary, indent=None if args.compact else 2))
+    ok = (
+        summary["uncaught_exceptions"] == 0
+        and summary["parity_ok"]
+        and (summary["all_classes_observed"] or args.ticks < 100)
+    )
+    return 0 if ok else 1
 
 
 def cmd_investigations(args) -> int:
@@ -474,6 +530,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="save the checkpoint even when the shippability "
                     "gate fails (research use)")
     sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser(
+        "chaos",
+        help="seeded chaos soak: fault injection over a synthetic world; "
+        "asserts zero uncaught exceptions + fault-free tick parity",
+    )
+    sp.add_argument("--fixture", default="50svc", help="<N>svc synthetic")
+    sp.add_argument("--seed", type=int, default=None,
+                    help="chaos schedule seed (default: $RCA_CHAOS_SEED or 7)")
+    sp.add_argument("--world-seed", type=int, default=0, dest="world_seed")
+    sp.add_argument("--fault-mix", default="crash", dest="fault_mix")
+    sp.add_argument("--ticks", type=int, default=200)
+    sp.add_argument("--top", type=int, default=5)
+    sp.add_argument("--topology-check-every", type=int, default=5,
+                    dest="topology_check_every")
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser("investigations", help="list/show investigations")
     sp.add_argument("--id", default=None)

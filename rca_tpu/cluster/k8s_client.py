@@ -22,6 +22,7 @@ import subprocess
 from typing import Any, Dict, List, Optional
 
 from rca_tpu.findings import utcnow_iso
+from rca_tpu.resilience.policy import Retry, suppressed
 
 try:  # gated: the kubernetes lib is an optional dependency
     from kubernetes import client as k8s_api
@@ -110,6 +111,12 @@ class K8sApiClient:
         self._kubeconfig = kubeconfig or os.environ.get("KUBECONFIG")
         self._context = context
         self._verify_ssl = verify_ssl
+        # transient API flakes retry with backoff before landing in the
+        # degraded-mode error channel (RCA_API_RETRIES=0 disables)
+        self._retry = Retry(
+            attempts=int(os.environ.get("RCA_API_RETRIES", "2")),
+            base_delay=0.1, max_delay=2.0, seed=0,
+        )
         self._connect()
 
     def _connect(self) -> None:
@@ -126,10 +133,8 @@ class K8sApiClient:
         # connection.
         with self._pumps_registry() as pumps_by_ns:
             for pumps in pumps_by_ns.values():
-                try:
+                with suppressed("k8s.reconnect_pump_stop"):
                     pumps.stop()
-                except Exception:
-                    pass
             pumps_by_ns.clear()
         if not HAVE_K8S_LIB:
             return
@@ -292,15 +297,13 @@ class K8sApiClient:
                 cmd += ["--kubeconfig", self._kubeconfig]
             cmd += ["--context", context, "get", "namespaces",
                     "-o", "name", "--request-timeout=5s"]
-            try:
+            with suppressed("k8s.switch_context_probe"):
                 proc = subprocess.run(
                     cmd, capture_output=True, text=True, timeout=10,
                     check=False,
                 )
                 if proc.returncode == 0:
                     return True
-            except Exception:
-                pass
         # restore rather than strand the client on a broken context
         self._context = previous
         self._connect()
@@ -347,10 +350,11 @@ class K8sApiClient:
         # api object is looked up lazily so disconnected clients (no
         # kubernetes lib / no cluster) degrade to [] instead of raising —
         # but NEVER silently: the failure lands in the error channel.
+        # Transient failures retry with backoff first (self._retry).
         if not self._connected or api is None:
             return []
         try:
-            resp = getattr(api, method)(*args, **kwargs)
+            resp = self._retry.call(getattr(api, method), *args, **kwargs)
             return [self._sanitize(item) for item in resp.items]
         except Exception as exc:
             self._record_error(method, f"{type(exc).__name__}: {exc}")
@@ -391,7 +395,9 @@ class K8sApiClient:
         if not self._connected:
             return None
         try:
-            return self._sanitize(self._core.read_namespaced_pod(name, namespace))
+            return self._sanitize(self._retry.call(
+                self._core.read_namespaced_pod, name, namespace
+            ))
         except Exception as exc:
             self._record_error(
                 "read_namespaced_pod", f"{type(exc).__name__}: {exc}"
@@ -409,7 +415,8 @@ class K8sApiClient:
         if not self._connected:
             return ""
         try:
-            return self._core.read_namespaced_pod_log(
+            return self._retry.call(
+                self._core.read_namespaced_pod_log,
                 pod_name,
                 namespace,
                 container=container,
@@ -610,11 +617,15 @@ class K8sApiClient:
         if not self._connected:
             return []
         try:
-            resp = self._core.list_namespaced_event(
-                namespace, field_selector=field_selector
+            resp = self._retry.call(
+                self._core.list_namespaced_event,
+                namespace, field_selector=field_selector,
             )
             return [self._sanitize(i) for i in resp.items]
-        except Exception:
+        except Exception as exc:
+            self._record_error(
+                "list_namespaced_event", f"{type(exc).__name__}: {exc}"
+            )
             return []
 
     # ---- traces -----------------------------------------------------------

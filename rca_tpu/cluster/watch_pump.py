@@ -53,6 +53,8 @@ import collections
 import threading
 from typing import Any, Dict, List, Optional
 
+from rca_tpu.resilience.policy import Retry, record_fault, suppressed
+
 QUEUE_CAP = 10_000
 # registry bound: dropping a consumer record is always safe (an unknown
 # token reads as expired, which forces the one correct recovery — resync)
@@ -65,6 +67,20 @@ _PUMPED = (
     ("pod", "list_namespaced_pod"),
     ("event", "list_namespaced_event"),
 )
+
+
+def _looks_like_gone(exc: BaseException) -> bool:
+    """Is this a 410 Gone (resourceVersion compacted away)?  A 410 is NOT
+    retryable at the stream level: the tracked RV is dead, consumers must
+    re-list.  Matched on the ApiException status when present, else on the
+    server's message shape."""
+    status = getattr(exc, "status", None)
+    if status == 410:
+        return True
+    msg = str(exc).lower()
+    return "410" in msg or "too old resource version" in msg or (
+        "expired" in msg
+    )
 
 
 def _meta_attr(obj: Any, attr: str) -> str:
@@ -93,58 +109,83 @@ class _Pump(threading.Thread):
         # iteration promptly instead of waiting out the server timeout
         self.watch_handle = w
         list_fn = getattr(self.owner.core, self.list_method)
+        retry = self.owner.retry
+        attempt = 0
+        rv = None
+        listed = False
         try:
-            # initial list pins the stream start (collection RV): the
-            # watch resumes from "now" with no synthetic replay of the
-            # existing objects
-            resp = list_fn(namespace=self.owner.namespace, limit=1)
-            rv = getattr(
-                getattr(resp, "metadata", None), "resource_version", None,
-            )
             while not self.owner._stop.is_set():
-                stream = w.stream(
-                    list_fn,
-                    namespace=self.owner.namespace,
-                    timeout_seconds=30,
-                    resource_version=rv,
-                    allow_watch_bookmarks=True,
-                )
-                for ev in stream:
+                try:
+                    if not listed:
+                        # initial list pins the stream start (collection
+                        # RV): the watch resumes from "now" with no
+                        # synthetic replay of the existing objects
+                        resp = list_fn(
+                            namespace=self.owner.namespace, limit=1
+                        )
+                        rv = getattr(
+                            getattr(resp, "metadata", None),
+                            "resource_version", None,
+                        )
+                        listed = True
+                    stream = w.stream(
+                        list_fn,
+                        namespace=self.owner.namespace,
+                        timeout_seconds=30,
+                        resource_version=rv,
+                        allow_watch_bookmarks=True,
+                    )
+                    for ev in stream:
+                        if self.owner._stop.is_set():
+                            return
+                        obj = ev.get("object")
+                        # every event (bookmarks included) advances the RV
+                        # so the next renewal resumes without replay
+                        new_rv = _meta_attr(obj, "resource_version")
+                        if new_rv:
+                            rv = new_rv
+                        if str(ev.get("type", "")).upper() == "BOOKMARK":
+                            continue
+                        name = _meta_attr(obj, "name")
+                        if self.kind == "event":
+                            # the change the analyzer cares about is the
+                            # event's INVOLVED object; fall back to the
+                            # event's own name
+                            inv = getattr(obj, "involved_object", None)
+                            if inv is not None and getattr(inv, "name", ""):
+                                name = inv.name
+                            elif isinstance(obj, dict):
+                                name = (
+                                    obj.get("involvedObject", {})
+                                    .get("name", "")
+                                    or name
+                                )
+                        if name:
+                            self.owner.push(self.kind, name)
+                    # normal stream end (server timeout): reopen at the
+                    # tracked RV; a clean round also resets the backoff
+                    attempt = 0
+                except Exception as exc:
                     if self.owner._stop.is_set():
+                        # a teardown-induced stream break is a shutdown,
+                        # not a 410: expiring here would force every
+                        # consumer of the NEXT connection's feed into a
+                        # spurious resync
                         return
-                    obj = ev.get("object")
-                    # every event (bookmarks included) advances the RV so
-                    # the next renewal resumes without replay
-                    new_rv = _meta_attr(obj, "resource_version")
-                    if new_rv:
-                        rv = new_rv
-                    if str(ev.get("type", "")).upper() == "BOOKMARK":
-                        continue
-                    name = _meta_attr(obj, "name")
-                    if self.kind == "event":
-                        # the change the analyzer cares about is the event's
-                        # INVOLVED object; fall back to the event's own name
-                        inv = getattr(obj, "involved_object", None)
-                        if inv is not None and getattr(inv, "name", ""):
-                            name = inv.name
-                        elif isinstance(obj, dict):
-                            name = (
-                                obj.get("involvedObject", {}).get("name", "")
-                                or name
-                            )
-                    if name:
-                        self.owner.push(self.kind, name)
-                # normal stream end (server timeout): reopen at tracked RV
-        except Exception:
-            if self.owner._stop.is_set():
-                # a teardown-induced stream break is a shutdown, not a 410:
-                # expiring here would force every consumer of the NEXT
-                # connection's feed into a spurious resync
-                return
-            # 410 Gone / network error / anything: the consumer must
-            # re-list; a dead pump silently dropping changes would be the
-            # one unrecoverable failure mode
-            self.owner.mark_expired()
+                    if _looks_like_gone(exc) or attempt >= retry.attempts:
+                        # 410 (RV compacted — consumers MUST re-list) or
+                        # retries exhausted: a dead pump silently dropping
+                        # changes would be the one unrecoverable failure
+                        # mode, so expire the set loudly
+                        self.owner.mark_expired()
+                        return
+                    # transient stream error: resuming at the tracked RV
+                    # replays nothing and loses nothing (that is what RV
+                    # tracking buys) — back off and reopen instead of
+                    # expiring every consumer into a full resync
+                    attempt += 1
+                    record_fault(f"watch_pump.{self.kind}.reopen", exc)
+                    retry.sleep_for(attempt)
         finally:
             w.stop()
 
@@ -154,9 +195,16 @@ class WatchPumpSet:
 
     _counter = 0
 
-    def __init__(self, core_api: Any, namespace: str):
+    def __init__(self, core_api: Any, namespace: str,
+                 retry: Optional[Retry] = None):
         self.core = core_api
         self.namespace = namespace
+        # transient stream errors reopen at the tracked RV with backoff
+        # before the set expires (a 410 still expires immediately);
+        # injectable for hermetic tests
+        self.retry = retry or Retry(
+            attempts=2, base_delay=0.2, max_delay=5.0, seed=0,
+        )
         self._lock = threading.Lock()
         # journal window: _journal[i] has absolute sequence _base + i
         self._journal: collections.deque = collections.deque()
@@ -177,10 +225,8 @@ class WatchPumpSet:
         for t in self._threads:
             w = t.watch_handle
             if w is not None:
-                try:
+                with suppressed("watch_pump.stop"):
                     w.stop()
-                except Exception:
-                    pass
 
     # -- consumer registry --------------------------------------------------
     def register(self) -> str:

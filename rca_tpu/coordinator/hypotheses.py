@@ -178,12 +178,12 @@ def execute_investigation_step(
                 namespace, name, tail_lines=100
             )
             previous = ""
-            try:
+            from rca_tpu.resilience.policy import suppressed
+
+            with suppressed("hypotheses.previous_logs"):
                 previous = coord.cluster.get_pod_logs(
                     namespace, name, previous=True, tail_lines=100
                 )
-            except Exception:
-                pass
             result: Any = {"logs": current[-4000:],
                            "previous_logs": previous[-4000:]}
         elif stype == "events":
@@ -286,12 +286,12 @@ def _get_evidence_for_component(
         if kind.lower() == "pod":
             pod = coord.cluster.get_pod(namespace, name)
             out["status"] = (pod or {}).get("status", {})
-            try:
+            from rca_tpu.resilience.policy import suppressed
+
+            with suppressed("hypotheses.log_tail"):
                 out["log_tail"] = coord.cluster.get_pod_logs(
                     namespace, name, tail_lines=50
                 )[-2000:]
-            except Exception:
-                pass
         elif kind.lower() == "deployment":
             out["deployment"] = coord.cluster.get_deployment(namespace, name)
         elif kind.lower() == "service":

@@ -135,7 +135,13 @@ def ell_up_step(u, h, decay, idx, mask, ovf_seg, ovf_other):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("steps", "decay", "explain_strength", "impact_bonus"),
+    # error_contrast must be static: the kernel branches on it in Python
+    # (`if error_contrast:`) — traced, that branch dies with
+    # TracerBoolConversionError the first time the ELL path runs
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus",
+        "error_contrast",
+    ),
 )
 def propagate_ell(
     features,                    # [S_pad, C]

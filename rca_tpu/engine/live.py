@@ -54,6 +54,19 @@ from rca_tpu.engine.runner import GraphEngine
 from rca_tpu.engine.streaming import StreamingSession, make_streaming_session
 from rca_tpu.features.extract import extract_features
 from rca_tpu.graph.build import service_dependency_edges
+from rca_tpu.resilience.policy import (
+    drain_faults,
+    record_fault,
+    retry_counter,
+    suppressed,
+)
+
+# degradation ladder rungs (engine guards): repeated device-dispatch
+# failure walks the session down one rung at a time instead of crashing
+# poll() — see RESILIENCE.md
+DEGRADATION_LADDER = ("full", "single-device", "interpret")
+# consecutive tick failures before stepping down a rung
+_TICK_FAILURES_TO_DEGRADE = 2
 
 # change kinds that shape the dependency graph: cheaper to rebuild the
 # session than to prove a patch preserves the edges
@@ -96,6 +109,16 @@ class LiveStreamingSession:
         self.topology_check_every = max(1, int(topology_check_every))
         self._polls = 0
         self.resyncs = -1  # first _resync is initialization, not a resync
+        # resync cause split (chaos runs assert on WHY a session resynced):
+        # feed expiry / lost-notification recovery vs. a real topology move
+        self.resyncs_expired = 0
+        self.resyncs_topology = 0
+        # degradation ladder position (index into DEGRADATION_LADDER) and
+        # the consecutive-tick-failure count that advances it
+        self.degradation = 0
+        self._tick_failures = 0
+        self._retries_mark = retry_counter()
+        self._last_ranked: List[dict] = []
         self._cursor: Optional[str] = None
         # set when a poll drained the feed but then failed to apply the
         # changes (sweep raised, or the capture came back partial): the
@@ -115,11 +138,16 @@ class LiveStreamingSession:
         self._resync()
 
     # -- topology (re)build -------------------------------------------------
-    def _resync(self, snap=None, fs=None, edges=None) -> None:
+    def _resync(self, snap=None, fs=None, edges=None,
+                cause: str = "topology") -> None:
         """Rebuild from an ALREADY-captured snapshot when the caller has
         one (poll() detected the change on it) — re-capturing here would
         sweep the cluster twice per resync tick and rebuild from different
-        state than the change-detection examined."""
+        state than the change-detection examined.
+
+        ``cause`` feeds the split resync counters: ``"expired"`` for
+        feed-expiry / lost-notification recovery, ``"topology"`` for a
+        real service-graph move — chaos soaks assert on the cause."""
         if snap is None:
             # reopen the change feed BEFORE listing: changes that land
             # during the capture get re-reported next poll (a harmless
@@ -142,6 +170,9 @@ class LiveStreamingSession:
         self._snap = snap if self._watch else None
         self._names = list(fs.service_names)
         self._edge_key = (src.tobytes(), dst.tobytes())
+        # raw edges retained so the degradation ladder can rebuild the
+        # session on a downgraded engine without re-capturing
+        self._edges_raw = (np.asarray(src), np.asarray(dst))
         self._features = np.array(fs.service_features, np.float32)
         self.session = make_streaming_session(
             self._names, src, dst,
@@ -149,7 +180,14 @@ class LiveStreamingSession:
             engine=self.engine, k=self.k,
         )
         self.session.set_all(self._features)
+        is_init = self.resyncs < 0
         self.resyncs += 1
+        if not is_init:
+            if cause == "expired":
+                self.resyncs_expired += 1
+            else:
+                self.resyncs_topology += 1
+        self._last_resync_cause = None if is_init else cause
 
     def _reopen_feed(self) -> None:
         if self._watch:
@@ -161,10 +199,8 @@ class LiveStreamingSession:
             if self._cursor is not None:
                 close = getattr(self.client, "watch_close", None)
                 if close is not None:
-                    try:
+                    with suppressed("live.watch_close"):
                         close(self.namespace, self._cursor)
-                    except Exception:
-                        pass
             try:
                 probe = self.client.watch_changes(self.namespace, None)
             except (AttributeError, TypeError):
@@ -270,7 +306,7 @@ class LiveStreamingSession:
         fs = extract_features(snap2)
         if list(fs.service_names) != self._names:
             # the service set itself moved while we were blind: full rebuild
-            self._resync(snap=snap2, fs=fs)
+            self._resync(snap=snap2, fs=fs, cause="expired")
             return self._finish(
                 t0, changed=len(self._names), resynced=True, quiet=False,
             )
@@ -303,7 +339,7 @@ class LiveStreamingSession:
             # a journaled trace update re-pulls the four payloads (each is
             # one call); UN-journaled trace drift is covered by the
             # periodic sweep like edge drift
-            try:
+            with suppressed("live.patch_traces"):
                 patch["traces"] = {
                     "latency": self.client.get_service_latency_stats(
                         self.namespace),
@@ -314,8 +350,6 @@ class LiveStreamingSession:
                     "slow_ops": self.client.find_slow_operations(
                         self.namespace),
                 }
-            except Exception:
-                pass
         if pod_names:
             by_name_old = {
                 p.get("metadata", {}).get("name"): p for p in snap.pods
@@ -371,8 +405,124 @@ class LiveStreamingSession:
         Returns the tick result plus ``changed_rows`` (real changed
         services before padding), ``resynced`` (topology changed → full
         rebuild this poll), ``capture_ms`` (host-side capture/patch time),
-        and ``quiet`` (watch path, no changes: no capture ran at all)."""
+        ``quiet`` (watch path, no changes: no capture ran at all),
+        ``degraded`` + ``health`` (the resilience contract, below).
+
+        Tick-loop contract (RESILIENCE.md): ``poll()`` NEVER raises on a
+        fault — injected or real.  A failing capture/patch/tick returns
+        the last known ranking with ``degraded: True`` and a per-tick
+        health record (sanitized-row count, resync causes, retries spent,
+        swallowed faults, injected chaos faults, ladder position); the
+        next poll recovers with a full resync.  When no fault fires the
+        output is bit-identical to the pre-resilience behavior (PARITY.md
+        invariant)."""
         self._polls += 1
+        try:
+            out = self._poll_inner()
+            out["degraded"] = bool(out.pop("_tick_degraded", False))
+        except Exception as exc:
+            record_fault("live.poll", exc)
+            # whatever the failing poll drained is gone from the feed —
+            # recover it with a full resync next poll
+            self._pending_resync = True
+            out = {
+                "ranked": list(self._last_ranked),
+                "latency_ms": 0.0, "capture_ms": 0.0,
+                "changed_rows": 0, "upload_rows": 0,
+                "sanitized_rows": 0, "quiet": False, "resynced": False,
+                "resyncs": self.resyncs, "tick": self._polls,
+                "degraded": True,
+            }
+        self._last_ranked = list(out.get("ranked", []))
+        out["health"] = self._health_record(out)
+        return out
+
+    def _health_record(self, out: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-tick resilience health: what degraded, why, and how much
+        recovery effort was spent."""
+        injected: List[Dict[str, str]] = []
+        drain = getattr(self.client, "drain_injected", None)
+        if drain is not None:
+            with suppressed("live.drain_injected"):
+                injected = drain()
+        retries_now = retry_counter()
+        spent = retries_now - self._retries_mark
+        self._retries_mark = retries_now
+        return {
+            "sanitized_rows": int(out.get("sanitized_rows", 0)),
+            "resyncs_expired": self.resyncs_expired,
+            "resyncs_topology": self.resyncs_topology,
+            "resync_cause": (
+                self._last_resync_cause if out.get("resynced") else None
+            ),
+            "retries": int(spent),
+            "faults": drain_faults(),
+            "injected": injected,
+            "degradation": self.degradation,
+            "degradation_rung": DEGRADATION_LADDER[self.degradation],
+        }
+
+    # -- degradation ladder -------------------------------------------------
+    def _degrade(self) -> None:
+        """Step one rung down: sharded/full → single-device GraphEngine →
+        interpret mode (jit disabled, op-by-op dispatch).  The rebuilt
+        session re-uploads the retained feature matrix; the ladder is
+        sticky for the session lifetime — a resync keeps the downgraded
+        engine (repeated dispatch failure is an environment property, not
+        a per-graph one)."""
+        self.degradation = min(self.degradation + 1,
+                               len(DEGRADATION_LADDER) - 1)
+        self._tick_failures = 0
+        if self.degradation == 1:
+            self.engine = GraphEngine()
+            src, dst = self._edges_raw
+            self.session = make_streaming_session(
+                self._names, src, dst,
+                num_features=self._features.shape[1],
+                engine=self.engine, k=self.k,
+            )
+            self.session.set_all(self._features)
+        # rung 2 ("interpret") keeps the single-device session and runs
+        # its tick under jax.disable_jit() — see _guarded_tick
+
+    def _guarded_tick(self) -> Dict[str, Any]:
+        """session.tick() under the degradation ladder: a dispatch failure
+        records the fault, steps the ladder after repeated failure, and
+        retries — poll() never sees the exception unless every rung fails.
+        """
+        import jax
+
+        last_exc: Optional[Exception] = None
+        for _ in range(len(DEGRADATION_LADDER) + 1):
+            try:
+                if self.degradation >= 2:
+                    with jax.disable_jit():
+                        out = self.session.tick()
+                else:
+                    out = self.session.tick()
+                self._tick_failures = 0
+                if last_exc is not None or self.degradation > 0:
+                    out["_tick_degraded"] = True
+                return out
+            except Exception as exc:
+                last_exc = exc
+                record_fault(
+                    f"live.tick[{DEGRADATION_LADDER[self.degradation]}]", exc
+                )
+                self._tick_failures += 1
+                if self.degradation >= len(DEGRADATION_LADDER) - 1:
+                    break
+                if self._tick_failures >= _TICK_FAILURES_TO_DEGRADE:
+                    self._degrade()
+        # every rung failed (or the bottom rung keeps failing): degraded
+        # no-result tick — the ranking is stale but poll() stays alive
+        return {
+            "ranked": list(self._last_ranked), "latency_ms": 0.0,
+            "tick": self._polls, "upload_rows": 0, "sanitized_rows": 0,
+            "_tick_degraded": True,
+        }
+
+    def _poll_inner(self) -> Dict[str, Any]:
         if not self._watch:
             return self._poll_sweep()
         t0 = time.perf_counter()
@@ -380,7 +530,7 @@ class LiveStreamingSession:
             # the previous poll drained notifications it could not apply;
             # a fresh full capture re-covers whatever they described
             self._pending_resync = False
-            self._resync()
+            self._resync(cause="expired")
             return self._finish(
                 t0, changed=len(self._names), resynced=True, quiet=False,
             )
@@ -466,7 +616,7 @@ class LiveStreamingSession:
     def _finish(self, t0: float, changed: int, resynced: bool,
                 quiet: bool) -> Dict[str, Any]:
         capture_ms = (time.perf_counter() - t0) * 1e3
-        out = self.session.tick()
+        out = self._guarded_tick()
         out.update(
             changed_rows=changed, resynced=resynced, quiet=quiet,
             capture_ms=round(capture_ms, 2), resyncs=self.resyncs,

@@ -129,6 +129,25 @@ def _noisy_or(features: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
     return 1.0 - jnp.prod(1.0 - clipped * weights[None, :], axis=1)
 
 
+def finite_mask_rows(features: jnp.ndarray):
+    """Zero every feature row carrying a NaN/Inf; return (clean, n_bad).
+
+    The resilience guard in front of propagation: a collector feeding a
+    poisoned metric channel (NaN usage, Inf latency) must degrade that ONE
+    service's evidence to "no signal", not propagate NaN through the whole
+    explain-away scan and wipe the ranking.  Runs fused inside the same
+    dispatch as propagation (no extra host sync); on all-finite input
+    ``jnp.where`` passes the original values through bit-identically, so
+    the fault-free path keeps the CPU/TPU parity invariant (PARITY.md).
+
+    Accepts [S, C] or batched [B, S, C]; ``n_bad`` is the total zeroed
+    row count as a traced int32 scalar (fetched alongside top-k)."""
+    ok = jnp.all(jnp.isfinite(features), axis=-1, keepdims=True)
+    clean = jnp.where(ok, features, jnp.zeros_like(features))
+    n_bad = jnp.sum(jnp.logical_not(ok)).astype(jnp.int32)
+    return clean, n_bad
+
+
 def background_excess(a: jnp.ndarray, n_live=None) -> jnp.ndarray:
     """Anomaly excess over the cascade-wide background level.  Correlated
     noise (scrape jitter, a hot node) lifts every service's evidence

@@ -30,6 +30,22 @@ from rca_tpu.engine.propagate import (
 UP_WIDTH_CAP = 8  # dependencies per service are few; hub FAN-IN is not
 
 
+def finite_mask_rows_np(features: np.ndarray):
+    """Host-side twin of :func:`rca_tpu.engine.propagate.finite_mask_rows`
+    for paths whose features are staged from host anyway (the sharded
+    engine's pre-upload pad, the sharded streaming session's delta rows):
+    zero non-finite rows in a COPY, return (clean, n_bad).  Same zeroing
+    semantics as the fused on-device pass so dense/sharded score parity
+    holds under poisoned input too."""
+    features = np.asarray(features, np.float32)
+    ok = np.all(np.isfinite(features), axis=-1)
+    if ok.all():
+        return features, 0
+    clean = features.copy()
+    clean[~ok] = 0.0
+    return clean, int(np.sum(~ok))
+
+
 def build_up_ell(n_pad: int, dep_src, dep_dst):
     """Device arrays for the hybrid layout's upstream gather table:
     (idx, mask, ovf_seg, ovf_other), grouping each service's dependencies
@@ -122,8 +138,15 @@ def _propagate_ranked(
 
     With ``use_pallas`` the two noisy-OR evidence passes run as the fused
     Pallas kernel over the channel-major transpose (one feature read feeds
-    both products); the propagation core is shared either way."""
-    from rca_tpu.engine.propagate import propagate_core
+    both products); the propagation core is shared either way.
+
+    The finite-mask sanitize runs first, fused into this same dispatch:
+    NaN/Inf rows (poisoned telemetry) zero out on device and the count
+    rides back with the top-k fetch — no extra host sync, bit-identical
+    pass-through on clean input."""
+    from rca_tpu.engine.propagate import finite_mask_rows, propagate_core
+
+    features, n_bad = finite_mask_rows(features)
 
     if use_pallas:
         from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
@@ -152,7 +175,7 @@ def _propagate_ranked(
             error_contrast=error_contrast,
         )
     vals, idx = jax.lax.top_k(score, k)
-    return jnp.stack([a, u, m, score]), vals, idx
+    return jnp.stack([a, u, m, score]), vals, idx, n_bad
 
 
 @functools.partial(
@@ -172,7 +195,9 @@ def _propagate_ranked_batch(
     propagation + per-hypothesis top-k (BASELINE.json "pmap over fault
     candidates" — on a single device the batch rides vmap lanes; the
     sharded engine's dp axis covers multi-device batches)."""
-    from rca_tpu.engine.propagate import propagate
+    from rca_tpu.engine.propagate import finite_mask_rows, propagate
+
+    features_b, n_bad = finite_mask_rows(features_b)
 
     def one(f):
         a, h, u, m, score = propagate(
@@ -184,7 +209,8 @@ def _propagate_ranked_batch(
         vals, idx = jax.lax.top_k(score, k)
         return jnp.stack([a, u, m, score]), vals, idx
 
-    return jax.vmap(one)(features_b)
+    stacked, vals, idx = jax.vmap(one)(features_b)
+    return stacked, vals, idx, n_bad
 
 
 @functools.partial(
@@ -200,6 +226,9 @@ def _propagate_ranked_ell(
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live=None, error_contrast: float = 0.0,
 ):
+    from rca_tpu.engine.propagate import finite_mask_rows
+
+    features, n_bad = finite_mask_rows(features)
     a, h, u, m, score = propagate_ell(
         features, up_idx, up_mask, up_ovf[0], up_ovf[1],
         dn_idx, dn_mask, dn_ovf[0], dn_ovf[1],
@@ -207,7 +236,7 @@ def _propagate_ranked_ell(
         n_live=n_live, error_contrast=error_contrast,
     )
     vals, idx = jax.lax.top_k(score, k)
-    return jnp.stack([a, u, m, score]), vals, idx
+    return jnp.stack([a, u, m, score]), vals, idx, n_bad
 from rca_tpu.features.extract import FeatureSet, extract_features
 from rca_tpu.graph.build import service_dependency_edges
 
@@ -224,6 +253,9 @@ class EngineResult:
     n_services: int
     n_edges: int
     engine: str = "single"        # which engine ran: single | sharded(...)
+    # feature rows zeroed by the finite-mask guard (NaN/Inf telemetry);
+    # 0 on clean input — nonzero means the analysis ran DEGRADED
+    sanitized_rows: int = 0
 
     def top_components(self, k: Optional[int] = None) -> List[str]:
         items = self.ranked if k is None else self.ranked[:k]
@@ -240,6 +272,7 @@ def render_result(
     latency_ms: float,
     n_edges: int,
     engine: str,
+    sanitized_rows: int = 0,
 ) -> EngineResult:
     """Shared host-side rendering: identical findings regardless of which
     engine (single-device or sharded) produced the device arrays."""
@@ -269,6 +302,7 @@ def render_result(
         n_services=n,
         n_edges=n_edges,
         engine=engine,
+        sanitized_rows=int(sanitized_rows),
     )
 
 
@@ -308,7 +342,9 @@ def resolve_params(
 
 def timed_fetch(run, timed: bool):
     """Shared fetch-synced execution for BOTH engines: ``run`` returns
-    (stacked_diagnostics, topk_vals, topk_idx) device values.
+    (stacked_diagnostics, topk_vals, topk_idx, sanitized_rows) device
+    values (``sanitized_rows`` may be a host int for engines that
+    sanitize host-side).
 
     Timing syncs through device_get of the top-k pair, NOT
     block_until_ready: on tunneled backends (axon) block_until_ready
@@ -323,16 +359,16 @@ def timed_fetch(run, timed: bool):
         reps = []
         for _ in range(10):
             t0 = time.perf_counter()
-            stacked, vals, idx = run()
+            stacked, vals, idx, n_bad = run()
             vals, idx = jax.device_get((vals, idx))
             reps.append((time.perf_counter() - t0) * 1e3)
         latency_ms = float(np.median(reps))
-        stacked = jax.device_get(stacked)
+        stacked, n_bad = jax.device_get((stacked, n_bad))
     else:
         t0 = time.perf_counter()
-        stacked, vals, idx = jax.device_get(run())
+        stacked, vals, idx, n_bad = jax.device_get(run())
         latency_ms = (time.perf_counter() - t0) * 1e3
-    return stacked, vals, idx, latency_ms
+    return stacked, vals, idx, int(n_bad), latency_ms
 
 
 class EngineAPI:
@@ -484,10 +520,10 @@ class GraphEngine(EngineAPI):
                     error_contrast=p.error_contrast,
                 )
 
-        stacked, vals, idx, latency_ms = timed_fetch(run, timed)
+        stacked, vals, idx, n_bad, latency_ms = timed_fetch(run, timed)
         return render_result(
             stacked, vals, idx, names, n, k, latency_ms,
-            int(len(dep_src)), engine="single",
+            int(len(dep_src)), engine="single", sanitized_rows=n_bad,
         )
 
     def analyze_batch(
@@ -528,17 +564,21 @@ class GraphEngine(EngineAPI):
         p = self.params
         kk = min(k + 8, f0.shape[0])
         t0 = _time.perf_counter()
-        stacked, vals, idx = jax.device_get(_propagate_ranked_batch(
+        stacked, vals, idx, n_bad = jax.device_get(_propagate_ranked_batch(
             jnp.asarray(fb), ej, self._aw, self._hw,
             p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
             jnp.asarray(n, jnp.int32), up_ell, down_seg, up_seg,
             error_contrast=p.error_contrast,
         ))
         latency_ms = (_time.perf_counter() - t0) * 1e3
+        # n_bad counts zeroed rows across the WHOLE batch (per-hypothesis
+        # attribution is not worth a [B] fetch — a poisoned row poisons
+        # every hypothesis built from the same snapshot)
         return [
             render_result(
                 stacked[b], vals[b], idx[b], names, n, k,
                 latency_ms / B, int(len(dep_src)), engine="single-batch",
+                sanitized_rows=int(n_bad),
             )
             for b in range(B)
         ]

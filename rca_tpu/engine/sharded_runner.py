@@ -35,6 +35,7 @@ from rca_tpu.engine.propagate import PropagationParams
 from rca_tpu.engine.runner import (
     EngineAPI,
     EngineResult,
+    finite_mask_rows_np,
     render_result,
     resolve_params,
     timed_fetch,
@@ -157,6 +158,10 @@ class ShardedGraphEngine(EngineAPI):
 
         n = features.shape[0]
         k = k or min(self.config.top_k_root_causes, n)
+        # finite-mask guard: host-side here (the features are being staged
+        # from host anyway), same zeroing semantics as the dense engine's
+        # fused on-device pass — score parity holds under poisoned input
+        features, n_bad = finite_mask_rows_np(features)
         graph = self._shard(n, dep_src, dep_dst)
         f = np.zeros((graph.n_pad, features.shape[1]), np.float32)
         f[:n] = features
@@ -172,13 +177,13 @@ class ShardedGraphEngine(EngineAPI):
             stack = invoke()
             vals, idx = sharded_topk(mesh, stack[:, 3], kk)
             # squeeze the B=1 axis on DEVICE so the fetch carries one copy
-            return stack[0], vals[0], idx[0]
+            return stack[0], vals[0], idx[0], n_bad
 
-        stack, vals, idx, latency_ms = timed_fetch(run, timed)
+        stack, vals, idx, n_bad, latency_ms = timed_fetch(run, timed)
         return render_result(
             stack, np.asarray(vals), np.asarray(idx),
             names, n, k, latency_ms, int(len(dep_src)),
-            engine=self.engine_tag,
+            engine=self.engine_tag, sanitized_rows=n_bad,
         )
 
     def analyze_batch(
@@ -199,6 +204,7 @@ class ShardedGraphEngine(EngineAPI):
 
         B, n = features_batch.shape[0], features_batch.shape[1]
         k = k or min(self.config.top_k_root_causes, n)
+        features_batch, n_bad = finite_mask_rows_np(features_batch)
         graph = self._shard(n, dep_src, dep_dst)
         B_pad = -(-B // self.dp) * self.dp
         fb = np.zeros((B_pad, graph.n_pad, features_batch.shape[2]),
@@ -214,7 +220,7 @@ class ShardedGraphEngine(EngineAPI):
             render_result(
                 stack[b], vals[b], idx[b], names, n, k,
                 latency_ms / B, int(len(dep_src)),
-                engine=self.engine_tag + "-batch",
+                engine=self.engine_tag + "-batch", sanitized_rows=n_bad,
             )
             for b in range(B)
         ]

@@ -48,10 +48,17 @@ def _flush_propagate_ranked(
     """Whole tick in ONE dispatch: scatter the delta rows into the donated
     resident buffer, propagate, top-k.  On tunneled TPUs every dispatch pays
     a host round trip, so flush-then-propagate as two calls doubles tick
-    latency; fused, the tick costs one RTT plus device compute."""
-    from rca_tpu.engine.propagate import propagate
+    latency; fused, the tick costs one RTT plus device compute.
+
+    The finite-mask sanitize runs fused after the scatter: a delta row
+    carrying NaN/Inf telemetry zeroes out ON DEVICE (persisting into the
+    resident buffer — "no signal" until a clean row arrives) and the
+    zeroed-row count rides back with the same top-k fetch, so the guard
+    costs no extra host sync.  Clean rows pass through bit-identically."""
+    from rca_tpu.engine.propagate import finite_mask_rows, propagate
 
     features = features.at[idx].set(rows)
+    features, n_bad = finite_mask_rows(features)
     a, h, u, m, score = propagate(
         features, edges[0], edges[1], anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, n_live=n_live,
@@ -59,7 +66,7 @@ def _flush_propagate_ranked(
         error_contrast=error_contrast,
     )
     vals, topi = jax.lax.top_k(score, k)
-    return features, vals, topi
+    return features, vals, topi, n_bad
 
 
 def make_streaming_session(
@@ -106,6 +113,10 @@ class StreamingHostState:
         self.ticks = 0
         self.last_upload_rows = 0  # padded rows uploaded by the last flush
         self._bulk_upload = 0      # set by set_all; reported by next tick
+        # rows zeroed by a host-side finite-mask pass (sharded session's
+        # set_all) awaiting the next tick's report; the dense session
+        # sanitizes on device and never uses it
+        self._san_pending = 0
 
     def update(self, service_index: int, features: np.ndarray) -> None:
         """Replace one service's feature row (delta update between ticks)."""
@@ -139,7 +150,8 @@ class StreamingHostState:
         self.last_upload_rows = total
         return total
 
-    def _render_tick(self, vals, idx, latency_ms: float) -> Dict[str, object]:
+    def _render_tick(self, vals, idx, latency_ms: float,
+                     sanitized_rows: int = 0) -> Dict[str, object]:
         ranked: List[dict] = []
         for j, i in enumerate(np.asarray(idx).tolist()):
             if i >= self._n or len(ranked) >= self.k:
@@ -149,7 +161,8 @@ class StreamingHostState:
             )
         self.ticks += 1
         return {"ranked": ranked, "latency_ms": latency_ms,
-                "tick": self.ticks, "upload_rows": self.last_upload_rows}
+                "tick": self.ticks, "upload_rows": self.last_upload_rows,
+                "sanitized_rows": int(sanitized_rows)}
 
 
 class StreamingSession(StreamingHostState):
@@ -216,7 +229,7 @@ class StreamingSession(StreamingHostState):
         if self._pending:
             # fused path: scatter + propagate + top-k in a single dispatch
             _, u_pad, idx_h, rows_h = self._pack_pending(self._n_pad - 1)
-            self._features, vals, idx = _flush_propagate_ranked(
+            self._features, vals, idx, n_bad = _flush_propagate_ranked(
                 self._features, jnp.asarray(idx_h), jnp.asarray(rows_h),
                 self._edges, self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
@@ -228,7 +241,7 @@ class StreamingSession(StreamingHostState):
             self._account_upload(u_pad)
         else:
             self._account_upload(0)
-            stacked, vals, idx = _propagate_ranked(
+            stacked, vals, idx, n_bad = _propagate_ranked(
                 self._features, self._edges,
                 self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
@@ -237,6 +250,7 @@ class StreamingSession(StreamingHostState):
             )
         # sync through the fetch: block_until_ready alone can return at
         # enqueue time on tunneled backends, under-measuring the tick
-        vals, idx = jax.device_get((vals, idx))
+        # (the sanitized-row count rides the same fetch — no extra sync)
+        vals, idx, n_bad = jax.device_get((vals, idx, n_bad))
         latency_ms = (time.perf_counter() - t0) * 1e3
-        return self._render_tick(vals, idx, latency_ms)
+        return self._render_tick(vals, idx, latency_ms, int(n_bad))

@@ -209,7 +209,11 @@ def extract_features(snapshot: ClusterSnapshot) -> FeatureSet:
 
     def seg_max(channel: int) -> np.ndarray:
         acc = np.zeros(S, dtype=np.float32)
-        np.maximum.at(acc, seg, pf[:, channel])
+        # NaN from poisoned telemetry propagates into the service row by
+        # design (the engine's finite-mask pass zeroes the whole row on
+        # device); suppress numpy's warning — this is the intended path
+        with np.errstate(invalid="ignore"):
+            np.maximum.at(acc, seg, pf[:, channel])
         return acc
 
     crashy = np.clip(

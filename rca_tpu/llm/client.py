@@ -26,6 +26,7 @@ from rca_tpu.llm.providers import (
     make_provider,
 )
 from rca_tpu.llm.tools import ToolSpec
+from rca_tpu.resilience.policy import CircuitBreaker, CircuitOpen, suppressed
 
 MAX_TOOL_ROUNDS = 6
 
@@ -36,6 +37,13 @@ LogFn = Callable[[Dict[str, Any]], None]
 # deterministic offline provider so analysis never dies on a 429)
 _FAILOVER_ORDER = ("anthropic", "openai", "offline")
 
+# breaker defaults: a provider that 429s twice in a row is held out of the
+# rotation for BREAKER_RESET_S, then probed half-open — replaces the
+# round-1 one-shot failover, which hammered a quota-exhausted provider on
+# every completion until the process died or the quota reset
+BREAKER_FAILURES = 2
+BREAKER_RESET_S = 30.0
+
 
 class LLMClient:
     def __init__(
@@ -43,40 +51,81 @@ class LLMClient:
         provider: Optional[Provider] = None,
         provider_name: Optional[str] = None,
         log_fn: Optional[LogFn] = None,
+        breakers: Optional[Dict[str, CircuitBreaker]] = None,
     ):
         self.provider = provider or make_provider(provider_name)
         self.log_fn = log_fn
+        # one breaker per provider NAME (injectable for hermetic tests)
+        self._breakers: Dict[str, CircuitBreaker] = breakers or {}
+
+    def _breaker(self, name: str) -> CircuitBreaker:
+        br = self._breakers.get(name)
+        if br is None:
+            br = self._breakers[name] = CircuitBreaker(
+                failure_threshold=BREAKER_FAILURES,
+                reset_after=BREAKER_RESET_S, name=f"llm.{name}",
+            )
+        return br
 
     def _complete(self, messages, **kwargs) -> ProviderReply:
-        """One completion with runtime quota failover."""
-        try:
-            return self.provider.complete(messages, **kwargs)
-        except LLMQuotaExceeded:
-            failed = self.provider.name
-            for name in _FAILOVER_ORDER:
-                if name == failed:
-                    continue
-                try:
-                    candidate = (
-                        OfflineProvider() if name == "offline"
-                        else make_provider(name)
-                    )
-                    reply = candidate.complete(messages, **kwargs)
-                except LLMUnavailable:
-                    continue
-                self.provider = candidate  # stick with the working provider
-                self._log(
-                    "", "", kind="provider_failover",
-                    failed_provider=failed, new_provider=candidate.name,
-                )
+        """One completion with breaker-gated provider rotation.
+
+        The current provider runs only when its circuit allows; a quota
+        failure (or an open circuit) rotates through ``_FAILOVER_ORDER``,
+        skipping providers whose breakers are open, sticking with the
+        first that answers.  The offline provider ends every chain, so
+        analysis never dies on a 429.  If the whole rotation fails, the
+        raised error CHAINS the original quota failure (satellite fix:
+        round-1 dropped it)."""
+        primary = self.provider
+        first_exc: Optional[LLMUnavailable] = None
+        br = self._breaker(primary.name)
+        if br.allow():
+            try:
+                reply = primary.complete(messages, **kwargs)
+                br.record_success()
                 return reply
-            raise
+            except LLMQuotaExceeded as exc:
+                br.record_failure()
+                first_exc = exc
+        else:
+            first_exc = CircuitOpen(
+                f"provider {primary.name!r} circuit open "
+                "(recent quota failures)"
+            )
+        for name in _FAILOVER_ORDER:
+            if name == primary.name:
+                continue
+            cand_br = self._breaker(name)
+            if not cand_br.allow():
+                continue
+            try:
+                candidate = (
+                    OfflineProvider() if name == "offline"
+                    else make_provider(name)
+                )
+                reply = candidate.complete(messages, **kwargs)
+            except LLMUnavailable:
+                cand_br.record_failure()
+                continue
+            cand_br.record_success()
+            self.provider = candidate  # stick with the working provider
+            self._log(
+                "", "", kind="provider_failover",
+                failed_provider=primary.name, new_provider=candidate.name,
+            )
+            return reply
+        raise LLMUnavailable(
+            f"all providers exhausted after failure on {primary.name!r}"
+        ) from first_exc
 
     # -- logging -----------------------------------------------------------
     def _log(self, prompt: str, response: str, **context: Any) -> None:
         if self.log_fn is None:
             return
-        try:
+        # observability must never break analysis — but the swallow goes
+        # through the policy channel so it is still visible in health
+        with suppressed("llm.log_fn"):
             self.log_fn(
                 {
                     "prompt": prompt,
@@ -88,8 +137,6 @@ class LLMClient:
                     },
                 }
             )
-        except Exception:
-            pass  # observability must never break analysis
 
     # -- tool loop ----------------------------------------------------------
     def analyze(

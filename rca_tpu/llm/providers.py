@@ -131,7 +131,7 @@ class OpenAIProvider(Provider):
                 temperature=temperature, max_tokens=max_tokens, **kwargs,
             )
         except Exception as e:  # pragma: no cover - network dependent
-            raise _classify_error(e) from e
+            raise _classify_error(e, self.name) from e
         choice = resp.choices[0]
         calls = [
             ToolCall(
@@ -225,7 +225,7 @@ class AnthropicProvider(Provider):
                 **kwargs,
             )
         except Exception as e:  # pragma: no cover - network dependent
-            raise _classify_error(e) from e
+            raise _classify_error(e, self.name) from e
         text_parts, calls = [], []
         for block in resp.content:
             if block.type == "text":
@@ -317,11 +317,16 @@ def _safe_json(s: str) -> Dict[str, Any]:
         return {}
 
 
-def _classify_error(e: Exception) -> LLMUnavailable:
+def _classify_error(e: Exception, provider: str = "") -> LLMUnavailable:
+    # the provider name rides in the message: a failure surfacing
+    # mid-failover must say WHICH backend died, and callers chain the
+    # original via ``raise _classify_error(e, name) from e`` so the root
+    # quota error is never dropped (round-6 satellite fix)
+    prefix = f"{provider}: " if provider else ""
     msg = str(e).lower()
     if any(k in msg for k in ("quota", "rate limit", "rate_limit", "429")):
-        return LLMQuotaExceeded(str(e))
-    return LLMUnavailable(str(e))
+        return LLMQuotaExceeded(f"{prefix}{e}")
+    return LLMUnavailable(f"{prefix}{e}")
 
 
 def make_provider(name: Optional[str] = None) -> Provider:

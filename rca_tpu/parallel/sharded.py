@@ -38,6 +38,23 @@ from rca_tpu.engine.propagate import (
 )
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` where it exists (jax ≥ 0.5), else the
+    ``jax.experimental.shard_map`` spelling with its ``check_rep`` kwarg —
+    the same primitive under an older name.  Without this shim every
+    sharded dispatch dies with AttributeError on a jax 0.4.x install,
+    which is exactly the class of environment skew the engine degradation
+    ladder exists for; prefer not entering the ladder at all."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm_legacy
+
+    return sm_legacy(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_vma)
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardedGraph:
     """Edge partition for an sp-way node sharding."""
@@ -318,7 +335,7 @@ def _jitted_shard_fn(
 
     batch_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
     n_seg = len(ShardedSegLayouts._fields) if use_segscan else 0
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map_compat(
         per_device,
         mesh=mesh,
         in_specs=(
@@ -362,7 +379,7 @@ def _jitted_topk_fn(mesh: Mesh, k: int, batch_axes: tuple = ("dp",)):
         return vv, jnp.take_along_axis(ig, pos, axis=1)
 
     batch_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map_compat(
         per_device,
         mesh=mesh,
         in_specs=(P(batch_spec, "sp"),),

@@ -41,6 +41,7 @@ from rca_tpu.parallel.sharded import (
     ShardedGraph,
     ShardedSegLayouts,
     _propagate_block,
+    shard_map_compat,
     sharded_seg_layouts_for,
 )
 
@@ -90,7 +91,7 @@ def _jitted_tick_fn(
         return f_blk, vv, jnp.take(ig, pos)
 
     n_seg = len(ShardedSegLayouts._fields) if use_segscan else 0
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map_compat(
         per_device,
         mesh=mesh,
         in_specs=(
@@ -167,6 +168,13 @@ class ShardedStreamingSession(StreamingHostState):
         self._init_host_state()
 
     def set_all(self, features: np.ndarray) -> None:
+        from rca_tpu.engine.runner import finite_mask_rows_np
+
+        # finite-mask guard, host-side: this path stages from host anyway,
+        # so zeroing poisoned rows before the upload matches the dense
+        # session's fused on-device sanitize (same zeroed-row semantics)
+        features, n_bad = finite_mask_rows_np(features)
+        self._san_pending += n_bad
         f = np.zeros((self._n_pad, self._num_features), np.float32)
         f[: len(features)] = features
         self._features = jax.device_put(
@@ -177,10 +185,17 @@ class ShardedStreamingSession(StreamingHostState):
 
     # -- tick ---------------------------------------------------------------
     def tick(self) -> Dict[str, object]:
+        from rca_tpu.engine.runner import finite_mask_rows_np
+
         t0 = time.perf_counter()
         # pad slots target index n_pad: out of range for EVERY shard, so
         # the scatter drops them (quiet ticks run the same executable)
         u, u_pad, idx_h, rows_h = self._pack_pending(self._n_pad)
+        # host-side twin of the dense session's fused sanitize: delta rows
+        # carrying NaN/Inf zero out before the scatter ships them
+        rows_h, n_bad = finite_mask_rows_np(rows_h)
+        sanitized = n_bad + self._san_pending
+        self._san_pending = 0
         with self.mesh:
             self._features, vals, idx = self._fn(
                 self._features, jnp.asarray(idx_h), jnp.asarray(rows_h),
@@ -192,4 +207,4 @@ class ShardedStreamingSession(StreamingHostState):
         self._account_upload(u_pad if u else 0)
         vals, idx = jax.device_get((vals, idx))
         latency_ms = (time.perf_counter() - t0) * 1e3
-        return self._render_tick(vals, idx, latency_ms)
+        return self._render_tick(vals, idx, latency_ms, sanitized)

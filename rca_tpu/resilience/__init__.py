@@ -1,0 +1,45 @@
+"""Resilience layer: composable failure policies + fault injection.
+
+Every failure path in the system used to be ad hoc in whatever layer a
+reviewer happened to find it (watch-pump 410 expiry, LLM quota failover,
+Pallas interpret fallback).  This package centralizes the vocabulary:
+
+- :mod:`rca_tpu.resilience.policy` — ``Retry`` (exponential backoff +
+  jitter, injectable clock/sleep), ``Deadline``, ``CircuitBreaker``, and
+  the ``suppressed`` context manager that replaces every bare
+  ``except Exception: pass`` outside this package (enforced by
+  ``tools/lint_swallowed_faults.py``);
+- :mod:`rca_tpu.resilience.chaos` — ``ChaosClusterClient``, a seeded
+  fault-injection wrapper over any :class:`rca_tpu.cluster.protocol.
+  ClusterClient`, plus the chaos-soak harness behind
+  ``python -m rca_tpu chaos`` and ``bench.py --chaos``.
+
+See RESILIENCE.md for the degradation ladder and the chaos-schedule
+format.
+"""
+
+from rca_tpu.resilience.policy import (
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+    PolicyError,
+    Retry,
+    drain_faults,
+    record_fault,
+    retry_counter,
+    suppressed,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpen",
+    "Deadline",
+    "DeadlineExceeded",
+    "PolicyError",
+    "Retry",
+    "drain_faults",
+    "record_fault",
+    "retry_counter",
+    "suppressed",
+]

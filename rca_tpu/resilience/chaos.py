@@ -1,0 +1,275 @@
+"""Seeded fault injection over any ClusterClient + the chaos-soak harness.
+
+:class:`ChaosClusterClient` wraps a :class:`rca_tpu.cluster.protocol.
+ClusterClient` and injects, from a seeded schedule, the fault classes the
+resilience layer must absorb:
+
+- ``api_timeout``     list/fetch calls raise :class:`InjectedTimeout`;
+- ``truncated_list``  ``get_pods`` silently returns a truncated copy
+  (the "collector dropped spans" shape from LogGD/RIG degraded-telemetry
+  scenarios — see ISSUE motivation);
+- ``nan_metrics``     ``get_pod_metrics`` returns a deep-copied payload
+  with NaN/Inf ``usage_percentage`` values (poisons feature channels,
+  exercising the engine's on-device finite-mask sanitizer);
+- ``gone_storm``      ``watch_changes`` reports ``expired`` for several
+  consecutive polls (a 410 Gone storm — repeated resyncs);
+- ``pump_death``      ``watch_changes`` silently discards the pending
+  feed entries, then reports one ``expired`` (a watch pump died holding
+  undelivered changes).
+
+With ``config.enabled = False`` (or every rate 0) the wrapper is a pure
+delegating proxy — bit-identical to the wrapped client (property-tested in
+tests/test_resilience.py), so it can sit permanently in a test harness.
+
+Injected faults are recorded in :meth:`ChaosClusterClient.drain_injected`;
+:class:`rca_tpu.engine.live.LiveStreamingSession` drains that surface into
+its per-tick health record, which is how :func:`run_chaos_soak` (behind
+``python -m rca_tpu chaos`` and ``bench.py --chaos``) counts observed
+fault classes and checks the fault-free-tick parity invariant.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import json
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+FAULT_CLASSES = (
+    "api_timeout", "truncated_list", "nan_metrics", "gone_storm",
+    "pump_death",
+)
+
+# calls eligible for api_timeout injection: the heavy capture-path getters
+_TIMEOUT_OPS = ("get_pods", "get_events", "get_pod_metrics")
+
+
+class InjectedTimeout(TimeoutError):
+    """A chaos-injected API timeout (distinguishable from real ones)."""
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    """Schedule parameters.  ``rates`` are per-opportunity probabilities
+    drawn from one seeded stream, so a (seed, call-sequence) pair replays
+    the exact same fault schedule."""
+
+    seed: int = 0
+    enabled: bool = True
+    rates: Dict[str, float] = dataclasses.field(default_factory=lambda: {
+        "api_timeout": 0.06,
+        "truncated_list": 0.10,
+        "nan_metrics": 0.12,
+        "gone_storm": 0.04,
+        "pump_death": 0.03,
+    })
+    storm_len: int = 3      # consecutive expired polls per gone_storm
+    nan_pods: int = 2       # pods corrupted per nan_metrics injection
+
+    def rate(self, fault: str) -> float:
+        return float(self.rates.get(fault, 0.0))
+
+
+class ChaosClusterClient:
+    """Fault-injecting proxy over any ``ClusterClient``."""
+
+    def __init__(self, inner: Any, config: Optional[ChaosConfig] = None):
+        self.inner = inner
+        self.config = config or ChaosConfig()
+        self._rng = random.Random(self.config.seed)
+        self._injected: List[Dict[str, str]] = []
+        self._storm_left = 0
+        self._nan_toggle = 0
+
+    # -- bookkeeping --------------------------------------------------------
+    def drain_injected(self, clear: bool = True) -> List[Dict[str, str]]:
+        out = list(self._injected)
+        if clear:
+            self._injected.clear()
+        return out
+
+    def _fires(self, fault: str) -> bool:
+        if not self.config.enabled:
+            return False
+        return self._rng.random() < self.config.rate(fault)
+
+    def _record(self, fault: str, op: str) -> None:
+        self._injected.append({"fault": fault, "op": op})
+
+    def _maybe_timeout(self, op: str) -> None:
+        if self._fires("api_timeout"):
+            self._record("api_timeout", op)
+            raise InjectedTimeout(f"chaos: injected timeout in {op}")
+
+    # -- transparent delegation --------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        # anything not explicitly intercepted passes straight through —
+        # the disabled wrapper is bit-identical to the wrapped client
+        return getattr(self.inner, name)
+
+    # -- intercepted surfaces ----------------------------------------------
+    def get_pods(self, namespace: str) -> List[Dict[str, Any]]:
+        self._maybe_timeout("get_pods")
+        pods = self.inner.get_pods(namespace)
+        if pods and len(pods) > 1 and self._fires("truncated_list"):
+            keep = max(1, len(pods) - max(1, len(pods) // 4))
+            self._record("truncated_list", "get_pods")
+            return pods[:keep]
+        return pods
+
+    def get_events(self, namespace: str, field_selector=None):
+        self._maybe_timeout("get_events")
+        return self.inner.get_events(namespace, field_selector)
+
+    def get_pod_metrics(self, namespace: str) -> Dict[str, Any]:
+        self._maybe_timeout("get_pod_metrics")
+        metrics = self.inner.get_pod_metrics(namespace)
+        if not self._fires("nan_metrics"):
+            return metrics
+        pods = (metrics or {}).get("pods") or {}
+        if not pods:
+            return metrics
+        corrupted = copy.deepcopy(metrics)
+        names = sorted(pods)
+        picks = [
+            names[self._rng.randrange(len(names))]
+            for _ in range(min(self.config.nan_pods, len(names)))
+        ]
+        # alternate NaN / +Inf so both non-finite shapes are exercised
+        self._nan_toggle ^= 1
+        poison = float("nan") if self._nan_toggle else float("inf")
+        for name in picks:
+            rec = corrupted["pods"][name]
+            for ch in ("cpu", "memory"):
+                if isinstance(rec.get(ch), dict):
+                    rec[ch]["usage_percentage"] = poison
+        self._record("nan_metrics", "get_pod_metrics")
+        return corrupted
+
+    def watch_changes(self, namespace: str, cursor):
+        if cursor is not None and self.config.enabled:
+            if self._storm_left > 0:
+                self._storm_left -= 1
+                self._record("gone_storm", "watch_changes")
+                return {"supported": True, "cursor": cursor,
+                        "expired": True, "changes": []}
+            if self._fires("gone_storm"):
+                # storm: this poll and the next storm_len-1 expire too
+                self._storm_left = max(0, self.config.storm_len - 1)
+                self._record("gone_storm", "watch_changes")
+                return {"supported": True, "cursor": cursor,
+                        "expired": True, "changes": []}
+            if self._fires("pump_death"):
+                # a dead pump loses whatever it was holding: consume the
+                # real feed (dropping the entries) and report expiry
+                self._record("pump_death", "watch_changes")
+                self.inner.watch_changes(namespace, cursor)
+                return {"supported": True, "cursor": cursor,
+                        "expired": True, "changes": []}
+        return self.inner.watch_changes(namespace, cursor)
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak harness (CLI `rca chaos`, bench --chaos, tests)
+# ---------------------------------------------------------------------------
+
+
+def run_chaos_soak(
+    make_world: Callable[[], Any],
+    namespace: str,
+    seed: int = 7,
+    ticks: int = 200,
+    k: int = 5,
+    engine_factory: Optional[Callable[[], Any]] = None,
+    config: Optional[ChaosConfig] = None,
+    topology_check_every: int = 5,
+) -> Dict[str, Any]:
+    """Run ``ticks`` polls of a :class:`LiveStreamingSession` over a
+    chaos-wrapped mock world and score the resilience contract:
+
+    - ``uncaught_exceptions`` MUST be 0 (``poll()`` never raises);
+    - every injected fault class should appear in the health records;
+    - fault-free ticks (no injection this tick, no residual contamination,
+      no sanitized rows, not degraded) must be bit-identical to a
+      fault-free baseline session over an identically-built world.
+
+    ``make_world`` is called twice (baseline + chaos) so the two sessions
+    never share mutable state.
+    """
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+
+    make_engine = engine_factory or (lambda: None)
+
+    base = LiveStreamingSession(
+        MockClusterClient(make_world()), namespace, k=k,
+        engine=make_engine(), topology_check_every=topology_check_every,
+    )
+    baseline_ranked = json.dumps(base.poll()["ranked"], sort_keys=True)
+
+    cfg = config or ChaosConfig(seed=seed)
+    was_enabled = cfg.enabled
+    cfg.enabled = False  # session bootstrap capture runs fault-free
+    chaos = ChaosClusterClient(MockClusterClient(make_world()), cfg)
+    live = LiveStreamingSession(
+        chaos, namespace, k=k, engine=make_engine(),
+        topology_check_every=topology_check_every,
+    )
+    cfg.enabled = was_enabled
+
+    counts: Dict[str, int] = {f: 0 for f in FAULT_CLASSES}
+    uncaught = 0
+    degraded_ticks = 0
+    sanitized_total = 0
+    parity_checked = 0
+    parity_ok = True
+    dirty = False
+    for _ in range(ticks):
+        try:
+            out = live.poll()
+        except Exception as exc:  # contract violation — poll must not raise
+            uncaught += 1
+            from rca_tpu.resilience.policy import record_fault
+
+            record_fault("chaos.soak.uncaught", exc)
+            continue
+        health = out.get("health", {})
+        injected = health.get("injected", [])
+        for f in injected:
+            counts[f.get("fault", "?")] = counts.get(f.get("fault", "?"), 0) + 1
+        sanitized = int(health.get("sanitized_rows", 0))
+        sanitized_total += sanitized
+        if out.get("degraded"):
+            degraded_ticks += 1
+        faulted = bool(injected) or sanitized > 0 or bool(health.get("faults"))
+        if faulted:
+            # contaminated state can outlive the faulting tick (stale rows
+            # persist across quiet polls until the next clean capture)
+            dirty = True
+        elif not out.get("quiet", False):
+            dirty = False  # a clean full capture restored ground truth
+        if not faulted and not dirty and not out.get("degraded"):
+            parity_checked += 1
+            ranked = json.dumps(out["ranked"], sort_keys=True)
+            if ranked != baseline_ranked:
+                parity_ok = False
+    return {
+        "ticks": ticks,
+        "seed": seed,
+        "uncaught_exceptions": uncaught,
+        "faults_injected": counts,
+        "fault_classes_observed": sorted(
+            f for f, n in counts.items() if n > 0
+        ),
+        "all_classes_observed": all(
+            counts.get(f, 0) > 0 for f in FAULT_CLASSES
+        ),
+        "degraded_ticks": degraded_ticks,
+        "sanitized_rows_total": sanitized_total,
+        "final_degradation": getattr(live, "degradation", 0),
+        "resyncs_expired": getattr(live, "resyncs_expired", 0),
+        "resyncs_topology": getattr(live, "resyncs_topology", 0),
+        "parity_ticks_checked": parity_checked,
+        "parity_ok": parity_ok,
+    }

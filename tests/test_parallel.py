@@ -21,6 +21,7 @@ def _reference_scores(features, src, dst, n_pad, params):
             jnp.asarray(f), jnp.asarray(src), jnp.asarray(dst), aw, hw,
             params.steps, params.decay, params.explain_strength,
             params.impact_bonus, n_live=features.shape[0],
+            error_contrast=params.error_contrast,
         )[4]
     )
 

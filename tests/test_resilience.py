@@ -1,0 +1,577 @@
+"""Resilience layer: policies (hermetic clocks), chaos client parity,
+NaN/Inf finite-mask sanitization, degradation ladder, resync-cause split,
+breaker-gated LLM rotation, watch-pump stream-reopen retry, the
+swallowed-fault lint, and a fast seeded chaos soak."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+import types
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.fixtures import NS, five_service_world
+from rca_tpu.cluster.generator import (
+    synthetic_cascade_arrays,
+    synthetic_cascade_world,
+)
+from rca_tpu.cluster.mock_client import MockClusterClient
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+from rca_tpu.engine import GraphEngine, LiveStreamingSession
+from rca_tpu.features.schema import NUM_SERVICE_FEATURES
+from rca_tpu.resilience.chaos import (
+    FAULT_CLASSES,
+    ChaosClusterClient,
+    ChaosConfig,
+    run_chaos_soak,
+)
+from rca_tpu.resilience.policy import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    Retry,
+    drain_faults,
+    suppressed,
+)
+
+
+# -- policy primitives (injectable time: no wall-clock in any test) ----------
+
+def test_retry_backoff_sequence_and_attempt_cap():
+    delays = []
+    r = Retry(attempts=3, base_delay=1.0, max_delay=10.0, jitter=0.0,
+              sleep=delays.append, seed=0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    assert r.call(flaky) == "ok"
+    assert delays == [1.0, 2.0]          # exponential, no jitter
+    assert r.retries_spent == 2
+
+    calls["n"] = 0
+    r2 = Retry(attempts=1, base_delay=1.0, jitter=0.0,
+               sleep=delays.append, seed=0)
+    with pytest.raises(ValueError):
+        r2.call(flaky)                   # 1 retry cannot cover 2 failures
+    assert calls["n"] == 2
+
+
+def test_retry_max_delay_and_jitter_bounds():
+    r = Retry(attempts=8, base_delay=1.0, max_delay=4.0, jitter=0.25, seed=7)
+    for attempt in range(1, 9):
+        d = r.delay(attempt)
+        assert 0.0 <= d <= 4.0 * 1.25
+
+
+def test_retry_respects_deadline():
+    t = [0.0]
+    r = Retry(attempts=10, base_delay=5.0, jitter=0.0,
+              sleep=lambda s: None, clock=lambda: t[0], seed=0)
+    dl = Deadline(budget_s=3.0, clock=lambda: t[0])
+
+    def always_fails():
+        raise ValueError("nope")
+
+    # the first retry's 5 s backoff cannot fit the 3 s budget
+    with pytest.raises(DeadlineExceeded) as ei:
+        r.call(always_fails, deadline=dl)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_circuit_breaker_state_machine():
+    t = [0.0]
+    cb = CircuitBreaker(failure_threshold=2, reset_after=10.0,
+                        clock=lambda: t[0])
+    assert cb.state == "closed" and cb.allow()
+    cb.record_failure()
+    assert cb.allow()                    # one failure: still closed
+    cb.record_failure()
+    assert cb.state == "open" and not cb.allow()
+    t[0] = 10.0
+    assert cb.allow()                    # half-open probe slot
+    assert not cb.allow()                # only ONE probe at a time
+    cb.record_failure()                  # probe failed: open again
+    assert not cb.allow()
+    t[0] = 20.0
+    assert cb.allow()
+    cb.record_success()
+    assert cb.state == "closed" and cb.allow()
+
+
+def test_suppressed_records_into_fault_log():
+    drain_faults()
+    with suppressed("test.op"):
+        raise RuntimeError("swallowed but visible")
+    faults = drain_faults()
+    assert any(
+        f["op"] == "test.op" and "swallowed but visible" in f["error"]
+        for f in faults
+    )
+    with pytest.raises(KeyboardInterrupt):
+        with suppressed("test.op2"):     # only Exception subclasses
+            raise KeyboardInterrupt()
+
+
+# -- chaos client: disabled == bit-identical passthrough ---------------------
+
+def _soak_world():
+    return synthetic_cascade_world(50, n_roots=1, seed=7,
+                                   namespace="synthetic")
+
+
+def test_chaos_disabled_is_bit_identical():
+    """Property (satellite): with faults disabled the wrapper must be
+    indistinguishable from the wrapped client — snapshot, change-feed
+    journal, and findings JSON on the 50-service fixture."""
+    plain = MockClusterClient(_soak_world())
+    chaos = ChaosClusterClient(
+        MockClusterClient(_soak_world()), ChaosConfig(seed=1, enabled=False)
+    )
+    snap_a = ClusterSnapshot.capture(plain, "synthetic")
+    snap_b = ClusterSnapshot.capture(chaos, "synthetic")
+    assert snap_a == snap_b
+
+    # journal feed: identical cursor/changes through a mutation sequence
+    ha = plain.watch_changes("synthetic", None)
+    hb = chaos.watch_changes("synthetic", None)
+    assert ha == hb
+    for c in (plain, chaos):
+        c.world.touch("pod", "synthetic", "p-x")
+        c.world.touch("event", "synthetic", "p-x")
+    assert (
+        plain.watch_changes("synthetic", ha["cursor"])
+        == chaos.watch_changes("synthetic", hb["cursor"])
+    )
+
+    engine = GraphEngine()
+    ra = engine.analyze_snapshot(snap_a, k=5)
+    rb = engine.analyze_snapshot(snap_b, k=5)
+    assert json.dumps(ra.ranked, sort_keys=True) == json.dumps(
+        rb.ranked, sort_keys=True
+    )
+    assert chaos.drain_injected() == []
+
+
+def test_chaos_schedule_is_seed_deterministic():
+    from rca_tpu.resilience.chaos import InjectedTimeout
+
+    def injected_with(seed):
+        chaos = ChaosClusterClient(
+            MockClusterClient(_soak_world()), ChaosConfig(seed=seed)
+        )
+        for _ in range(50):
+            for op in (chaos.get_pods, chaos.get_pod_metrics):
+                try:
+                    op("synthetic")
+                except InjectedTimeout:
+                    pass  # the injection itself is the signal under test
+        return [f["fault"] for f in chaos.drain_injected()]
+
+    assert injected_with(3) == injected_with(3)
+    assert injected_with(3) != injected_with(4)
+
+
+# -- finite-mask sanitizer ---------------------------------------------------
+
+def test_nan_inf_each_channel_zeroes_only_poisoned_rows():
+    """Satellite: poison each feature channel with NaN and Inf → the
+    sanitizer zeroes exactly the poisoned rows (count reported) and the
+    result is bit-identical to analyzing with those rows zeroed."""
+    case = synthetic_cascade_arrays(50, n_roots=1, seed=0)
+    engine = GraphEngine()
+    rows = [3, 17]
+    zeroed = case.features.copy()
+    zeroed[rows] = 0.0
+    ref = engine.analyze_arrays(
+        zeroed, case.dep_src, case.dep_dst, case.names, k=5
+    )
+    assert ref.sanitized_rows == 0
+    for poison in (np.nan, np.inf, -np.inf):
+        for ch in range(NUM_SERVICE_FEATURES):
+            f = case.features.copy()
+            f[rows, ch] = poison
+            out = engine.analyze_arrays(
+                f, case.dep_src, case.dep_dst, case.names, k=5
+            )
+            assert out.sanitized_rows == len(rows)
+            assert np.isfinite(out.score).all()
+            np.testing.assert_array_equal(out.score, ref.score)
+            assert json.dumps(out.ranked, sort_keys=True) == json.dumps(
+                ref.ranked, sort_keys=True
+            )
+
+
+def test_ranking_over_clean_services_unchanged_by_poisoned_zeros():
+    """Poisoning rows that carried no evidence anyway must leave the
+    ranking EXACTLY equal to the fault-free run — the clean services'
+    scores are untouched by the sanitizer."""
+    case = synthetic_cascade_arrays(50, n_roots=1, seed=0)
+    engine = GraphEngine()
+    base_features = case.features.copy()
+    rows = [5, 29]
+    base_features[rows] = 0.0            # fault-free run: rows carry nothing
+    base = engine.analyze_arrays(
+        base_features, case.dep_src, case.dep_dst, case.names, k=5
+    )
+    poisoned = base_features.copy()
+    poisoned[rows] = np.nan
+    out = engine.analyze_arrays(
+        poisoned, case.dep_src, case.dep_dst, case.names, k=5
+    )
+    assert out.sanitized_rows == len(rows)
+    np.testing.assert_array_equal(out.score, base.score)
+    assert [r["component"] for r in out.ranked] == [
+        r["component"] for r in base.ranked
+    ]
+
+
+def test_streaming_tick_sanitizes_poisoned_delta_rows():
+    from rca_tpu.engine.streaming import StreamingSession
+
+    case = synthetic_cascade_arrays(30, n_roots=1, seed=1)
+    names = list(case.names)
+    sess = StreamingSession(names, case.dep_src, case.dep_dst,
+                            num_features=case.features.shape[1],
+                            engine=GraphEngine(), k=3)
+    sess.set_all(case.features)
+    out0 = sess.tick()
+    assert out0["sanitized_rows"] == 0
+    bad = case.features[2].copy()
+    bad[0] = np.nan
+    sess.update(2, bad)
+    out1 = sess.tick()
+    assert out1["sanitized_rows"] == 1
+    # the poisoned row persisted as zeros: next tick is clean again
+    out2 = sess.tick()
+    assert out2["sanitized_rows"] == 0
+    # and equals a session that uploaded zeros for that row directly
+    sess2 = StreamingSession(names, case.dep_src, case.dep_dst,
+                             num_features=case.features.shape[1],
+                             engine=GraphEngine(), k=3)
+    f2 = case.features.copy()
+    f2[2] = 0.0
+    sess2.set_all(f2)
+    ref = sess2.tick()
+    assert json.dumps(out2["ranked"], sort_keys=True) == json.dumps(
+        ref["ranked"], sort_keys=True
+    )
+
+
+# -- live session: resync-cause split, never-raise poll, ladder --------------
+
+def test_resync_cause_split_counters():
+    from rca_tpu.cluster.world import make_deployment, make_service
+
+    world = five_service_world()
+    client = MockClusterClient(world)
+    live = LiveStreamingSession(client, NS, k=3, engine=GraphEngine(),
+                                topology_check_every=100)
+    assert (live.resyncs_expired, live.resyncs_topology) == (0, 0)
+
+    world.add("services", NS, make_service("brandnew", NS))
+    world.add("deployments", NS, make_deployment("brandnew", NS, "brandnew"))
+    out = live.poll()
+    assert out["resynced"] is True
+    assert out["health"]["resync_cause"] == "topology"
+    assert (live.resyncs_expired, live.resyncs_topology) == (0, 1)
+
+    live._pending_resync = True          # lost-notification recovery path
+    out2 = live.poll()
+    assert out2["resynced"] is True
+    assert out2["health"]["resync_cause"] == "expired"
+    assert (live.resyncs_expired, live.resyncs_topology) == (1, 1)
+    assert live.resyncs == live.resyncs_expired + live.resyncs_topology
+
+
+class _FlakyClient(MockClusterClient):
+    """get_pods raises until ``heal()`` is called."""
+
+    def __init__(self, world):
+        super().__init__(world)
+        self.broken = False
+
+    def get_pods(self, namespace):
+        if self.broken:
+            raise RuntimeError("api server unreachable")
+        return super().get_pods(namespace)
+
+
+def test_poll_never_raises_and_recovers():
+    world = five_service_world()
+    client = _FlakyClient(world)
+    live = LiveStreamingSession(client, NS, k=3, engine=GraphEngine(),
+                                topology_check_every=100)
+    healthy = live.poll()
+    assert healthy["degraded"] is False
+
+    client.broken = True
+    live._pending_resync = True          # forces a capture next poll
+    out = live.poll()                    # capture raises internally
+    assert out["degraded"] is True
+    assert out["ranked"] == healthy["ranked"]   # stale but served
+    assert any("live.poll" == f["op"] for f in out["health"]["faults"])
+
+    client.broken = False
+    out2 = live.poll()                   # pending resync recovers
+    assert out2["degraded"] is False
+    assert out2["resynced"] is True
+    assert out2["health"]["resync_cause"] == "expired"
+    assert json.dumps(out2["ranked"], sort_keys=True) == json.dumps(
+        healthy["ranked"], sort_keys=True
+    )
+
+
+def test_degradation_ladder_steps_to_single_device():
+    world = five_service_world()
+    client = MockClusterClient(world)
+    live = LiveStreamingSession(client, NS, k=3, engine=GraphEngine(),
+                                topology_check_every=100)
+    healthy = live.poll()
+
+    def boom():
+        raise RuntimeError("device dispatch failed")
+
+    live.session.tick = boom             # kill the current session's tick
+    out = live.poll()
+    # two consecutive failures stepped the ladder; the rebuilt
+    # single-device session answered within the same poll
+    assert out["degraded"] is True
+    assert live.degradation == 1
+    assert out["health"]["degradation_rung"] == "single-device"
+    assert json.dumps(out["ranked"], sort_keys=True) == json.dumps(
+        healthy["ranked"], sort_keys=True
+    )
+    # subsequent polls stay on the (working) downgraded engine
+    out2 = live.poll()
+    assert out2["health"]["degradation_rung"] == "single-device"
+    assert out2["ranked"] == healthy["ranked"]
+
+
+# -- LLM: breaker-gated rotation ---------------------------------------------
+
+class _QuotaProvider:
+    name = "quota-prim"
+    model = "m"
+
+    def __init__(self):
+        self.calls = 0
+
+    def complete(self, messages, **kwargs):
+        from rca_tpu.llm.providers import LLMQuotaExceeded
+
+        self.calls += 1
+        raise LLMQuotaExceeded("quota-prim: 429")
+
+
+def test_breaker_gates_provider_rotation(monkeypatch):
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    monkeypatch.delenv("ANTHROPIC_API_KEY", raising=False)
+    from rca_tpu.llm import LLMClient
+
+    prim = _QuotaProvider()
+    t = [0.0]
+    llm = LLMClient(provider=prim, breakers={
+        "quota-prim": CircuitBreaker(failure_threshold=1, reset_after=30.0,
+                                     clock=lambda: t[0], name="quota-prim"),
+    })
+    assert llm.generate_completion("hi")         # rotated to offline
+    assert llm.provider.name == "offline"
+    assert prim.calls == 1
+
+    # circuit open: switching back to the primary must NOT call it again
+    llm.provider = prim
+    assert llm.generate_completion("hi2")
+    assert prim.calls == 1                        # breaker skipped the call
+
+    # half-open after the reset window: the primary gets ONE probe
+    llm.provider = prim
+    t[0] = 30.0
+    assert llm.generate_completion("hi3")
+    assert prim.calls == 2
+
+
+def test_rotation_exhaustion_chains_original_quota_error(monkeypatch):
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    monkeypatch.delenv("ANTHROPIC_API_KEY", raising=False)
+    from rca_tpu.llm import LLMClient
+    from rca_tpu.llm.providers import (
+        LLMQuotaExceeded,
+        LLMUnavailable,
+        OfflineProvider,
+    )
+
+    def offline_dies(self, messages, **kwargs):
+        raise LLMUnavailable("offline: simulated outage")
+
+    monkeypatch.setattr(OfflineProvider, "complete", offline_dies)
+    llm = LLMClient(provider=_QuotaProvider())
+    with pytest.raises(LLMUnavailable) as ei:
+        llm.generate_completion("hi")
+    assert "quota-prim" in str(ei.value)
+    assert isinstance(ei.value.__cause__, LLMQuotaExceeded)
+
+
+def test_classify_error_names_the_provider():
+    from rca_tpu.llm.providers import (
+        LLMQuotaExceeded,
+        _classify_error,
+    )
+
+    err = _classify_error(Exception("rate limit reached"), "openai")
+    assert isinstance(err, LLMQuotaExceeded)
+    assert str(err).startswith("openai: ")
+
+
+# -- watch pump: transient stream errors retry before expiring ---------------
+
+class _Meta:
+    def __init__(self, name, rv=""):
+        self.name = name
+        self.resource_version = rv
+
+
+class _PodObj:
+    def __init__(self, name, rv="101"):
+        self.metadata = _Meta(name, rv)
+
+
+class _ListResp:
+    def __init__(self, rv):
+        self.metadata = _Meta("", rv)
+        self.items = []
+
+
+class _FakeCore:
+    def list_namespaced_pod(self, *a, **k):
+        return _ListResp("100")
+
+    def list_namespaced_event(self, *a, **k):
+        return _ListResp("200")
+
+
+def _wait_until(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _install_flaky_kubernetes_stub(monkeypatch, fail_first_streams):
+    """Watch stub whose pod stream raises a TRANSIENT error for the first
+    ``fail_first_streams`` openings, then yields one pod event."""
+    mod = types.ModuleType("kubernetes")
+    watch_mod = types.ModuleType("kubernetes.watch")
+    state = {"pod_fails": fail_first_streams, "delivered": False}
+
+    class _Watch:
+        def stream(self, list_fn, namespace=None, timeout_seconds=None,
+                   resource_version=None, allow_watch_bookmarks=None):
+            if "pod" in list_fn.__name__:
+                if state["pod_fails"] > 0:
+                    state["pod_fails"] -= 1
+                    raise ConnectionError("connection reset by peer")
+                if not state["delivered"]:
+                    state["delivered"] = True
+                    yield {"type": "ADDED", "object": _PodObj("db-0")}
+            time.sleep(0.05)
+
+        def stop(self):
+            pass
+
+    watch_mod.Watch = _Watch
+    mod.watch = watch_mod
+    monkeypatch.setitem(sys.modules, "kubernetes", mod)
+    monkeypatch.setitem(sys.modules, "kubernetes.watch", watch_mod)
+
+
+def test_pump_retries_transient_stream_error(monkeypatch):
+    _install_flaky_kubernetes_stub(monkeypatch, fail_first_streams=2)
+    from rca_tpu.cluster.watch_pump import WatchPumpSet
+
+    retry = Retry(attempts=3, base_delay=0.0, jitter=0.0,
+                  sleep=lambda s: None, seed=0)
+    pumps = WatchPumpSet(_FakeCore(), "prod", retry=retry)
+    token = pumps.register()
+    pumps.start()
+    try:
+        assert _wait_until(lambda: len(pumps._journal) >= 1)
+        assert not pumps.expired         # transient errors did NOT expire
+        assert retry.retries_spent >= 2
+        assert {(c["kind"], c["name"]) for c in pumps.drain(token)} == {
+            ("pod", "db-0"),
+        }
+    finally:
+        pumps.stop()
+
+
+def test_pump_gone_still_expires_immediately(monkeypatch):
+    """A 410-shaped error must bypass the retry loop: the RV is dead and
+    every consumer has to re-list."""
+    mod = types.ModuleType("kubernetes")
+    watch_mod = types.ModuleType("kubernetes.watch")
+
+    class _Watch:
+        def stream(self, *a, **k):
+            raise RuntimeError("Expired: too old resource version (410)")
+            yield  # pragma: no cover
+
+        def stop(self):
+            pass
+
+    watch_mod.Watch = _Watch
+    mod.watch = watch_mod
+    monkeypatch.setitem(sys.modules, "kubernetes", mod)
+    monkeypatch.setitem(sys.modules, "kubernetes.watch", watch_mod)
+    from rca_tpu.cluster.watch_pump import WatchPumpSet
+
+    retry = Retry(attempts=5, base_delay=0.0, jitter=0.0,
+                  sleep=lambda s: None, seed=0)
+    pumps = WatchPumpSet(_FakeCore(), "prod", retry=retry)
+    pumps.start()
+    try:
+        assert _wait_until(lambda: pumps.expired)
+        assert retry.retries_spent == 0  # no retries burned on a 410
+    finally:
+        pumps.stop()
+
+
+# -- lint + soak -------------------------------------------------------------
+
+def test_swallowed_fault_lint_is_clean():
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "lint_swallowed_faults.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_chaos_soak_contract_fast():
+    """Seeded 60-tick soak on the 50-service fixture (the fast tier-1
+    variant of ``python -m rca_tpu chaos``): zero uncaught exceptions,
+    every fault class observed, fault-free ticks bit-identical to the
+    fault-free baseline session."""
+    summary = run_chaos_soak(
+        _soak_world, "synthetic", seed=7, ticks=60,
+        engine_factory=GraphEngine, config=ChaosConfig(seed=7),
+    )
+    assert summary["uncaught_exceptions"] == 0
+    assert summary["all_classes_observed"], summary["faults_injected"]
+    assert summary["parity_ok"]
+    assert summary["parity_ticks_checked"] > 0
+    assert summary["resyncs_expired"] > 0
+    assert set(FAULT_CLASSES) == set(summary["faults_injected"])
