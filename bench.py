@@ -268,6 +268,14 @@ def main(skip_accuracy: bool = False, with_chaos: bool = False) -> int:
     batch_marginal_jitter_ms = (
         float(np.max(_marginals) - np.min(_marginals)) if _marginals else None
     )
+    # a marginal BELOW the run-to-run jitter bound is noise, not a
+    # measurement (BENCH_r05 published -0.0048 ms): per the PERF.md
+    # "never print 0" rule it reports null, with the jitter bound kept
+    # alongside as the honest resolution limit
+    if (batch_marginal_ms is not None
+            and batch_marginal_jitter_ms is not None
+            and batch_marginal_ms < batch_marginal_jitter_ms):
+        batch_marginal_ms = None
 
     # pure device compute per 2k inference, amortized over an in-jit loop
     # (the headline ``value`` is single-shot end-to-end and so includes one
@@ -285,10 +293,15 @@ def main(skip_accuracy: bool = False, with_chaos: bool = False) -> int:
     from rca_tpu.engine.pallas_kernels import (
         noisy_or_pair_pallas,
         noisy_or_pair_xla,
+        noisyor_autotune,
         pallas_enabled,
         pallas_supported,
     )
 
+    # one-shot combine-path autotune (ISSUE 2 satellite): what a session
+    # starting on THIS backend would actually run, replacing the static
+    # flag that left pallas_supported=true / 4.5x-slower on record
+    noisyor_choice = noisyor_autotune()
     pallas_ok = pallas_supported()
     aw_j, hw_j = jnp.asarray(aw), jnp.asarray(hw)
     ft = bfj.T  # kernel reads channel-major; bfj is the padded 50k matrix
@@ -315,32 +328,79 @@ def main(skip_accuracy: bool = False, with_chaos: bool = False) -> int:
     # resident feature buffer; each tick flushes ~1% of services as a
     # donated-argument row scatter then reruns the cached executable.
     from rca_tpu.engine.streaming import StreamingSession
+    from rca_tpu.obslog.profiling import PhaseStats
 
     sk = synthetic_cascade_arrays(10_000, n_roots=3, seed=1)
-    sess = StreamingSession(
-        [f"svc-{i:05d}" for i in range(sk.n)], sk.dep_src, sk.dep_dst,
-        num_features=sk.features.shape[1], k=5,
-    )
-    sess.set_all(sk.features)
-    sess.tick()  # warm the propagation executable
-    # warm the 128-row scatter tier too, so no measured tick pays a compile
-    sess.update_many({i: sk.features[i] for i in range(100)})
-    sess.tick()
+
+    def make_10k_session():
+        s = StreamingSession(
+            [f"svc-{i:05d}" for i in range(sk.n)], sk.dep_src, sk.dep_dst,
+            num_features=sk.features.shape[1], k=5,
+        )
+        s.set_all(sk.features)
+        s.tick()  # warm the propagation executable
+        # warm the 128-row scatter tier too: no measured tick pays a compile
+        s.update_many({i: sk.features[i] for i in range(100)})
+        s.tick()
+        return s
+
+    # the SAME seeded delta sequence drives the serial and pipelined
+    # loops, so their per-tick states — and rankings — are comparable
     srng = np.random.default_rng(2)
-    tick_times = []
+    delta_seq = []
     for _ in range(20):
-        rows = {
+        delta_seq.append({
             int(i): np.clip(
                 sk.features[i]
                 + srng.uniform(-0.05, 0.05, sk.features.shape[1]), 0, 1
             ).astype(np.float32)
             for i in srng.integers(0, sk.n, 100)
-        }
+        })
+
+    sess = make_10k_session()
+    serial_phases = PhaseStats()
+    tick_times = []
+    serial_ranked = []
+    for rows in delta_seq:
         sess.update_many(rows)
         out = sess.tick()
         tick_times.append(out["latency_ms"])
+        serial_phases.record_tick(out)
+        serial_ranked.append(out["ranked"])
     tick_ms_10k = float(np.median(tick_times))
     tick_upload_rows = int(out["upload_rows"])
+
+    # pipelined twin (ISSUE 2 tentpole): dispatch tick N, stage tick N+1's
+    # deltas, THEN fetch tick N — per-tick wall is what the overlap leaves,
+    # not capture + RTT summed.  Fresh session (identical warmup) so both
+    # loops start from the same device state; ranking parity is asserted.
+    sess_p = make_10k_session()
+    pipe_phases = PhaseStats()
+    pipe_iter_times = []
+    pipe_ranked = []
+    prev = None
+    for rows in delta_seq:
+        t0 = time.perf_counter()
+        with pipe_phases.phase("capture"):
+            sess_p.update_many(rows)
+        h = sess_p.dispatch()
+        pipe_phases.record("dispatch", h.dispatch_ms)
+        if prev is not None:
+            out_p = sess_p.fetch(prev)
+            pipe_phases.record("fetch", out_p["fetch_ms"])
+            pipe_ranked.append(out_p["ranked"])
+        prev = h
+        pipe_iter_times.append((time.perf_counter() - t0) * 1e3)
+    out_p = sess_p.fetch(prev)  # drain the last in-flight tick
+    pipe_ranked.append(out_p["ranked"])
+    # first iteration fetches nothing (pipeline fill) — excluded
+    tick_ms_10k_pipelined = float(np.median(pipe_iter_times[1:]))
+    pipeline_parity_ok = pipe_ranked == serial_ranked
+
+    def phase_medians(ps):
+        return {
+            name: rec["median_ms"] for name, rec in ps.summary().items()
+        }
 
     # -- live capture path at 10k (VERDICT r2 item 6): watch-driven quiet
     # polls vs full-sweep polls, HOST-side capture cost (capture_ms —
@@ -408,7 +468,25 @@ def main(skip_accuracy: bool = False, with_chaos: bool = False) -> int:
         "out = s.tick()\n"
         "top1 = out['ranked'][0]['component']\n"
         "hit = top1 in {f's{r}' for r in c.roots.tolist()}\n"
-        "print(json.dumps({'tick_ms': out['latency_ms'], 'top1_hit': hit}))\n"
+        # pipelined ticks over the same session (ISSUE 2): dispatch N,
+        # stage N+1's deltas, fetch N — wall per tick with the fetch
+        # overlapped, same dispatch/fetch split as the dense session
+        "import time\n"
+        "prev = None\n"
+        "iters = 4\n"
+        "t0 = time.perf_counter()\n"
+        "for t in range(iters):\n"
+        "    for i in rng.integers(0, c.n, 9):\n"
+        "        s.update(int(i), np.clip(c.features[i] + 0.1 + t * 0.01,"
+        " 0, 1))\n"
+        "    h = s.dispatch()\n"
+        "    if prev is not None:\n"
+        "        s.fetch(prev)\n"
+        "    prev = h\n"
+        "s.fetch(prev)\n"
+        "pipe_ms = (time.perf_counter() - t0) * 1e3 / iters\n"
+        "print(json.dumps({'tick_ms': out['latency_ms'], 'top1_hit': hit,"
+        " 'tick_ms_pipelined': pipe_ms}))\n"
     )
     try:
         env = dict(os.environ)
@@ -526,18 +604,32 @@ def main(skip_accuracy: bool = False, with_chaos: bool = False) -> int:
         "batch64_marginal_per_hypothesis_ms_2k": r(batch_marginal_ms),
         "batch64_marginal_jitter_ms": r(batch_marginal_jitter_ms),
         "tick_ms_10k": round(tick_ms_10k, 3),
+        "tick_ms_10k_pipelined": round(tick_ms_10k_pipelined, 3),
+        "tick_pipeline_speedup_10k": round(
+            tick_ms_10k / max(tick_ms_10k_pipelined, 1e-3), 2
+        ),
+        "tick_pipeline_parity_ok_10k": bool(pipeline_parity_ok),
+        "tick_phases_10k": phase_medians(serial_phases),
+        "tick_phases_10k_pipelined": phase_medians(pipe_phases),
         "tick_upload_rows_10k": tick_upload_rows,
         "live_quiet_capture_ms_10k": round(live_quiet_ms, 3),
         "live_sweep_capture_ms_10k": round(live_sweep_ms, 3),
         "live_recovery_capture_ms_10k": round(live_recovery_ms, 3),
         "live_recovery_graceful": live_recovered,
         "sharded_stream_tick_50k_dryrun": shard_tick,
+        "sharded_stream_tick_50k_pipelined": (
+            r(shard_tick.get("tick_ms_pipelined"), 3)
+            if isinstance(shard_tick, dict) else None
+        ),
         "live_watch_capture_speedup": round(
             live_sweep_ms / max(live_quiet_ms, 1e-3), 1
         ),
         "segscan_engaged_50k": big_down_seg is not None,
         "pallas_supported": bool(pallas_ok),
         "pallas_engaged": bool(pallas_enabled()),  # reflects RCA_PALLAS env
+        # the measured one-shot autotune choice sessions actually run
+        # (xla | pallas; RCA_PALLAS=1/0 forces, auto times both on TPU)
+        "noisyor_path": noisyor_choice,
         "xla_noisyor_50k_ms": r(xla_nor_ms),
         "pallas_noisyor_50k_ms": r(pallas_nor_ms),
         "backend": "jax",

@@ -314,7 +314,10 @@ def cmd_stream(args) -> int:
     client, ns = _make_client(args.fixture, args.seed,
                               getattr(args, 'fault_mix', 'crash'))
     namespace = args.namespace or ns or "default"
-    live = LiveStreamingSession(client, namespace, k=args.top)
+    live = LiveStreamingSession(
+        client, namespace, k=args.top,
+        pipeline_depth=getattr(args, "pipeline_depth", None),
+    )
     for i in range(args.ticks):
         out = live.poll()
         line = {
@@ -336,6 +339,13 @@ def cmd_stream(args) -> int:
             line["sanitized_rows"] = health["sanitized_rows"]
         if health.get("degradation"):
             line["degradation_rung"] = health["degradation_rung"]
+        # pipeline channel: only at depth >= 2, so depth-1 output stays
+        # byte-identical to the pre-pipeline stream
+        if health.get("pipeline_depth", 1) > 1:
+            line["pipeline_depth"] = health["pipeline_depth"]
+            line["result_lag"] = health["result_lag"]
+            if health.get("pipeline_fill"):
+                line["pipeline_fill"] = True
         print(json.dumps(line, default=str), flush=True)
         if args.interval > 0 and i + 1 < args.ticks:
             _time.sleep(args.interval)
@@ -512,6 +522,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--interval", type=float, default=1.0,
                     help="seconds between polls (0 = as fast as possible)")
     sp.add_argument("--top", type=int, default=5)
+    sp.add_argument("--pipeline-depth", type=int, default=None,
+                    dest="pipeline_depth",
+                    help="tick pipeline depth (default $RCA_PIPELINE_DEPTH "
+                    "or 1): 2 overlaps each tick's device round trip with "
+                    "the next poll's capture; rankings arrive depth-1 "
+                    "ticks late")
     sp.set_defaults(fn=cmd_stream)
 
     sp = sub.add_parser("train", help="fit propagation weights on "

@@ -81,8 +81,20 @@ class World:
         """Record that object ``kind``/``name`` changed (create, update, or
         delete — watchers re-fetch, so the op is irrelevant).  ``kind`` is
         the singular store name ("pod", "service", ...) plus the pseudo
-        kinds "pod_metrics", "event", and "logs"."""
+        kinds "pod_metrics", "event", and "logs".
+
+        Mirrors the API server's write semantics by bumping the touched
+        object's ``metadata.resourceVersion`` (to the journal seq): real
+        clusters stamp every write, and the incremental feature extractor
+        (features/extract.py) keys its row cache on it — a mock whose
+        mutations kept a frozen rv would make that cache untestable."""
         self.journal_seq += 1
+        store = getattr(self, self._KIND_PLURAL.get(kind, ""), None)
+        if isinstance(store, dict):
+            for obj in store.get(namespace, []):
+                md = obj.get("metadata")
+                if isinstance(md, dict) and md.get("name") == name:
+                    md["resourceVersion"] = str(self.journal_seq)
         self.journal.append(
             {"seq": self.journal_seq, "kind": kind,
              "namespace": namespace, "name": name}
@@ -101,6 +113,15 @@ class World:
         if seq < self.journal_floor - 1:
             return None
         return [e for e in self.journal if e["seq"] > seq]
+
+    _KIND_PLURAL = {
+        "pod": "pods", "service": "services", "deployment": "deployments",
+        "statefulset": "statefulsets", "daemonset": "daemonsets",
+        "cronjob": "cronjobs", "event": "events", "endpoints": "endpoints",
+        "ingress": "ingresses", "networkpolicy": "network_policies",
+        "configmap": "configmaps", "secret": "secrets", "pvc": "pvcs",
+        "resourcequota": "resource_quotas", "hpa": "hpas",
+    }
 
     _KIND_SINGULAR = {
         "pods": "pod", "services": "service", "deployments": "deployment",

@@ -31,6 +31,14 @@ class RCAConfig:
     # Engine knobs
     propagation_steps: int = 8
     top_k_root_causes: int = 5
+    # Streaming tick pipeline depth (RCA_PIPELINE_DEPTH): 1 = serial
+    # capture→dispatch→fetch per poll (the pre-round-6 behavior,
+    # bit-identical); N >= 2 keeps N-1 ticks in flight — each poll
+    # dispatches this tick's work and fetches the tick issued N-1 polls
+    # ago, hiding the tunnel RTT behind the next poll's host capture at
+    # the cost of N-1 polls of result latency (surfaced per tick in the
+    # health record).  See engine/live.py and PERF.md round-6.
+    pipeline_depth: int = 1
     # Shape-bucket tiers for jit recompilation control (padded node AND
     # edge counts).  Explicit power-of-two tiers up to 4096; above, sizes
     # round up to 8 sub-tiers per octave (bucket_for), because the
@@ -55,9 +63,87 @@ class RCAConfig:
             "llm_provider": os.environ.get("LLM_PROVIDER", "offline"),
             "log_dir": os.environ.get("RCA_LOG_DIR", "logs"),
             "kubeconfig": os.environ.get("KUBECONFIG"),
+            "pipeline_depth": pipeline_depth_from_env(),
         }
         env.update(overrides)
         return cls(**env)
+
+
+def pipeline_depth_from_env(default: int = 1) -> int:
+    """``RCA_PIPELINE_DEPTH`` as a validated int (>= 1); empty/unset means
+    the caller's default.  A malformed value fails loudly — a typo'd depth
+    silently running serial would fake away the optimization it asked for.
+    """
+    raw = (os.environ.get("RCA_PIPELINE_DEPTH") or "").strip()
+    if not raw:
+        return default
+    try:
+        depth = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"RCA_PIPELINE_DEPTH={raw!r}: expected a positive integer"
+        )
+    if depth < 1:
+        raise ValueError(
+            f"RCA_PIPELINE_DEPTH={depth}: depth counts this tick too, so "
+            "it must be >= 1 (1 = serial)"
+        )
+    return depth
+
+
+# -- persistent compilation cache (ISSUE 2 satellite) -----------------------
+# enabled at most once per process; the dict is the recorded status the
+# session health records and bench line carry
+_COMPILE_CACHE: Optional[dict] = None
+
+
+def enable_compile_cache() -> dict:
+    """Point JAX's persistent compilation cache at ``RCA_COMPILE_CACHE``
+    (a directory) so repeated sessions skip recompiling the tick
+    executables — a 50k sharded session pays tens of seconds of XLA
+    compile on first run that a warm cache turns into a disk read.
+    Unset = disabled (the default: tests and one-off runs keep their
+    hermetic no-cache behavior).  Idempotent; returns the status dict
+    (``compile_cache_entries`` counts cache files at call time, so a
+    caller sampling it before and after a session's first tick sees
+    miss-compiles as new entries)."""
+    global _COMPILE_CACHE
+    if _COMPILE_CACHE is not None:
+        return compile_cache_status()
+    cache_dir = (os.environ.get("RCA_COMPILE_CACHE") or "").strip()
+    if not cache_dir:
+        _COMPILE_CACHE = {"enabled": False}
+        return compile_cache_status()
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache every executable: the tick executables the streaming
+        # sessions rely on compile in well under the 1s default floor
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _COMPILE_CACHE = {"enabled": True, "dir": cache_dir}
+    except Exception as exc:  # pragma: no cover - depends on jax build
+        # a missing cache feature must not take down the engine: record
+        # why it is off and run uncached
+        _COMPILE_CACHE = {
+            "enabled": False, "dir": cache_dir,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+    return compile_cache_status()
+
+
+def compile_cache_status() -> dict:
+    """Current cache status + entry count (cheap directory scan)."""
+    status = dict(_COMPILE_CACHE or {"enabled": False})
+    if status.get("enabled"):
+        try:
+            status["entries"] = sum(
+                1 for e in os.scandir(status["dir"]) if e.is_file()
+            )
+        except OSError:
+            status["entries"] = 0
+    return status
 
 
 def bucket_for(n: int, buckets) -> int:

@@ -24,6 +24,17 @@ Two capture strategies, auto-selected (VERDICT r2 item 6):
   host-side, and diffs against the previous matrix, uploading only the
   changed rows.
 
+Round 6 (ISSUE 2) threads the tick PIPELINE through ``poll()``: with
+``pipeline_depth >= 2`` (``RCA_PIPELINE_DEPTH``), each poll dispatches
+this capture's fused tick and fetches the one issued depth-1 polls ago,
+so the ~90–110 ms tunneled-device round trip and the host capture hide
+behind each other instead of summing.  Rankings are exactly the serial
+sequence delivered depth-1 polls late (parity-tested); depth 1 is the
+bit-identical serial default.  Busy-poll captures also stop re-deriving
+unchanged feature rows: :class:`rca_tpu.features.extract.
+IncrementalExtractor` memoizes rows by object resourceVersion and log
+scans/selector matches by content.
+
 Either way, topology changes (services added/removed, dependency edges
 changed) force a session rebuild — edges are device-pinned for the
 session, so a changed graph is a new session, counted in ``resyncs``.
@@ -43,6 +54,7 @@ Below the cap the patched session is bit-identical to a fresh one
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Any, Dict, List, Optional
@@ -50,9 +62,14 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from rca_tpu.cluster.snapshot import ClusterSnapshot
+from rca_tpu.config import (
+    compile_cache_status,
+    enable_compile_cache,
+    pipeline_depth_from_env,
+)
 from rca_tpu.engine.runner import GraphEngine
 from rca_tpu.engine.streaming import StreamingSession, make_streaming_session
-from rca_tpu.features.extract import extract_features
+from rca_tpu.features.extract import IncrementalExtractor
 from rca_tpu.graph.build import service_dependency_edges
 from rca_tpu.resilience.policy import (
     drain_faults,
@@ -88,16 +105,41 @@ class LiveStreamingSession:
         engine: Optional[GraphEngine] = None,
         topology_check_every: int = 5,
         use_watch: bool = True,
+        pipeline_depth: Optional[int] = None,
     ):
         """``topology_check_every``: do a full sweep + dependency-edge
         compare on every Nth poll — the edge build is the most expensive
         host step (~0.9 s at 10k services) and trace-derived edges drift
         invisibly to the change feed.  ``use_watch=False`` forces the
         sweep strategy even when the client has a change feed (the bench
-        uses this to measure the sweep baseline)."""
+        uses this to measure the sweep baseline).
+
+        ``pipeline_depth`` (default ``RCA_PIPELINE_DEPTH``, else 1): 1 runs
+        each poll serially (capture → dispatch → fetch, the pre-round-6
+        behavior, bit-identical); N >= 2 keeps N-1 ticks in flight — each
+        poll dispatches this capture's tick and fetches the one issued N-1
+        polls ago, so the device round trip hides behind the NEXT poll's
+        host capture.  Rankings are identical to serial, delivered N-1
+        polls late (the first N-1 polls are pipeline-fill ticks carrying
+        the last known ranking); the lag is surfaced in every tick's
+        health record."""
         self.client = client
         self.namespace = namespace
         self.k = k
+        # tick pipeline (ISSUE 2 tentpole): in-flight handles, oldest first
+        self.pipeline_depth = (
+            pipeline_depth_from_env() if pipeline_depth is None
+            else max(1, int(pipeline_depth))
+        )
+        self._inflight: "collections.deque" = collections.deque()
+        self.pipeline_flushed = 0  # in-flight ticks dropped by degradation
+        # incremental capture cache (busy polls re-derive only changed
+        # rows; full sweeps refresh the cache — see features/extract.py)
+        self._extractor = IncrementalExtractor()
+        # persistent-compile-cache status for the health record: entries
+        # counted at session start; the first post-tick health record adds
+        # how many NEW entries this session compiled (0 = warm start)
+        self._compile_cache = enable_compile_cache()
         # engine selection follows the analyze boundary (RCA_SHARD +
         # visible devices): a sharded engine gets the sharded streaming
         # session with its sp-sharded resident buffer (VERDICT r3 item 3)
@@ -155,7 +197,10 @@ class LiveStreamingSession:
             self._reopen_feed()
             snap = ClusterSnapshot.capture(self.client, self.namespace)
         if fs is None:
-            fs = extract_features(snap)
+            # full-mode extraction: a resync is the recovery path for
+            # "we may have missed something", so it must not trust the
+            # row cache — it refreshes it instead
+            fs = self._extractor.extract(snap, incremental=False)
         src, dst = edges if edges is not None else service_dependency_edges(
             snap, fs
         )
@@ -303,7 +348,11 @@ class LiveStreamingSession:
             errors=[],
         )
         self._force_topology_check = True
-        fs = extract_features(snap2)
+        # full-mode extraction: the notifications were LOST, so the drift
+        # this recovery grafted in is exactly the un-journaled kind the
+        # rv-keyed row cache cannot see (the log-scan and selector memos,
+        # content-keyed, still apply — and get refreshed)
+        fs = self._extractor.extract(snap2, incremental=False)
         if list(fs.service_names) != self._names:
             # the service set itself moved while we were blind: full rebuild
             self._resync(snap=snap2, fs=fs, cause="expired")
@@ -448,8 +497,31 @@ class LiveStreamingSession:
         retries_now = retry_counter()
         spent = retries_now - self._retries_mark
         self._retries_mark = retries_now
+        if (self._compile_cache.get("enabled")
+                and "new_entries" not in self._compile_cache
+                and getattr(self.session, "ticks", 0) > 0):
+            # first post-tick health record: how many executables this
+            # session had to COMPILE (new cache files) — 0 means the
+            # persistent cache served everything (a warm start)
+            now = compile_cache_status().get("entries", 0)
+            self._compile_cache["new_entries"] = (
+                now - self._compile_cache.get("entries", 0)
+            )
+            self._compile_cache["warm"] = (
+                self._compile_cache["new_entries"] == 0
+            )
         return {
             "sanitized_rows": int(out.get("sanitized_rows", 0)),
+            "pipeline_depth": self.pipeline_depth,
+            "result_lag": (
+                0 if self.pipeline_depth == 1 or out.get("pipeline_fill")
+                else self.pipeline_depth - 1
+            ),
+            "inflight": len(self._inflight),
+            "pipeline_flushed": self.pipeline_flushed,
+            "pipeline_fill": bool(out.get("pipeline_fill", False)),
+            "noisyor_path": getattr(self.session, "noisyor_path", None),
+            "compile_cache": dict(self._compile_cache),
             "resyncs_expired": self.resyncs_expired,
             "resyncs_topology": self.resyncs_topology,
             "resync_cause": (
@@ -473,6 +545,14 @@ class LiveStreamingSession:
         self.degradation = min(self.degradation + 1,
                                len(DEGRADATION_LADDER) - 1)
         self._tick_failures = 0
+        # drain the pipeline: queued in-flight handles were dispatched on
+        # the engine that just failed repeatedly — their results are
+        # suspect and their buffers belong to the session being replaced.
+        # Dropping (counted, surfaced in health) is the clean drain; the
+        # retained feature matrix re-uploads below, so no DATA is lost,
+        # only up to depth-1 stale rankings.
+        self.pipeline_flushed += len(self._inflight)
+        self._inflight.clear()
         if self.degradation == 1:
             self.engine = GraphEngine()
             src, dst = self._edges_raw
@@ -521,6 +601,94 @@ class LiveStreamingSession:
             "tick": self._polls, "upload_rows": 0, "sanitized_rows": 0,
             "_tick_degraded": True,
         }
+
+    # -- pipelined tick (pipeline_depth >= 2) --------------------------------
+    def _guarded_dispatch(self):
+        """session.dispatch() under the degradation ladder (the dispatch
+        half of :meth:`_guarded_tick`'s contract): a failure records the
+        fault, steps the ladder after repeated failure, and retries on the
+        rebuilt session.  Returns None only when every rung failed."""
+        import jax
+
+        for _ in range(len(DEGRADATION_LADDER) + 1):
+            try:
+                if self.degradation >= 2:
+                    with jax.disable_jit():
+                        handle = self.session.dispatch()
+                else:
+                    handle = self.session.dispatch()
+                self._tick_failures = 0
+                return handle
+            except Exception as exc:
+                record_fault(
+                    "live.dispatch"
+                    f"[{DEGRADATION_LADDER[self.degradation]}]", exc
+                )
+                self._tick_failures += 1
+                if self.degradation >= len(DEGRADATION_LADDER) - 1:
+                    break
+                if self._tick_failures >= _TICK_FAILURES_TO_DEGRADE:
+                    self._degrade()
+        return None
+
+    def _guarded_fetch(self, handle) -> Optional[Dict[str, Any]]:
+        """Fetch one in-flight tick; an execution fault surfacing at the
+        fetch (that is where async dispatch errors land) is absorbed like
+        a serial tick failure: record, count toward the ladder, return
+        None — the caller serves the last known ranking degraded."""
+        try:
+            out = handle.session.fetch(handle)
+            self._tick_failures = 0
+            return out
+        except Exception as exc:
+            record_fault(
+                f"live.fetch[{DEGRADATION_LADDER[self.degradation]}]", exc
+            )
+            self._tick_failures += 1
+            if (self._tick_failures >= _TICK_FAILURES_TO_DEGRADE
+                    and self.degradation < len(DEGRADATION_LADDER) - 1):
+                self._degrade()
+            return None
+
+    def _tick_pipelined(self) -> Dict[str, Any]:
+        """One pipelined tick: dispatch THIS capture's work, then return
+        the tick issued ``pipeline_depth - 1`` polls ago — its device
+        round trip ran while the intervening captures did host work.
+        While the pipeline fills (and after a flush) the poll returns the
+        last known ranking with ``pipeline_fill``; rankings are otherwise
+        exactly the serial sequence, one poll late per depth step
+        (parity-tested in tests/test_tick_pipeline.py)."""
+        handle = self._guarded_dispatch()
+        degraded = handle is None or self.degradation > 0
+        if handle is not None:
+            self._inflight.append(handle)
+        out: Optional[Dict[str, Any]] = None
+        fill = False
+        if len(self._inflight) > self.pipeline_depth - 1 or (
+            handle is None and self._inflight
+        ):
+            # queue full (steady state) — or dispatch is broken, in which
+            # case drain rather than sit on results that already exist
+            out = self._guarded_fetch(self._inflight.popleft())
+            if out is None:
+                degraded = True
+        elif handle is not None and not degraded:
+            fill = True  # healthy, pipeline still filling
+        if out is None:
+            out = {
+                "ranked": list(self._last_ranked), "latency_ms": 0.0,
+                "tick": self._polls,
+                "upload_rows": handle.upload_rows if handle else 0,
+                "sanitized_rows": 0,
+                "dispatch_ms": (
+                    round(handle.dispatch_ms, 3) if handle else 0.0
+                ),
+            }
+        if fill:
+            out["pipeline_fill"] = True
+        if degraded:
+            out["_tick_degraded"] = True
+        return out
 
     def _poll_inner(self) -> Dict[str, Any]:
         if not self._watch:
@@ -579,7 +747,11 @@ class LiveStreamingSession:
                     t0, changed=len(self._names), resynced=True, quiet=False,
                 )
             snap = self._patch_snapshot(changes)
-            fs = extract_features(snap)
+            # busy-poll capture: every mutation reaching here is
+            # journal-mediated (the API server — or the mock's touch —
+            # bumped resourceVersion), so the incremental extractor
+            # re-derives ONLY the changed rows
+            fs = self._extractor.extract(snap)
             if list(fs.service_names) != self._names:
                 self._resync(snap=snap, fs=fs)
                 return self._finish(
@@ -616,7 +788,10 @@ class LiveStreamingSession:
     def _finish(self, t0: float, changed: int, resynced: bool,
                 quiet: bool) -> Dict[str, Any]:
         capture_ms = (time.perf_counter() - t0) * 1e3
-        out = self._guarded_tick()
+        out = (
+            self._tick_pipelined() if self.pipeline_depth > 1
+            else self._guarded_tick()
+        )
         out.update(
             changed_rows=changed, resynced=resynced, quiet=quiet,
             capture_ms=round(capture_ms, 2), resyncs=self.resyncs,
@@ -632,7 +807,10 @@ class LiveStreamingSession:
         feed; the watch path's periodic topology check also lands here)."""
         t0 = time.perf_counter()
         snap = ClusterSnapshot.capture(self.client, self.namespace)
-        fs = extract_features(snap)
+        # full mode: sweeps exist to catch OUT-OF-BAND drift (trace-derived
+        # edges, un-journaled mutations), which the rv-keyed row cache by
+        # definition cannot see — recompute rows, refresh the cache
+        fs = self._extractor.extract(snap, incremental=False)
         resynced = False
         edges = None
         if list(fs.service_names) != self._names:

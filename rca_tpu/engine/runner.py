@@ -421,6 +421,12 @@ class GraphEngine(EngineAPI):
         config: Optional[RCAConfig] = None,
         params: Optional[PropagationParams] = None,
     ):
+        # persistent XLA compile cache (RCA_COMPILE_CACHE, idempotent):
+        # enabled before the first jit of the session so repeated engine
+        # starts skip recompiling the tick executables
+        from rca_tpu.config import enable_compile_cache
+
+        enable_compile_cache()
         self.config = config or RCAConfig()
         self.params = resolve_params(self.config, params)
         self._aw, self._hw = self.params.weight_arrays()
@@ -500,16 +506,17 @@ class GraphEngine(EngineAPI):
             )
             from rca_tpu.engine.pallas_kernels import (
                 BLOCK_S,
-                pallas_enabled,
+                noisyor_autotune,
             )
 
-            # Pallas evidence pass is explicit opt-in (RCA_PALLAS=1): it
-            # measures as a wash vs XLA on real TPU (pallas_kernels
-            # docstring).  Kernel grid also needs the node pad to divide
-            # into blocks (true for every power-of-two shape bucket).
+            # Pallas evidence pass engages only when the one-shot autotune
+            # MEASURED it faster on this backend (RCA_PALLAS=1 forces it,
+            # =0 forces XLA; see pallas_kernels.noisyor_autotune).  Kernel
+            # grid also needs the node pad to divide into blocks (true for
+            # every power-of-two shape bucket).
             use_pallas = (
                 f.shape[0] % min(f.shape[0], BLOCK_S) == 0
-                and pallas_enabled()
+                and noisyor_autotune() == "pallas"
             )
 
             def run():

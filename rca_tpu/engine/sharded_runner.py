@@ -89,6 +89,13 @@ class ShardedGraphEngine(EngineAPI):
     ):
         from rca_tpu.parallel.mesh import make_mesh
 
+        # same persistent-compile-cache hook as the dense engine: the
+        # sharded tick executables are the most expensive compiles in the
+        # codebase (tens of seconds at 50k), exactly what a warm
+        # RCA_COMPILE_CACHE dir turns into a disk read
+        from rca_tpu.config import enable_compile_cache
+
+        enable_compile_cache()
         self.config = config or RCAConfig()
         self.params = resolve_params(self.config, params)
         if mesh is None:

@@ -15,10 +15,24 @@ the [S_pad, C] matrix.  The whole tick — scatter, propagation, top-k — runs
 as a SINGLE fused dispatch (:func:`_flush_propagate_ranked`): on tunneled
 TPUs each dispatch pays a host round trip that dwarfs device compute, so
 flush-then-propagate as two calls would double the tick latency.
+
+Round 6 splits the tick into its two host-visible halves so callers can
+PIPELINE ticks (ISSUE 2): :meth:`StreamingHostState.dispatch` packs the
+pending deltas and enqueues the fused executable (JAX dispatch is async —
+this returns in microseconds with a :class:`TickHandle` over the in-flight
+device values), and :meth:`StreamingHostState.fetch` blocks on the handle's
+results and renders the ranking.  ``tick()`` is exactly
+``fetch(dispatch())`` — the serial path stays bit-identical — while a
+depth-2 caller issues tick N, runs tick N+1's host capture, and only then
+fetches tick N: the ~90–110 ms tunnel RTT and the host capture hide behind
+each other instead of summing (bench: ``tick_ms_10k_pipelined``).  The
+ONLY place the tick path may synchronize with the device is
+:meth:`StreamingHostState.fetch` (enforced by tools/lint_tick_sync.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 from typing import Dict, List, Optional, Sequence
@@ -36,14 +50,14 @@ from rca_tpu.engine.runner import GraphEngine, _propagate_ranked, up_ell_for
     donate_argnums=(0,),
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
-        "error_contrast",
+        "error_contrast", "use_pallas",
     ),
 )
 def _flush_propagate_ranked(
     features, idx, rows, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live, up_ell=None, down_seg=None, up_seg=None,
-    error_contrast: float = 0.0,
+    error_contrast: float = 0.0, use_pallas: bool = False,
 ):
     """Whole tick in ONE dispatch: scatter the delta rows into the donated
     resident buffer, propagate, top-k.  On tunneled TPUs every dispatch pays
@@ -59,12 +73,35 @@ def _flush_propagate_ranked(
 
     features = features.at[idx].set(rows)
     features, n_bad = finite_mask_rows(features)
-    a, h, u, m, score = propagate(
-        features, edges[0], edges[1], anomaly_w, hard_w,
-        steps, decay, explain_strength, impact_bonus, n_live=n_live,
-        up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-        error_contrast=error_contrast,
-    )
+    if use_pallas:
+        # autotuned evidence path (pallas_kernels.noisyor_autotune picked
+        # the fused kernel for this backend): same math as propagate()'s
+        # XLA expression, over the channel-major transpose
+        from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
+        from rca_tpu.engine.propagate import (
+            error_source_excess,
+            fold_error_contrast,
+            propagate_core,
+        )
+
+        a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
+        if error_contrast:
+            a = fold_error_contrast(
+                a, error_source_excess(features, edges[0], edges[1]),
+                error_contrast,
+            )
+        a, h, u, m, score = propagate_core(
+            a, h, edges[0], edges[1],
+            steps, decay, explain_strength, impact_bonus, n_live=n_live,
+            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        )
+    else:
+        a, h, u, m, score = propagate(
+            features, edges[0], edges[1], anomaly_w, hard_w,
+            steps, decay, explain_strength, impact_bonus, n_live=n_live,
+            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+            error_contrast=error_contrast,
+        )
     vals, topi = jax.lax.top_k(score, k)
     return features, vals, topi, n_bad
 
@@ -94,6 +131,26 @@ def make_streaming_session(
         names, dep_src, dep_dst, num_features=num_features,
         engine=engine, k=k,
     )
+
+
+@dataclasses.dataclass
+class TickHandle:
+    """One in-flight tick: the device values an async dispatch left behind
+    plus everything the eventual fetch needs to render the result without
+    touching the session's CURRENT host state (which may already describe
+    a LATER tick — or, after a resync, a different session entirely).
+
+    ``session`` is the session that dispatched it: rankings render with
+    THAT session's names, so a handle stays fetchable across a live
+    session's topology resync or degradation rebuild."""
+
+    session: "StreamingHostState"
+    vals: object                 # [kk] device (or concrete) values
+    idx: object                  # [kk] device indices
+    n_bad: object                # sanitized-row count (device scalar or int)
+    upload_rows: int             # padded rows this tick uploaded
+    dispatch_ms: float           # host time to pack + enqueue
+    dispatched_at: float         # perf_counter at dispatch start
 
 
 class StreamingHostState:
@@ -151,7 +208,8 @@ class StreamingHostState:
         return total
 
     def _render_tick(self, vals, idx, latency_ms: float,
-                     sanitized_rows: int = 0) -> Dict[str, object]:
+                     sanitized_rows: int = 0,
+                     upload_rows: Optional[int] = None) -> Dict[str, object]:
         ranked: List[dict] = []
         for j, i in enumerate(np.asarray(idx).tolist()):
             if i >= self._n or len(ranked) >= self.k:
@@ -161,8 +219,48 @@ class StreamingHostState:
             )
         self.ticks += 1
         return {"ranked": ranked, "latency_ms": latency_ms,
-                "tick": self.ticks, "upload_rows": self.last_upload_rows,
+                "tick": self.ticks,
+                "upload_rows": (self.last_upload_rows
+                                if upload_rows is None else upload_rows),
                 "sanitized_rows": int(sanitized_rows)}
+
+    # -- pipelined tick halves ----------------------------------------------
+    def dispatch(self) -> TickHandle:
+        """Pack pending deltas and ENQUEUE the fused tick executable;
+        returns without synchronizing (JAX dispatch is async).  Implemented
+        by each session kind."""
+        raise NotImplementedError
+
+    def fetch(self, handle: TickHandle) -> Dict[str, object]:
+        """Block on an in-flight tick's results and render the ranking.
+
+        THE designated device-sync point of the whole tick path
+        (tools/lint_tick_sync.py forbids ``jax.device_get`` /
+        ``block_until_ready`` anywhere else in it): sync is through the
+        fetch, never ``block_until_ready`` alone — on tunneled backends
+        the latter can return at enqueue time (PERF.md methodology).
+
+        ``latency_ms`` is dispatch_ms + fetch_ms — the host time the tick
+        COST, not the handle's age: a pipelined caller parks a handle for
+        a whole poll interval, and age would read as latency."""
+        t1 = time.perf_counter()
+        vals, idx, n_bad = jax.device_get(
+            (handle.vals, handle.idx, handle.n_bad)
+        )
+        fetch_ms = (time.perf_counter() - t1) * 1e3
+        out = handle.session._render_tick(
+            vals, idx, handle.dispatch_ms + fetch_ms, int(n_bad),
+            upload_rows=handle.upload_rows,
+        )
+        out["dispatch_ms"] = round(handle.dispatch_ms, 3)
+        out["fetch_ms"] = round(fetch_ms, 3)
+        return out
+
+    def tick(self) -> Dict[str, object]:
+        """One serial inference pass (dispatch immediately fetched):
+        ranked root causes + tick latency, bit-identical to the
+        pre-pipeline behavior."""
+        return self.fetch(self.dispatch())
 
 
 class StreamingSession(StreamingHostState):
@@ -208,6 +306,16 @@ class StreamingSession(StreamingHostState):
         )
         self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
         self._kk = min(k + 8, self._n_pad)
+        # noisy-OR combine path picked ONCE at session start (ISSUE 2
+        # satellite: BENCH_r05 had pallas_supported=true but a 4.5x-slower
+        # kernel — a static flag cannot know; the autotune measures)
+        from rca_tpu.engine.pallas_kernels import BLOCK_S, noisyor_autotune
+
+        self.noisyor_path = noisyor_autotune()
+        self._use_pallas = (
+            self.noisyor_path == "pallas"
+            and self._n_pad % min(self._n_pad, BLOCK_S) == 0
+        )
         self._init_host_state()
 
     def set_all(self, features: np.ndarray) -> None:
@@ -222,8 +330,10 @@ class StreamingSession(StreamingHostState):
         self._bulk_upload = self._n_pad
 
     # -- tick ---------------------------------------------------------------
-    def tick(self) -> Dict[str, object]:
-        """One inference pass; returns ranked root causes + tick latency."""
+    def dispatch(self) -> TickHandle:
+        """Enqueue one fused tick (scatter + propagate + top-k) and return
+        the in-flight handle; :meth:`fetch` renders it.  ``tick()`` (the
+        serial path) is fetch(dispatch()) back to back."""
         p = self.engine.params
         t0 = time.perf_counter()
         if self._pending:
@@ -235,22 +345,24 @@ class StreamingSession(StreamingHostState):
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
                 self._kk, self._n_live, self._up_ell, self._down_seg,
                 self._up_seg, error_contrast=p.error_contrast,
+                use_pallas=self._use_pallas,
             )
             # only drop the deltas once the dispatch is accepted — a raise
             # above (fresh-tier compile failure) must leave them retryable
-            self._account_upload(u_pad)
+            upload = self._account_upload(u_pad)
         else:
-            self._account_upload(0)
+            upload = self._account_upload(0)
             stacked, vals, idx, n_bad = _propagate_ranked(
                 self._features, self._edges,
                 self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
-                self._kk, False, self._n_live, self._up_ell, self._down_seg,
-                self._up_seg, error_contrast=p.error_contrast,
+                self._kk, self._use_pallas, self._n_live, self._up_ell,
+                self._down_seg, self._up_seg,
+                error_contrast=p.error_contrast,
             )
-        # sync through the fetch: block_until_ready alone can return at
-        # enqueue time on tunneled backends, under-measuring the tick
-        # (the sanitized-row count rides the same fetch — no extra sync)
-        vals, idx, n_bad = jax.device_get((vals, idx, n_bad))
-        latency_ms = (time.perf_counter() - t0) * 1e3
-        return self._render_tick(vals, idx, latency_ms, int(n_bad))
+        now = time.perf_counter()
+        return TickHandle(
+            session=self, vals=vals, idx=idx, n_bad=n_bad,
+            upload_rows=upload, dispatch_ms=(now - t0) * 1e3,
+            dispatched_at=t0,
+        )

@@ -40,6 +40,50 @@ class StageTimer:
         return out
 
 
+class PhaseStats:
+    """Per-phase duration accumulator for repeated loops (streaming ticks):
+    ``record("capture", ms)`` per iteration, ``summary()`` at the end.
+
+    The streaming pipeline (engine/streaming.py dispatch/fetch split) uses
+    this to publish the capture/dispatch/fetch breakdown the bench records
+    (``tick_phases_*``): medians are robust to the tunnel RTT's multi-ms
+    jitter, and the p90 keeps the tail visible instead of averaged away."""
+
+    def __init__(self) -> None:
+        self._samples: Dict[str, List[float]] = {}
+
+    def record(self, phase: str, ms: float) -> None:
+        self._samples.setdefault(phase, []).append(float(ms))
+
+    def record_tick(self, out: Dict[str, object]) -> None:
+        """Pull the standard phase keys off one tick/poll record."""
+        for key, phase in (("capture_ms", "capture"),
+                           ("dispatch_ms", "dispatch"),
+                           ("fetch_ms", "fetch")):
+            v = out.get(key)
+            if v is not None:
+                self.record(phase, float(v))  # type: ignore[arg-type]
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, (time.perf_counter() - t0) * 1e3)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for name, xs in self._samples.items():
+            s = sorted(xs)
+            out[name] = {
+                "median_ms": round(s[len(s) // 2], 3),
+                "p90_ms": round(s[min(len(s) - 1, (len(s) * 9) // 10)], 3),
+                "n": len(s),
+            }
+        return out
+
+
 @contextlib.contextmanager
 def maybe_jax_profile(tag: str):
     """Device trace when RCA_JAX_PROFILE=<dir> is set; no-op otherwise."""

@@ -160,6 +160,10 @@ class ShardedStreamingSession(StreamingHostState):
             self._kk, self._block, use_segscan=seg is not None,
             error_contrast=p.error_contrast,
         )
+        # the sharded per-block kernel keeps XLA's fused noisy-OR (the
+        # Pallas pair kernel has no shard_map twin); recorded so the tick
+        # health channel shows which combine path ran, same as dense
+        self.noisyor_path = "xla"
         self._feat_sharding = NamedSharding(self.mesh, P("sp", None))
         self._features = jax.device_put(
             jnp.zeros((self._n_pad, num_features), jnp.float32),
@@ -184,8 +188,14 @@ class ShardedStreamingSession(StreamingHostState):
         self._bulk_upload = self._n_pad
 
     # -- tick ---------------------------------------------------------------
-    def tick(self) -> Dict[str, object]:
+    def dispatch(self):
+        """Enqueue one fused sharded tick; same dispatch/fetch contract as
+        the dense session (``StreamingHostState.fetch`` renders the handle,
+        ``tick()`` runs the two serially).  The sanitized-row count is
+        host-side here (the delta rows stage from host anyway) so the
+        handle carries a plain int."""
         from rca_tpu.engine.runner import finite_mask_rows_np
+        from rca_tpu.engine.streaming import TickHandle
 
         t0 = time.perf_counter()
         # pad slots target index n_pad: out of range for EVERY shard, so
@@ -204,7 +214,10 @@ class ShardedStreamingSession(StreamingHostState):
             )
         # deltas drop only once the dispatch is accepted (retryable on a
         # compile failure), matching the dense session's contract
-        self._account_upload(u_pad if u else 0)
-        vals, idx = jax.device_get((vals, idx))
-        latency_ms = (time.perf_counter() - t0) * 1e3
-        return self._render_tick(vals, idx, latency_ms, sanitized)
+        upload = self._account_upload(u_pad if u else 0)
+        now = time.perf_counter()
+        return TickHandle(
+            session=self, vals=vals, idx=idx, n_bad=sanitized,
+            upload_rows=upload, dispatch_ms=(now - t0) * 1e3,
+            dispatched_at=t0,
+        )

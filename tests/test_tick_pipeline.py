@@ -1,0 +1,496 @@
+"""ISSUE 2: pipelined streaming ticks + incremental capture cache.
+
+Covers the round-6 contracts:
+
+- dispatch/fetch split is bit-identical to the old one-call tick;
+- ``pipeline_depth=2`` rankings are EXACTLY the serial sequence delivered
+  one tick late (60-tick seeded run, including periodic sweep polls);
+- under ChaosClusterClient faults the pipeline never raises and the
+  degradation ladder drains/flushes the in-flight tick cleanly;
+- the incremental feature cache matches full re-extraction after
+  arbitrary update/delete sequences (property test);
+- tools/lint_tick_sync.py gates the no-sync-outside-fetch invariant.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.generator import (
+    synthetic_cascade_arrays,
+    synthetic_cascade_world,
+)
+from rca_tpu.cluster.mock_client import MockClusterClient
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+from rca_tpu.cluster.world import make_event, waiting_status
+from rca_tpu.engine import GraphEngine, LiveStreamingSession
+from rca_tpu.engine.streaming import StreamingSession
+from rca_tpu.features.extract import IncrementalExtractor, extract_features
+
+
+def _ranked_key(out):
+    return json.dumps(out["ranked"], sort_keys=True)
+
+
+# -- dispatch/fetch split ----------------------------------------------------
+
+def test_dispatch_fetch_equals_tick():
+    """fetch(dispatch()) IS tick(): same rankings, scores, upload
+    accounting — the serial path through the split is bit-identical."""
+    c = synthetic_cascade_arrays(300, n_roots=1, seed=5)
+
+    def session():
+        s = StreamingSession(
+            c.names, c.dep_src, c.dep_dst,
+            num_features=c.features.shape[1], k=3,
+        )
+        s.set_all(c.features)
+        return s
+
+    a, b = session(), session()
+    for t in range(4):
+        rows = {
+            int((c.roots[0] + 17 * t + j) % c.n): np.full(
+                c.features.shape[1], 0.3 + 0.1 * t, np.float32
+            )
+            for j in range(3)
+        }
+        a.update_many(rows)
+        b.update_many(rows)
+        out_a = a.tick()
+        h = b.dispatch()
+        out_b = b.fetch(h)
+        assert _ranked_key(out_a) == _ranked_key(out_b)
+        assert out_a["upload_rows"] == out_b["upload_rows"]
+        assert out_a["sanitized_rows"] == out_b["sanitized_rows"]
+        assert out_b["dispatch_ms"] >= 0 and out_b["fetch_ms"] >= 0
+
+
+def test_streaming_session_manual_pipeline_shifted_parity():
+    """Depth-2 by hand on the raw session: dispatch N, stage N+1, fetch N
+    — the fetched sequence equals the serial sequence exactly."""
+    c = synthetic_cascade_arrays(400, n_roots=2, seed=9)
+    deltas = []
+    rng = np.random.default_rng(3)
+    for _ in range(6):
+        deltas.append({
+            int(i): np.clip(
+                c.features[i] + rng.uniform(-0.2, 0.2, c.features.shape[1]),
+                0, 1,
+            ).astype(np.float32)
+            for i in rng.integers(0, c.n, 5)
+        })
+
+    def session():
+        s = StreamingSession(
+            c.names, c.dep_src, c.dep_dst,
+            num_features=c.features.shape[1], k=4,
+        )
+        s.set_all(c.features)
+        s.tick()
+        return s
+
+    serial = session()
+    serial_seq = []
+    for rows in deltas:
+        serial.update_many(rows)
+        serial_seq.append(_ranked_key(serial.tick()))
+
+    piped = session()
+    piped_seq = []
+    prev = None
+    for rows in deltas:
+        piped.update_many(rows)
+        h = piped.dispatch()
+        if prev is not None:
+            piped_seq.append(_ranked_key(piped.fetch(prev)))
+        prev = h
+    piped_seq.append(_ranked_key(piped.fetch(prev)))
+    assert piped_seq == serial_seq
+
+
+# -- live session pipeline ---------------------------------------------------
+
+def _mutate(world, ns, op):
+    """Apply one descriptor-driven mutation (same descriptor applied to
+    twin worlds keeps them bit-identical)."""
+    kind, idx, arg = op
+    pods = world.pods[ns]
+    pod = pods[idx % len(pods)]
+    name = pod["metadata"]["name"]
+    app = pod["metadata"]["labels"].get("app", "x")
+    if kind == "crash":
+        pod["status"]["phase"] = "Running"
+        pod["status"]["containerStatuses"] = [
+            waiting_status(app, "CrashLoopBackOff",
+                           restarts=arg, last_exit_code=1)
+        ]
+        world.touch("pod", ns, name)
+    elif kind == "heal":
+        pod["status"]["phase"] = "Running"
+        pod["status"]["containerStatuses"] = [
+            {"name": app, "ready": True, "restartCount": 0,
+             "state": {"running": {}}}
+        ]
+        world.touch("pod", ns, name)
+    elif kind == "logs":
+        world.logs[ns][name] = {app: f"ERROR: failure mode {arg}\n" * arg}
+        world.touch("logs", ns, name)
+    elif kind == "metrics":
+        rec = world.pod_metrics[ns]["pods"].get(name)
+        if rec:
+            rec["cpu"]["usage_percentage"] = float(arg)
+            world.touch("pod_metrics", ns, name)
+
+
+def _op_sequence(seed, n):
+    rng = np.random.default_rng(seed)
+    kinds = ("crash", "heal", "logs", "metrics")
+    return [
+        (kinds[int(rng.integers(0, len(kinds)))],
+         int(rng.integers(0, 10_000)), int(rng.integers(1, 9)))
+        for _ in range(n)
+    ]
+
+
+def test_live_pipeline_60_tick_bit_parity():
+    """Acceptance gate: over a 60-tick seeded run (busy polls, quiet
+    polls, periodic sweep polls), depth-2 rankings are EXACTLY the serial
+    depth-1 sequence one tick late, with the first poll a pipeline-fill
+    tick."""
+    ops = _op_sequence(seed=13, n=60)
+
+    def run(depth):
+        world = synthetic_cascade_world(40, n_roots=1, seed=3,
+                                        namespace="pipe")
+        live = LiveStreamingSession(
+            MockClusterClient(world), "pipe", k=3, engine=GraphEngine(),
+            topology_check_every=7, pipeline_depth=depth,
+        )
+        seq = []
+        for t, op in enumerate(ops):
+            if t % 3 != 2:          # every third poll stays quiet
+                _mutate(world, "pipe", op)
+            out = live.poll()
+            assert out["degraded"] is False
+            seq.append((_ranked_key(out), out["health"]))
+        return seq
+
+    serial = run(1)
+    piped = run(2)
+    assert piped[0][1]["pipeline_fill"] is True
+    assert piped[0][1]["result_lag"] == 0
+    for k in range(1, 60):
+        assert piped[k][0] == serial[k - 1][0], f"tick {k} diverged"
+        assert piped[k][1]["result_lag"] == 1
+        assert piped[k][1]["pipeline_depth"] == 2
+        assert piped[k][1]["inflight"] == 1
+    # serial health record advertises the serial contract
+    assert serial[5][1]["pipeline_depth"] == 1
+    assert serial[5][1]["result_lag"] == 0
+    assert serial[5][1]["noisyor_path"] in ("xla", "pallas")
+
+
+def test_live_pipeline_under_chaos_never_raises_and_drains():
+    """RESILIENCE contract at depth 2: injected faults (timeouts,
+    truncated lists, NaN metrics, feed expiry storms) never escape
+    poll(), the in-flight queue stays bounded at depth-1, and the session
+    keeps serving rankings."""
+    from rca_tpu.resilience.chaos import ChaosClusterClient, ChaosConfig
+
+    world = synthetic_cascade_world(40, n_roots=1, seed=5, namespace="cx")
+    cfg = ChaosConfig(seed=11)
+    cfg.enabled = False             # bootstrap capture runs fault-free
+    chaos = ChaosClusterClient(MockClusterClient(world), cfg)
+    live = LiveStreamingSession(
+        chaos, "cx", k=3, engine=GraphEngine(),
+        topology_check_every=5, pipeline_depth=2,
+    )
+    cfg.enabled = True
+    ops = _op_sequence(seed=29, n=60)
+    served = 0
+    for op in ops:
+        _mutate(world, "cx", op)
+        out = live.poll()           # must never raise
+        assert len(live._inflight) <= 1
+        if out["ranked"]:
+            served += 1
+        h = out["health"]
+        assert h["pipeline_depth"] == 2
+        assert h["inflight"] == len(live._inflight)
+    assert served > 30              # chaos degraded ticks, not the stream
+    # drain at teardown: the remaining in-flight tick is fetchable
+    if live._inflight:
+        final = live._inflight[-1]
+        assert final.session.fetch(final)["ranked"]
+
+
+def test_pipeline_degradation_flushes_inflight_cleanly():
+    """A repeatedly-failing dispatch steps the ladder; the queued
+    in-flight tick from the broken engine is FLUSHED (counted in health),
+    the rebuilt session answers within the same poll chain, and rankings
+    recover."""
+    world = synthetic_cascade_world(30, n_roots=1, seed=7, namespace="dg")
+    live = LiveStreamingSession(
+        MockClusterClient(world), "dg", k=3, engine=GraphEngine(),
+        topology_check_every=100, pipeline_depth=2,
+    )
+    healthy = live.poll()           # fill tick: dispatch queued
+    assert healthy["health"]["inflight"] == 1
+
+    def boom():
+        raise RuntimeError("device dispatch failed")
+
+    live.session.dispatch = boom
+    out = live.poll()
+    assert out["degraded"] is True
+    assert live.degradation == 1
+    assert out["health"]["degradation_rung"] == "single-device"
+    assert out["health"]["pipeline_flushed"] == 1   # old in-flight dropped
+    # the rebuilt session's dispatch queued a fresh tick
+    assert out["health"]["inflight"] == 1
+    # next polls serve the rebuilt engine's (identical) rankings; the
+    # ladder is STICKY (matching the serial contract), so the tick stays
+    # flagged degraded while running on the downgraded rung
+    out2 = live.poll()
+    assert out2["health"]["degradation_rung"] == "single-device"
+    assert out2["ranked"]
+    ref = LiveStreamingSession(
+        MockClusterClient(synthetic_cascade_world(
+            30, n_roots=1, seed=7, namespace="dg")),
+        "dg", k=3, engine=GraphEngine(), topology_check_every=100,
+    ).poll()
+    assert _ranked_key(out2) == _ranked_key(ref)
+
+
+def test_pipeline_depth_env_parsing(monkeypatch):
+    from rca_tpu.config import pipeline_depth_from_env
+
+    monkeypatch.delenv("RCA_PIPELINE_DEPTH", raising=False)
+    assert pipeline_depth_from_env() == 1
+    monkeypatch.setenv("RCA_PIPELINE_DEPTH", "2")
+    assert pipeline_depth_from_env() == 2
+    world = synthetic_cascade_world(20, n_roots=1, seed=1, namespace="e")
+    live = LiveStreamingSession(
+        MockClusterClient(world), "e", k=3, engine=GraphEngine(),
+    )
+    assert live.pipeline_depth == 2
+    monkeypatch.setenv("RCA_PIPELINE_DEPTH", "0")
+    with pytest.raises(ValueError):
+        pipeline_depth_from_env()
+    monkeypatch.setenv("RCA_PIPELINE_DEPTH", "fast")
+    with pytest.raises(ValueError):
+        pipeline_depth_from_env()
+
+
+# -- incremental capture cache ----------------------------------------------
+
+def test_incremental_extractor_property_update_delete():
+    """Property: after ARBITRARY update/delete/add sequences, the
+    incremental extraction over the persistent cache equals a fresh full
+    extraction bit-for-bit (NaN rows included — poisoned telemetry must
+    flow through identically)."""
+    ns = "inc"
+    world = synthetic_cascade_world(30, n_roots=1, seed=2, namespace=ns)
+    client = MockClusterClient(world)
+    inc = IncrementalExtractor()
+    rng = np.random.default_rng(17)
+
+    def rand_pod():
+        pods = world.pods[ns]
+        return pods[int(rng.integers(0, len(pods)))]
+
+    for step in range(40):
+        roll = int(rng.integers(0, 7))
+        if roll <= 3:
+            _mutate(world, ns, ("crash", int(rng.integers(0, 10_000)),
+                                int(rng.integers(1, 9))))
+        elif roll == 4:   # delete a pod
+            pods = world.pods[ns]
+            if len(pods) > 5:
+                pod = pods.pop(int(rng.integers(0, len(pods))))
+                name = pod["metadata"]["name"]
+                world.logs[ns].pop(name, None)
+                world.pod_metrics[ns]["pods"].pop(name, None)
+                world.touch("pod", ns, name)
+        elif roll == 5:   # poison a metric channel (NaN path)
+            pod = rand_pod()
+            rec = world.pod_metrics[ns]["pods"].get(
+                pod["metadata"]["name"])
+            if rec:
+                rec["memory"]["usage_percentage"] = float("nan")
+                world.touch("pod_metrics", ns,
+                            pod["metadata"]["name"])
+        else:             # warning event lands on a pod
+            pod = rand_pod()
+            world.add("events", ns, make_event(
+                ns, "Pod", pod["metadata"]["name"], "BackOff",
+                "Back-off restarting failed container",
+                count=int(rng.integers(1, 5)),
+            ))
+        if step % 4 != 3:
+            continue
+        snap = ClusterSnapshot.capture(client, ns)
+        got = inc.extract(snap, incremental=True)
+        want = extract_features(snap)
+        assert got.service_names == want.service_names
+        assert got.pod_names == want.pod_names
+        assert np.array_equal(got.pod_features, want.pod_features,
+                              equal_nan=True)
+        assert np.array_equal(got.service_features, want.service_features,
+                              equal_nan=True)
+        assert np.array_equal(got.pod_service, want.pod_service)
+        assert np.array_equal(got.memb_pod, want.memb_pod)
+        assert np.array_equal(got.memb_svc, want.memb_svc)
+        assert np.array_equal(got.pod_node, want.pod_node)
+        if step % 8 == 3:
+            # interleave a full-mode pass (what a periodic sweep runs) —
+            # it must also match and must refresh, not poison, the cache
+            full = inc.extract(snap, incremental=False)
+            assert np.array_equal(
+                full.service_features, want.service_features,
+                equal_nan=True,
+            )
+
+
+def test_incremental_extractor_reuses_cached_rows():
+    """The cache actually caches: an unchanged capture re-derives zero
+    rows (log regex scans skipped), a one-pod change re-derives one."""
+    from rca_tpu.features import extract as ex
+
+    ns = "hot"
+    world = synthetic_cascade_world(25, n_roots=1, seed=4, namespace=ns)
+    client = MockClusterClient(world)
+    inc = IncrementalExtractor()
+    snap = ClusterSnapshot.capture(client, ns)
+    inc.extract(snap)
+
+    calls = []
+    orig = ex.scan_pod_logs
+
+    def counting(logs):
+        calls.append(1)
+        return orig(logs)
+
+    ex.scan_pod_logs = counting
+    try:
+        inc.extract(ClusterSnapshot.capture(client, ns))
+        assert not calls    # quiet capture: every row + log scan cached
+        # mutate the logs of a pod that IS inside the snapshot's log
+        # sample (capture caps healthy-pod log fetches)
+        name = sorted(snap.logs)[0]
+        app = name.rsplit("-", 1)[0]
+        world.logs[ns][name] = {app: "ERROR: fresh failure\n" * 4}
+        world.touch("logs", ns, name)
+        inc.extract(ClusterSnapshot.capture(client, ns))
+        assert len(calls) == 1   # exactly the touched pod re-scanned
+    finally:
+        ex.scan_pod_logs = orig
+
+
+def test_sharded_session_pipelined_shifted_parity():
+    """The sharded twin honors the same dispatch/fetch contract: a depth-2
+    hand-rolled pipeline over the sp-sharded session returns exactly the
+    serial tick sequence (the 50k bench dryrun runs this at scale)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from rca_tpu.engine import ShardedGraphEngine
+    from rca_tpu.parallel.streaming import ShardedStreamingSession
+
+    c = synthetic_cascade_arrays(512, n_roots=1, seed=6)
+    names = [f"s{i}" for i in range(c.n)]
+
+    def session():
+        s = ShardedStreamingSession(
+            names, c.dep_src, c.dep_dst, c.features.shape[1],
+            engine=ShardedGraphEngine(spec="sp=8"), k=4,
+        )
+        s.set_all(c.features)
+        s.tick()
+        return s
+
+    rng = np.random.default_rng(8)
+    deltas = [{
+        int(i): np.clip(
+            c.features[i] + rng.uniform(0, 0.4, c.features.shape[1]), 0, 1
+        ).astype(np.float32)
+        for i in rng.integers(0, c.n, 5)
+    } for _ in range(4)]
+
+    serial = session()
+    serial_seq = []
+    for rows in deltas:
+        serial.update_many(rows)
+        serial_seq.append(_ranked_key(serial.tick()))
+
+    piped = session()
+    piped_seq = []
+    prev = None
+    for rows in deltas:
+        piped.update_many(rows)
+        h = piped.dispatch()
+        if prev is not None:
+            piped_seq.append(_ranked_key(piped.fetch(prev)))
+        prev = h
+    piped_seq.append(_ranked_key(piped.fetch(prev)))
+    assert piped_seq == serial_seq
+
+
+# -- lint gate ---------------------------------------------------------------
+
+def test_tick_sync_lint_is_clean():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "lint_tick_sync.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- satellites: compile cache + autotune ------------------------------------
+
+def test_compile_cache_status_flags(tmp_path, monkeypatch):
+    import jax
+
+    from rca_tpu import config as cfg
+
+    monkeypatch.setattr(cfg, "_COMPILE_CACHE", None)
+    monkeypatch.delenv("RCA_COMPILE_CACHE", raising=False)
+    assert cfg.enable_compile_cache() == {"enabled": False}
+
+    cache_dir = str(tmp_path / "xla-cache")
+    monkeypatch.setattr(cfg, "_COMPILE_CACHE", None)
+    monkeypatch.setenv("RCA_COMPILE_CACHE", cache_dir)
+    try:
+        status = cfg.enable_compile_cache()
+        if status.get("enabled"):
+            assert status["dir"] == cache_dir
+            assert status["entries"] == 0
+            assert jax.config.jax_compilation_cache_dir == cache_dir
+        else:
+            # a jax build without the knob records WHY instead of crashing
+            assert "error" in status or status == {"enabled": False}
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.setattr(cfg, "_COMPILE_CACHE", None)
+
+
+def test_noisyor_autotune_cpu_picks_xla(monkeypatch):
+    from rca_tpu.engine import pallas_kernels as pk
+
+    try:
+        monkeypatch.setenv("RCA_PALLAS", "0")
+        assert pk.noisyor_autotune(refresh=True) == "xla"
+        monkeypatch.delenv("RCA_PALLAS")
+        # CPU backend short-circuits to XLA without timing an interpreter
+        assert pk.noisyor_autotune(refresh=True) == "xla"
+        assert pk.noisyor_path() == "xla"
+    finally:
+        monkeypatch.undo()
+        pk.noisyor_autotune(refresh=True)
