@@ -11,6 +11,8 @@
 - ``train``     fit propagation weights; save an orbax checkpoint
 - ``stream``    poll-driven live streaming analysis (1 Hz loop)
 - ``chaos``     seeded fault-injection soak over a synthetic world
+- ``serve``     multi-tenant serving scheduler (continuous shape-bucketed
+                batching; ``--selftest`` asserts the serving contract)
 - ``investigations``  list / show persisted investigations
 - ``ui``        launch the Streamlit app (when streamlit is installed)
 
@@ -397,6 +399,86 @@ def cmd_chaos(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_serve(args) -> int:
+    """Multi-tenant serving scheduler (SERVING.md).  ``--selftest`` runs
+    the end-to-end contract check (mixed-tenant requests over several
+    shape buckets, concurrent submitters, deadline sheds, coalesced-vs-
+    solo bit parity; ``--chaos`` adds seeded dispatch/fetch faults) and
+    exits 0 only when the contract holds.  Without ``--selftest`` it runs
+    a synthetic load demo over a ``<N>svc`` fixture graph and prints the
+    per-tenant metrics summary."""
+    import time as _time
+
+    import numpy as np
+
+    from rca_tpu.config import ServeConfig
+
+    overrides = {
+        k: v for k, v in (
+            ("max_batch", args.max_batch),
+            ("max_wait_us", args.max_wait_us),
+            ("queue_cap", args.queue_cap),
+        ) if v is not None
+    }
+    config = ServeConfig.from_env(**overrides)
+    if args.selftest:
+        from rca_tpu.serve import serve_selftest
+
+        summary = serve_selftest(
+            n_requests=args.requests, seed=args.seed, chaos=args.chaos,
+            config=config, submitters=args.submitters,
+        )
+        print(json.dumps(summary, indent=None if args.compact else 2,
+                         default=str))
+        return 0 if summary["ok"] else 1
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine import make_engine
+    from rca_tpu.serve import ServeClient, ServeLoop
+
+    m = re.fullmatch(r"(\d+)svc", args.fixture or "500svc")
+    if not m:
+        raise SystemExit(
+            f"serve needs a synthetic fixture (<N>svc), got {args.fixture!r}"
+        )
+    case = synthetic_cascade_arrays(
+        int(m.group(1)), n_roots=1, seed=args.seed
+    )
+    rng = np.random.default_rng(args.seed)
+    loop = ServeLoop(engine=make_engine(), config=config)
+    tenants = [f"tenant-{i}" for i in range(args.tenants)]
+    t0 = _time.perf_counter()
+    with loop:
+        client = ServeClient(loop)
+        reqs = [
+            client.submit(
+                np.clip(case.features + rng.uniform(
+                    0, 0.05, case.features.shape
+                ).astype(np.float32), 0, 1),
+                case.dep_src, case.dep_dst, names=case.names,
+                tenant=tenants[i % len(tenants)], k=args.top,
+            )
+            for i in range(args.requests)
+        ]
+        responses = [r.result(timeout=300.0) for r in reqs]
+    wall_s = _time.perf_counter() - t0
+    by_status = {}
+    for resp in responses:
+        by_status[resp.status] = by_status.get(resp.status, 0) + 1
+    print(json.dumps({
+        "requests": args.requests,
+        "tenants": len(tenants),
+        "by_status": by_status,
+        "wall_s": round(wall_s, 3),
+        "analyses_per_sec": round(
+            by_status.get("ok", 0) / max(wall_s, 1e-9), 1
+        ),
+        "device_batches": loop.device_batches,
+        "metrics": loop.metrics.summary(),
+    }, indent=None if args.compact else 2, default=str))
+    return 0 if by_status.get("ok", 0) == args.requests else 1
+
+
 def cmd_investigations(args) -> int:
     from rca_tpu.store import InvestigationStore
 
@@ -563,6 +645,37 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="topology_check_every")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser(
+        "serve",
+        help="multi-tenant serving scheduler: continuous shape-bucketed "
+        "batching of concurrent analyze requests (SERVING.md)",
+    )
+    sp.add_argument("--selftest", action="store_true",
+                    help="run the serving-contract selftest (all requests "
+                    "answered or shed, coalesced-vs-solo bit parity); "
+                    "exit 0 only when the contract holds")
+    sp.add_argument("--chaos", action="store_true",
+                    help="selftest with seeded dispatch/fetch fault "
+                    "injection (breaker + degraded path)")
+    sp.add_argument("--requests", type=int, default=32)
+    sp.add_argument("--submitters", type=int, default=4,
+                    help="concurrent submitter threads (selftest)")
+    sp.add_argument("--tenants", type=int, default=4,
+                    help="logical tenants (load demo)")
+    sp.add_argument("--fixture", default="500svc",
+                    help="<N>svc synthetic graph (load demo)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--top", type=int, default=5)
+    sp.add_argument("--max-batch", type=int, default=None, dest="max_batch",
+                    help="override RCA_SERVE_MAX_BATCH")
+    sp.add_argument("--max-wait-us", type=int, default=None,
+                    dest="max_wait_us",
+                    help="override RCA_SERVE_MAX_WAIT_US")
+    sp.add_argument("--queue-cap", type=int, default=None, dest="queue_cap",
+                    help="override RCA_SERVE_QUEUE_CAP")
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("investigations", help="list/show investigations")
     sp.add_argument("--id", default=None)

@@ -91,6 +91,82 @@ def pipeline_depth_from_env(default: int = 1) -> int:
     return depth
 
 
+# -- serving scheduler (ISSUE 3) --------------------------------------------
+# env knobs, each a validated int with the documented range:
+#
+#   RCA_SERVE_MAX_BATCH   [1, 4096]          requests coalesced per device
+#                                            dispatch (a full batch never
+#                                            waits; default 16)
+#   RCA_SERVE_MAX_WAIT_US [0, 60_000_000]    longest a request is held
+#                                            waiting for batchmates while
+#                                            the device is busy (µs;
+#                                            default 2000 — an idle engine
+#                                            never waits, see SERVING.md)
+#   RCA_SERVE_QUEUE_CAP   [1, 1_000_000]     admission cap: a submit
+#                                            against a full queue is
+#                                            rejected (`queue_full`), the
+#                                            queue never grows unboundedly
+#                                            (default 256)
+
+_SERVE_ENV_RANGES = {
+    "RCA_SERVE_MAX_BATCH": (1, 4096),
+    "RCA_SERVE_MAX_WAIT_US": (0, 60_000_000),
+    "RCA_SERVE_QUEUE_CAP": (1, 1_000_000),
+}
+
+
+def _serve_env_int(name: str, default: int) -> int:
+    """One ``RCA_SERVE_*`` env var as a range-checked int; empty/unset
+    means the default.  Malformed or out-of-range values fail loudly —
+    a typo'd serving knob silently falling back would fake away the
+    batching (or the backpressure) the operator asked for."""
+    lo, hi = _SERVE_ENV_RANGES[name]
+    raw = (os.environ.get(name) or "").strip()
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer in [{lo}, {hi}]")
+    if not lo <= value <= hi:
+        raise ValueError(f"{name}={value}: out of range [{lo}, {hi}]")
+    return value
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Typed serving-scheduler knobs (rca_tpu/serve, SERVING.md)."""
+
+    max_batch: int = 16      # RCA_SERVE_MAX_BATCH
+    max_wait_us: int = 2000  # RCA_SERVE_MAX_WAIT_US
+    queue_cap: int = 256     # RCA_SERVE_QUEUE_CAP
+
+    def __post_init__(self):
+        # same ranges as the env parse, so a directly-constructed config
+        # cannot smuggle in a value the env path would reject
+        for name, value in (
+            ("RCA_SERVE_MAX_BATCH", self.max_batch),
+            ("RCA_SERVE_MAX_WAIT_US", self.max_wait_us),
+            ("RCA_SERVE_QUEUE_CAP", self.queue_cap),
+        ):
+            lo, hi = _SERVE_ENV_RANGES[name]
+            if not lo <= int(value) <= hi:
+                raise ValueError(
+                    f"{name.lower().removeprefix('rca_serve_')}={value}: "
+                    f"out of range [{lo}, {hi}]"
+                )
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        env = {
+            "max_batch": _serve_env_int("RCA_SERVE_MAX_BATCH", 16),
+            "max_wait_us": _serve_env_int("RCA_SERVE_MAX_WAIT_US", 2000),
+            "queue_cap": _serve_env_int("RCA_SERVE_QUEUE_CAP", 256),
+        }
+        env.update(overrides)
+        return cls(**env)
+
+
 # -- persistent compilation cache (ISSUE 2 satellite) -----------------------
 # enabled at most once per process; the dict is the recorded status the
 # session health records and bench line carry

@@ -51,11 +51,30 @@ class RCACoordinator:
         backend: Optional[str] = None,
         use_llm_agents: bool = False,
         engine=None,
+        serve=None,
+        tenant: Optional[str] = None,
     ):
         self.cluster = cluster_client
         self.llm = llm_client or LLMClient(provider=OfflineProvider())
         self.evidence = evidence_logger
         self.backend = backend or default_backend()
+        # ``serve``: a rca_tpu.serve.ServeClient (or a ServeLoop) — the
+        # correlation analyses then ride the shared multi-tenant serving
+        # queue instead of owning the device exclusively, so concurrent
+        # coordinators coalesce into batched dispatches (SERVING.md).
+        # Mutually exclusive with a directly-pinned ``engine``.
+        if serve is not None:
+            if engine is not None:
+                raise ValueError("pass either engine= or serve=, not both")
+            from rca_tpu.serve.client import ServeClient
+            from rca_tpu.serve.loop import ServeLoop
+
+            if isinstance(serve, ServeLoop):
+                serve = ServeClient(serve)
+            engine = serve.as_engine(
+                tenant=tenant or f"coordinator-{uuid.uuid4().hex[:6]}"
+            )
+        self.serve = serve
         self.engine = engine
         self.use_llm_agents = use_llm_agents
         self.agents = make_agents()

@@ -98,6 +98,27 @@ def up_ell_for(n_pad: int, dep_src, dep_dst):
     return build_up_ell(n_pad, dep_src, dep_dst)
 
 
+def coo_layouts_for(n_pad: int, e_pad: int, dep_src, dep_dst):
+    """Layout selection for the COO-family executables, shared by every
+    caller that stages a padded graph (one-shot analyze, hypothesis batch,
+    streaming session, serving dispatcher): segscan upgrades only the
+    hybrid DEFAULT (an explicit ``RCA_EDGE_LAYOUT=coo`` stays pure COO —
+    the documented A/B knob for the PERF.md layout study), and the hybrid
+    up-table fills in when segscan declines the tier.  One definition so a
+    layout-gating change cannot land in one caller and silently break the
+    cross-path score parity.  Returns ``(down_seg, up_seg, up_ell)``."""
+    from rca_tpu.engine.segscan import seg_layouts_for
+
+    down_seg, up_seg = (
+        seg_layouts_for(n_pad, e_pad, dep_src, dep_dst)
+        if edge_layout() == "hybrid" else (None, None)
+    )
+    up_ell = (
+        None if up_seg is not None else up_ell_for(n_pad, dep_src, dep_dst)
+    )
+    return down_seg, up_seg, up_ell
+
+
 def edge_layout() -> str:
     """Edge layout for the propagation scans, ``RCA_EDGE_LAYOUT``:
 
@@ -488,21 +509,8 @@ class GraphEngine(EngineAPI):
                 )
         else:
             ej = jnp.asarray(np.stack([s, d]))  # one [2, E] upload
-            from rca_tpu.engine.segscan import seg_layouts_for
-
-            # segscan upgrades only the DEFAULT layout: an explicit
-            # RCA_EDGE_LAYOUT=coo stays pure COO (it is the documented
-            # A/B knob for the PERF.md layout study)
-            down_seg, up_seg = (
-                seg_layouts_for(f.shape[0], len(s), dep_src, dep_dst)
-                if layout == "hybrid" else (None, None)
-            )
-            # ...and replaces the hybrid up-table when engaged (one
-            # E-gather per step beats the [S, 8] table's gathers 2.5x at
-            # 50k; see PERF.md round-4 segscan study)
-            up_ell = (
-                None if up_seg is not None
-                else up_ell_for(f.shape[0], dep_src, dep_dst)
+            down_seg, up_seg, up_ell = coo_layouts_for(
+                f.shape[0], len(s), dep_src, dep_dst
             )
             from rca_tpu.engine.pallas_kernels import (
                 BLOCK_S,
@@ -556,17 +564,9 @@ class GraphEngine(EngineAPI):
         fb = np.zeros((B, *f0.shape), np.float32)
         fb[:, :n] = features_batch
         ej = jnp.asarray(np.stack([s, d]))
-        from rca_tpu.engine.segscan import seg_layouts_for
-
-        # same layout selection as analyze_arrays (segscan upgrades only
-        # the hybrid default; up_ell_for is None for non-hybrid layouts)
-        down_seg, up_seg = (
-            seg_layouts_for(f0.shape[0], len(s), dep_src, dep_dst)
-            if edge_layout() == "hybrid" else (None, None)
-        )
-        up_ell = (
-            None if up_seg is not None
-            else up_ell_for(f0.shape[0], dep_src, dep_dst)
+        # same layout selection as analyze_arrays
+        down_seg, up_seg, up_ell = coo_layouts_for(
+            f0.shape[0], len(s), dep_src, dep_dst
         )
         p = self.params
         kk = min(k + 8, f0.shape[0])

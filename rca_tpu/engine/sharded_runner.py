@@ -207,7 +207,7 @@ class ShardedGraphEngine(EngineAPI):
         are dropped from the result."""
         import time as _time
 
-        from rca_tpu.parallel.sharded import sharded_topk, stage_sharded
+        from rca_tpu.parallel.sharded import stage_batch_ranked
 
         B, n = features_batch.shape[0], features_batch.shape[1]
         k = k or min(self.config.top_k_root_causes, n)
@@ -219,8 +219,9 @@ class ShardedGraphEngine(EngineAPI):
         fb[:B, :n] = features_batch
         kk = min(k + 8, graph.n_pad)
         t0 = _time.perf_counter()
-        stack = stage_sharded(self.mesh, fb, graph, self.params)()
-        vals, idx = sharded_topk(self.mesh, stack[:, 3], kk)
+        stack, vals, idx = stage_batch_ranked(
+            self.mesh, fb, graph, self.params, kk
+        )
         stack, vals, idx = jax.device_get((stack, vals, idx))
         latency_ms = (_time.perf_counter() - t0) * 1e3
         return [

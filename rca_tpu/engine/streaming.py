@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rca_tpu.config import RCAConfig, bucket_for
-from rca_tpu.engine.runner import GraphEngine, _propagate_ranked, up_ell_for
+from rca_tpu.engine.runner import GraphEngine, _propagate_ranked
 
 
 @functools.partial(
@@ -293,16 +293,10 @@ class StreamingSession(StreamingHostState):
         # segscan layouts at large tiers (same gate as the one-shot
         # engine: hybrid default only; replaces the hybrid up-table when
         # engaged), built once for the session's pinned edges
-        from rca_tpu.engine.runner import edge_layout
-        from rca_tpu.engine.segscan import seg_layouts_for
+        from rca_tpu.engine.runner import coo_layouts_for
 
-        self._down_seg, self._up_seg = (
-            seg_layouts_for(self._n_pad, e_pad, dep_src, dep_dst)
-            if edge_layout() == "hybrid" else (None, None)
-        )
-        self._up_ell = (
-            None if self._up_seg is not None
-            else up_ell_for(self._n_pad, dep_src, dep_dst)
+        self._down_seg, self._up_seg, self._up_ell = coo_layouts_for(
+            self._n_pad, e_pad, dep_src, dep_dst
         )
         self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
         self._kk = min(k + 8, self._n_pad)

@@ -83,6 +83,21 @@ class PhaseStats:
             }
         return out
 
+    def quantile(self, phase: str, q: float) -> Optional[float]:
+        """Nearest-rank quantile of one phase's samples (q in [0, 1]);
+        None when the phase has no samples.  The serving scheduler's
+        per-tenant queue-time p50/p99 ride this (rca_tpu/serve/metrics.py)
+        — same robustness rationale as summary()'s median/p90."""
+        xs = self._samples.get(phase)
+        if not xs:
+            return None
+        s = sorted(xs)
+        i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return round(s[i], 3)
+
+    def count(self, phase: str) -> int:
+        return len(self._samples.get(phase, []))
+
 
 @contextlib.contextmanager
 def maybe_jax_profile(tag: str):

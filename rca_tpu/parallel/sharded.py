@@ -477,3 +477,23 @@ def sharded_propagate(
     return sharded_propagate_full(
         mesh, features_batch, graph, params, batch_axes
     )[:, 3]
+
+
+def stage_batch_ranked(
+    mesh: Mesh,
+    features_batch: np.ndarray,  # [B, n_pad, C] hypothesis batch, same graph
+    graph: ShardedGraph,
+    params: PropagationParams,
+    kk: int,
+    batch_axes: Tuple[str, ...] = ("dp",),
+):
+    """Enqueue the sharded hypothesis batch AND its cross-shard top-k
+    merge, returning ``(stack, vals, idx)`` as in-flight DEVICE values —
+    this function never synchronizes (JAX dispatch is async), so a caller
+    can overlap host work with the mesh execution and fetch later.  The
+    engine's ``analyze_batch`` fetches immediately; the serving
+    dispatcher (rca_tpu/serve) parks the values in a batch handle and
+    fetches one batch behind."""
+    stack = stage_sharded(mesh, features_batch, graph, params, batch_axes)()
+    vals, idx = sharded_topk(mesh, stack[:, 3], kk, batch_axes)
+    return stack, vals, idx

@@ -170,6 +170,29 @@ class ChaosClusterClient:
         return self.inner.watch_changes(namespace, cursor)
 
 
+def seeded_fault_hook(
+    seed: int,
+    rate: float = 0.1,
+    ops: Optional[List[str]] = None,
+) -> Callable[[str], None]:
+    """Seeded fault injector for the serving dispatcher (rca_tpu/serve):
+    called with the op name (``"dispatch"`` / ``"fetch"``) before the
+    device work; raises :class:`InjectedTimeout` at ``rate`` per call
+    from one seeded stream, so a (seed, call-sequence) pair replays the
+    exact same fault schedule — the serve soak's analogue of
+    :class:`ChaosClusterClient`.  ``ops`` restricts injection to those
+    call sites (default: all)."""
+    rng = random.Random(seed)
+
+    def hook(op: str) -> None:
+        if ops is not None and op not in ops:
+            return
+        if rng.random() < rate:
+            raise InjectedTimeout(f"chaos: injected fault in serve {op}")
+
+    return hook
+
+
 # ---------------------------------------------------------------------------
 # Chaos soak harness (CLI `rca chaos`, bench --chaos, tests)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,55 @@
+"""Multi-tenant serving scheduler: continuous shape-bucketed batching.
+
+Every pre-serve entry point (``rca analyze``, ``rca hypotheses``,
+``rca stream``) owns the device exclusively — two concurrent
+investigations serialize with zero batching, even though the engine's
+``analyze_batch`` scores many hypotheses in one dispatch at near-zero
+marginal cost per extra lane.  This package is the missing serving
+layer (SERVING.md):
+
+- :mod:`rca_tpu.serve.request` — the request/response contract;
+- :mod:`rca_tpu.serve.queue` — bounded admission + per-tenant weighted
+  fair queuing + priorities + deadline shedding;
+- :mod:`rca_tpu.serve.batcher` — shape-bucket grouping with the
+  max-batch / max-wait flush policy;
+- :mod:`rca_tpu.serve.dispatcher` — the coalesced device dispatch
+  (dispatch/fetch split; fetch is THE sync point, lint-enforced);
+- :mod:`rca_tpu.serve.loop` — the continuous-batching worker with
+  breaker-gated degradation;
+- :mod:`rca_tpu.serve.client` — in-process client, the coordinator's
+  EngineAPI facade, and the ``rca serve --selftest`` harness;
+- :mod:`rca_tpu.serve.metrics` — per-tenant queue/occupancy metrics.
+"""
+
+from rca_tpu.serve.batcher import ShapeBucketBatcher
+from rca_tpu.serve.client import ServeClient, ServeEngineAdapter, serve_selftest
+from rca_tpu.serve.dispatcher import BatchDispatcher, BatchHandle
+from rca_tpu.serve.loop import ServeLoop
+from rca_tpu.serve.metrics import ServeMetrics
+from rca_tpu.serve.queue import RequestQueue
+from rca_tpu.serve.request import (
+    PRIORITY_BATCH,
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    ServeRequest,
+    ServeResponse,
+    graph_key,
+)
+
+__all__ = [
+    "ShapeBucketBatcher",
+    "ServeClient",
+    "ServeEngineAdapter",
+    "serve_selftest",
+    "BatchDispatcher",
+    "BatchHandle",
+    "ServeLoop",
+    "ServeMetrics",
+    "RequestQueue",
+    "ServeRequest",
+    "ServeResponse",
+    "graph_key",
+    "PRIORITY_HIGH",
+    "PRIORITY_NORMAL",
+    "PRIORITY_BATCH",
+]

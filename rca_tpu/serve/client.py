@@ -1,0 +1,279 @@
+"""In-process serving client + the EngineAPI adapter + the selftest.
+
+:class:`ServeClient` is how code inside the process talks to a
+:class:`rca_tpu.serve.loop.ServeLoop`: submit returns the request (a
+future — ``req.result(timeout)`` parks the caller), ``analyze`` is the
+blocking convenience, ``submit_many`` fans a hypothesis sweep into
+requests that naturally coalesce into one batch (same graph → same
+bucket).
+
+:meth:`ServeClient.as_engine` returns an :class:`rca_tpu.engine.runner.
+EngineAPI` facade, which is how the coordinator uses the scheduler: a
+``RCACoordinator(serve=client)`` routes its correlation analyses through
+the shared serving queue instead of owning the device exclusively — two
+concurrent investigations batch instead of serializing.
+
+:func:`serve_selftest` is the end-to-end smoke behind
+``rca serve --selftest`` (and the tier-1 suite): mixed-tenant requests
+over several shape buckets, concurrent submitters, optional chaos, and a
+bit-parity check of coalesced vs. solo rankings.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from rca_tpu.engine.runner import EngineAPI, EngineResult
+from rca_tpu.serve.loop import ServeLoop
+from rca_tpu.serve.request import PRIORITY_NORMAL, ServeRequest, ServeResponse
+
+DEFAULT_TIMEOUT_S = 60.0
+
+
+class ServeClient:
+    """Thin submission surface over one (started) ServeLoop."""
+
+    def __init__(self, loop: Optional[ServeLoop] = None, **loop_kwargs):
+        self._own = loop is None
+        self.loop = loop if loop is not None else ServeLoop(**loop_kwargs)
+        if self._own:
+            self.loop.start()
+
+    def close(self) -> None:
+        if self._own:
+            self.loop.stop()
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- submission ----------------------------------------------------------
+    def submit(
+        self,
+        features: np.ndarray,
+        dep_src: np.ndarray,
+        dep_dst: np.ndarray,
+        names: Optional[Sequence[str]] = None,
+        tenant: str = "default",
+        k: int = 5,
+        priority: int = PRIORITY_NORMAL,
+        deadline_ms: Optional[float] = None,
+        investigation_id: Optional[str] = None,
+    ) -> ServeRequest:
+        """Queue one analyze request; returns immediately with the
+        request future (``queue_full``/``shed`` outcomes are already
+        completed on it)."""
+        deadline_s = (
+            self.loop.clock() + deadline_ms / 1e3
+            if deadline_ms is not None else None
+        )
+        req = ServeRequest(
+            tenant=tenant, features=features, dep_src=dep_src,
+            dep_dst=dep_dst, names=names, k=k, priority=priority,
+            deadline_s=deadline_s, investigation_id=investigation_id,
+        )
+        self.loop.submit(req)
+        return req
+
+    def submit_many(
+        self, features_batch: Sequence[np.ndarray], dep_src, dep_dst,
+        **kwargs,
+    ) -> List[ServeRequest]:
+        """A hypothesis sweep as individual requests — same graph, so
+        they coalesce into the same shape bucket and (queue permitting)
+        the same device dispatch."""
+        return [
+            self.submit(f, dep_src, dep_dst, **kwargs)
+            for f in features_batch
+        ]
+
+    def analyze(
+        self, features, dep_src, dep_dst,
+        timeout_s: float = DEFAULT_TIMEOUT_S, **kwargs,
+    ) -> ServeResponse:
+        """Blocking submit: one request through the shared queue."""
+        return self.submit(
+            features, dep_src, dep_dst, **kwargs
+        ).result(timeout_s)
+
+    # -- coordinator facade --------------------------------------------------
+    def as_engine(
+        self,
+        tenant: str = "coordinator",
+        deadline_ms: Optional[float] = None,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+    ) -> "ServeEngineAdapter":
+        return ServeEngineAdapter(
+            self, tenant=tenant, deadline_ms=deadline_ms,
+            timeout_s=timeout_s,
+        )
+
+
+class ServeEngineAdapter(EngineAPI):
+    """EngineAPI facade over the serving queue: any caller written
+    against the analyze boundary (the coordinator's correlate step, the
+    CLI) runs through the shared scheduler unchanged, coalescing with
+    whatever else is in flight."""
+
+    def __init__(self, client: ServeClient, tenant: str,
+                 deadline_ms: Optional[float], timeout_s: float):
+        self.client = client
+        self.tenant = tenant
+        self.deadline_ms = deadline_ms
+        self.timeout_s = timeout_s
+
+    def analyze_arrays(self, features, dep_src, dep_dst, names=None,
+                       k=None, timed=False) -> EngineResult:
+        resp = self.client.analyze(
+            features, dep_src, dep_dst, names=names, k=k or 5,
+            tenant=self.tenant, deadline_ms=self.deadline_ms,
+            timeout_s=self.timeout_s,
+        )
+        if resp.result is None:
+            raise RuntimeError(
+                f"serve: {resp.status}"
+                + (f" ({resp.detail})" if resp.detail else "")
+            )
+        return resp.result
+
+
+# ---------------------------------------------------------------------------
+# Selftest (CLI `rca serve --selftest`, tier-1 smoke)
+# ---------------------------------------------------------------------------
+
+
+def serve_selftest(
+    n_requests: int = 32,
+    seed: int = 0,
+    engine=None,
+    chaos: bool = False,
+    chaos_rate: float = 0.15,
+    deadline_ms: float = 30_000.0,
+    config=None,
+    submitters: int = 4,
+    timeout_s: float = 300.0,
+) -> Dict[str, object]:
+    """End-to-end scheduler smoke: ``n_requests`` mixed-tenant requests
+    over three shape buckets, submitted from ``submitters`` concurrent
+    threads with mixed priorities, a couple of them with already-expired
+    deadlines (the shed contract must fire).  Asserts — and reports —
+    that every request resolved (answered or shed), and that every ``ok``
+    ranking is bit-identical to a solo analysis of the same request
+    (the batching-parity contract), then returns the summary the CLI
+    prints.  ``chaos`` wires a seeded fault hook into the dispatcher to
+    exercise the breaker + degraded path (parity is then checked on the
+    ok responses only — degraded ones are stale by contract)."""
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.config import ServeConfig
+    from rca_tpu.engine.runner import GraphEngine
+
+    engine = engine or GraphEngine()
+    fault_hook = None
+    if chaos:
+        from rca_tpu.resilience.chaos import seeded_fault_hook
+
+        fault_hook = seeded_fault_hook(seed, rate=chaos_rate)
+    cases = [
+        synthetic_cascade_arrays(n, n_roots=1, seed=seed + i)
+        for i, n in enumerate((48, 120, 260))
+    ]
+    tenants = [f"tenant-{c}" for c in "abcd"]
+    rng = np.random.default_rng(seed)
+    loop = ServeLoop(
+        engine=engine, config=config or ServeConfig.from_env(),
+        fault_hook=fault_hook,
+    )
+    loop.queue.set_weight(tenants[0], 2.0)  # one heavy tenant
+    specs = []
+    for i in range(n_requests):
+        case = cases[i % len(cases)]
+        feats = np.clip(
+            case.features
+            + rng.uniform(0, 0.05, case.features.shape).astype(np.float32),
+            0, 1,
+        )
+        specs.append({
+            "case": case,
+            "features": feats,
+            "tenant": tenants[i % len(tenants)],
+            "priority": 0 if i % 7 == 0 else 1,
+            # a few requests arrive already expired: the shed contract
+            # (no device slot, `shed` response) must fire
+            "deadline_ms": -1.0 if i % 11 == 10 else deadline_ms,
+        })
+    requests: List[Optional[ServeRequest]] = [None] * n_requests
+    with loop:
+        client = ServeClient(loop)
+
+        def submitter(worker: int) -> None:
+            for i in range(worker, n_requests, submitters):
+                s = specs[i]
+                requests[i] = client.submit(
+                    s["features"], s["case"].dep_src, s["case"].dep_dst,
+                    names=s["case"].names, tenant=s["tenant"], k=3,
+                    priority=s["priority"], deadline_ms=s["deadline_ms"],
+                )
+
+        threads = [
+            threading.Thread(target=submitter, args=(w,))
+            for w in range(submitters)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        responses = [r.result(timeout_s) for r in requests]  # type: ignore
+
+    by_status: Dict[str, int] = {}
+    for resp in responses:
+        by_status[resp.status] = by_status.get(resp.status, 0) + 1
+    # parity: every ok ranking must equal the solo analysis bit-for-bit
+    parity_checked = 0
+    parity_ok = True
+    for spec, resp in zip(specs, responses):
+        if not resp.ok:
+            continue
+        solo = engine.analyze_arrays(
+            spec["features"], spec["case"].dep_src, spec["case"].dep_dst,
+            spec["case"].names, k=3,
+        )
+        parity_checked += 1
+        if solo.ranked != resp.ranked or not np.array_equal(
+            solo.score, resp.result.score
+        ):
+            parity_ok = False
+    expected_shed = sum(1 for s in specs if s["deadline_ms"] < 0)
+    all_resolved = all(r.done() for r in requests)  # type: ignore
+    summary = loop.metrics.summary()
+    ok = (
+        all_resolved
+        and parity_ok
+        and by_status.get("shed", 0) >= expected_shed
+        # without chaos the device path must be clean: no errors, every
+        # non-shed request served ok.  Under chaos, degraded/error are
+        # legitimate contract outcomes (RESILIENCE.md) — the assertions
+        # that matter are resolution + parity of the ok responses.
+        and (chaos or (
+            by_status.get("error", 0) == 0
+            and by_status.get("ok", 0)
+            == n_requests - by_status.get("shed", 0)
+        ))
+    )
+    return {
+        "ok": bool(ok),
+        "requests": n_requests,
+        "chaos": bool(chaos),
+        "by_status": by_status,
+        "expected_shed_min": expected_shed,
+        "all_resolved": bool(all_resolved),
+        "parity_checked": parity_checked,
+        "parity_ok": bool(parity_ok),
+        "device_batches": loop.device_batches,
+        "breaker_state": loop.breaker.state,
+        "metrics": summary,
+    }

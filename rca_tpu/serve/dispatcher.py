@@ -1,0 +1,244 @@
+"""Batched device dispatch for the serving scheduler (dispatch/fetch split).
+
+One :class:`BatchDispatcher` turns a batcher flush — requests sharing one
+:func:`rca_tpu.serve.request.graph_key` — into a single device dispatch of
+the engine's batched executable, and renders per-request
+:class:`rca_tpu.engine.runner.EngineResult` objects at fetch time.
+
+The split mirrors the PR-2 streaming tick pipeline: :meth:`dispatch`
+packs, pads, and ENQUEUES (JAX dispatch is async — it returns in
+microseconds with a :class:`BatchHandle` over the in-flight device
+values), and :meth:`fetch` is THE designated sync point of the whole
+serve path (enforced by tools/lint_tick_sync.py) — the serve loop
+dispatches batch N, assembles batch N+1 from the queue, and only then
+fetches batch N, hiding the device round trip behind host scheduling
+work.
+
+Parity contract: a request served at any batch width is bit-identical to
+the same request served alone, because every width runs the SAME
+batched executable (``_propagate_ranked_batch`` — a vmap of the same
+``propagate`` the one-shot path runs) over the same padded graph; batch
+width is padded to a power of two so the executable count stays bounded
+per shape bucket (pad lanes are zero hypotheses dropped at render).
+Sharded engines ride :func:`rca_tpu.parallel.sharded.stage_batch_ranked`
+with the batch padded to the mesh's dp multiple instead.
+
+Per-graph staging state (padded edges on device, segscan/up-table
+layouts, live-count scalar) is prepared once and LRU-cached, so a hot
+tenant's steady-state dispatch cost is the feature stack upload plus the
+enqueue.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from rca_tpu.config import bucket_for
+from rca_tpu.serve.request import GraphKey, K_CAP, ServeRequest
+
+#: prepared graphs kept hot (beyond this, least-recently-served evicts)
+GRAPH_CACHE_CAP = 32
+
+
+@dataclasses.dataclass
+class _PreparedGraph:
+    """Per-graph staging state shared by every dispatch over that graph."""
+
+    n: int
+    n_pad: int
+    n_edges: int
+    edges_j: object = None        # [2, e_pad] device buffer (dense engine)
+    down_seg: object = None
+    up_seg: object = None
+    up_ell: object = None
+    n_live: object = None
+    sharded_graph: object = None  # ShardedGraph (sharded engine)
+    kk: int = 0
+
+
+@dataclasses.dataclass
+class BatchHandle:
+    """One in-flight coalesced batch: the device values the async
+    dispatch left behind plus what fetch needs to render each lane."""
+
+    requests: List[ServeRequest]
+    stacked: object               # [b_pad, 4, n_pad] device values
+    vals: object                  # [b_pad, kk]
+    idx: object                   # [b_pad, kk]
+    n_bad: object                 # sanitized-row count (device or host int)
+    n: int                        # real (unpadded) service count
+    engine_tag: str
+    dispatch_ms: float
+    dispatched_at: float          # scheduler-clock stamp at dispatch
+
+
+class BatchDispatcher:
+    """Coalesced analyze dispatch over one engine (dense or sharded)."""
+
+    def __init__(
+        self,
+        engine=None,
+        fault_hook: Optional[Callable[[str], None]] = None,
+        cache_cap: int = GRAPH_CACHE_CAP,
+    ):
+        from rca_tpu.engine.runner import GraphEngine
+
+        self.engine = engine if engine is not None else GraphEngine()
+        # chaos surface (tests / `rca serve --selftest --chaos`): called
+        # with "dispatch"/"fetch" before the device work; a raise here
+        # exercises the serve loop's breaker + degraded-response path
+        self.fault_hook = fault_hook
+        self._cache_cap = max(1, int(cache_cap))
+        self._graphs: "collections.OrderedDict[GraphKey, _PreparedGraph]" = (
+            collections.OrderedDict()
+        )
+        from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+        self._sharded = isinstance(self.engine, ShardedGraphEngine)
+        self.engine_tag = (
+            f"serve+{self.engine.engine_tag}" if self._sharded
+            else "serve+single"
+        )
+
+    # -- per-graph staging ---------------------------------------------------
+    def _prepared(self, req: ServeRequest) -> _PreparedGraph:
+        key = req.graph_key
+        gs = self._graphs.get(key)
+        if gs is not None:
+            self._graphs.move_to_end(key)
+            return gs
+        n = req.features.shape[0]
+        if self._sharded:
+            graph = self.engine._shard(n, req.dep_src, req.dep_dst)
+            gs = _PreparedGraph(
+                n=n, n_pad=graph.n_pad, n_edges=len(req.dep_src),
+                sharded_graph=graph,
+                kk=min(K_CAP + 8, graph.n_pad),
+            )
+        else:
+            import jax.numpy as jnp
+
+            from rca_tpu.engine.runner import coo_layouts_for
+
+            cfg = self.engine.config
+            n_pad = bucket_for(n + 1, cfg.shape_buckets)
+            e_pad = bucket_for(max(len(req.dep_src), 1), cfg.shape_buckets)
+            dummy = n_pad - 1
+            s = np.full(e_pad, dummy, np.int32)
+            d = np.full(e_pad, dummy, np.int32)
+            s[: len(req.dep_src)] = req.dep_src
+            d[: len(req.dep_dst)] = req.dep_dst
+            down_seg, up_seg, up_ell = coo_layouts_for(
+                n_pad, e_pad, req.dep_src, req.dep_dst
+            )
+            gs = _PreparedGraph(
+                n=n, n_pad=n_pad, n_edges=len(req.dep_src),
+                edges_j=jnp.asarray(np.stack([s, d])),
+                down_seg=down_seg, up_seg=up_seg, up_ell=up_ell,
+                n_live=jnp.asarray(n, jnp.int32),
+                kk=min(K_CAP + 8, n_pad),
+            )
+        self._graphs[key] = gs
+        while len(self._graphs) > self._cache_cap:
+            self._graphs.popitem(last=False)
+        return gs
+
+    def _b_pad(self, b: int) -> int:
+        """Padded batch width: power of two (bounded executable count per
+        shape bucket); sharded batches additionally round to a dp
+        multiple so the hypothesis axis tiles the mesh."""
+        b_pad = 1 << max(0, (b - 1).bit_length())
+        if self._sharded:
+            dp = self.engine.dp
+            b_pad = -(-b_pad // dp) * dp
+        return b_pad
+
+    # -- the split -----------------------------------------------------------
+    def dispatch(
+        self, batch: List[ServeRequest], now: Optional[float] = None
+    ) -> BatchHandle:
+        """Stack, pad, and ENQUEUE one coalesced batch; returns without
+        synchronizing.  All requests must share a graph_key (the batcher
+        guarantees it)."""
+        if not batch:
+            raise ValueError("empty batch")
+        if any(r.graph_key != batch[0].graph_key for r in batch[1:]):
+            raise ValueError("batch members must share a graph_key")
+        if self.fault_hook is not None:
+            self.fault_hook("dispatch")
+        t0 = time.perf_counter()
+        gs = self._prepared(batch[0])
+        b = len(batch)
+        b_pad = self._b_pad(b)
+        fb = np.zeros(
+            (b_pad, gs.n_pad, batch[0].features.shape[1]), np.float32
+        )
+        for i, req in enumerate(batch):
+            fb[i, : gs.n] = req.features
+        if self._sharded:
+            from rca_tpu.engine.runner import finite_mask_rows_np
+            from rca_tpu.parallel.sharded import stage_batch_ranked
+
+            # host-side guard, same semantics as the sharded engine's
+            # analyze_batch (features are being staged from host anyway)
+            fb, n_bad = finite_mask_rows_np(fb)
+            stacked, vals, idx = stage_batch_ranked(
+                self.engine.mesh, fb, gs.sharded_graph, self.engine.params,
+                gs.kk,
+            )
+        else:
+            import jax.numpy as jnp
+
+            from rca_tpu.engine.runner import _propagate_ranked_batch
+
+            p = self.engine.params
+            stacked, vals, idx, n_bad = _propagate_ranked_batch(
+                jnp.asarray(fb), gs.edges_j,
+                self.engine._aw, self.engine._hw,
+                p.steps, p.decay, p.explain_strength, p.impact_bonus,
+                gs.kk, gs.n_live, gs.up_ell, gs.down_seg, gs.up_seg,
+                error_contrast=p.error_contrast,
+            )
+        return BatchHandle(
+            requests=list(batch), stacked=stacked, vals=vals, idx=idx,
+            n_bad=n_bad, n=gs.n, engine_tag=self.engine_tag,
+            dispatch_ms=(time.perf_counter() - t0) * 1e3,
+            dispatched_at=now if now is not None else time.monotonic(),
+        )
+
+    def fetch(self, handle: BatchHandle) -> List[object]:
+        """Block on an in-flight batch and render one EngineResult per
+        request (lane order = request order; pad lanes dropped).
+
+        THE designated device-sync point of the serve path
+        (tools/lint_tick_sync.py forbids device_get/block_until_ready
+        anywhere else in it) — async dispatch errors also surface here,
+        which is why the serve loop's breaker wraps the fetch."""
+        import jax
+
+        from rca_tpu.engine.runner import render_result
+
+        if self.fault_hook is not None:
+            self.fault_hook("fetch")
+        t1 = time.perf_counter()
+        stacked, vals, idx, n_bad = jax.device_get(
+            (handle.stacked, handle.vals, handle.idx, handle.n_bad)
+        )
+        fetch_ms = (time.perf_counter() - t1) * 1e3
+        per_req_ms = (handle.dispatch_ms + fetch_ms) / len(handle.requests)
+        results = []
+        for b, req in enumerate(handle.requests):
+            results.append(render_result(
+                stacked[b], vals[b], idx[b], req.names, handle.n, req.k,
+                per_req_ms, int(len(req.dep_src)),
+                engine=handle.engine_tag,
+                # batch-wide count, as in analyze_batch: a poisoned row
+                # poisons every hypothesis built from the same snapshot
+                sanitized_rows=int(n_bad),
+            ))
+        return results

@@ -1,0 +1,331 @@
+"""ServeLoop: the continuous-batching serve worker.
+
+One worker drives the whole scheduler: it sheds expired requests, drains
+the fair queue into the shape-bucket batcher, dispatches ready batches,
+and fetches the PREVIOUS batch only after the next one is already in
+flight — the PR-2 dispatch/fetch split applied to serving, so batch N's
+device round trip hides behind batch N+1's host-side assembly instead of
+serializing with it.
+
+Resilience contract (RESILIENCE.md vocabulary):
+
+- every request is completed EXACTLY once, whatever fails — the loop
+  never lets an exception escape a scheduling iteration;
+- a dispatch/fetch failure records the fault (bounded fault log), counts
+  against a :class:`rca_tpu.resilience.policy.CircuitBreaker`, and
+  answers the batch with the LAST KNOWN ranking for that graph
+  (``degraded``) or ``error`` when none exists;
+- an OPEN breaker answers immediately without touching the device (the
+  degraded path is also the overload path: a broken device must not
+  accumulate queue);
+- deadline shedding happens at admission, in the queue, in the batcher,
+  and once more at batch formation — an expired request NEVER consumes a
+  device slot.  A request whose deadline lapses only while its batch is
+  in flight is still answered ``ok`` with ``deadline_missed`` set (the
+  slot was already spent; the caller decides what staleness means).
+
+The loop body lives in :meth:`run_once` so policy tests can drive the
+scheduler single-threaded with a fake clock; :meth:`start` runs the same
+body on a daemon worker for real serving.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from rca_tpu.config import ServeConfig
+from rca_tpu.resilience.policy import (
+    CircuitBreaker,
+    record_fault,
+    suppressed,
+)
+from rca_tpu.serve.batcher import ShapeBucketBatcher
+from rca_tpu.serve.dispatcher import BatchDispatcher, BatchHandle
+from rca_tpu.serve.metrics import ServeMetrics
+from rca_tpu.serve.queue import RequestQueue
+from rca_tpu.serve.request import GraphKey, ServeRequest, ServeResponse
+
+#: last-known rankings kept per graph for degraded responses
+_LAST_KNOWN_CAP = 128
+#: staging window: how far the loop reads ahead of the current batch
+_STAGE_AHEAD_BATCHES = 4
+#: idle park time when nothing is queued, staged, or in flight
+_IDLE_WAIT_S = 0.05
+
+
+class ServeLoop:
+    def __init__(
+        self,
+        engine=None,
+        config: Optional[ServeConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        store=None,
+        fault_hook: Optional[Callable[[str], None]] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        dispatcher: Optional[BatchDispatcher] = None,
+    ):
+        self.config = config or ServeConfig.from_env()
+        self.clock = clock
+        self.queue = RequestQueue(self.config.queue_cap, clock=clock)
+        self.batcher = ShapeBucketBatcher(
+            self.config.max_batch, self.config.max_wait_us, clock=clock
+        )
+        self.dispatcher = dispatcher or BatchDispatcher(
+            engine, fault_hook=fault_hook
+        )
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=3, reset_after=1.0, clock=clock,
+            name="serve.dispatch",
+        )
+        self.metrics = ServeMetrics()
+        # optional investigation store: an ok response with an
+        # investigation_id appends a serve note there (the store's fcntl
+        # locking is what makes this safe from the worker thread while
+        # submitters touch the same investigation)
+        self.store = store
+        self._last_known: "collections.OrderedDict[GraphKey, List[dict]]" = (
+            collections.OrderedDict()
+        )
+        self._inflight: Optional[BatchHandle] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.device_batches = 0   # batches actually dispatched to device
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ServeLoop":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="rca-serve", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self.queue.kick()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self) -> "ServeLoop":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def engine(self):
+        return self.dispatcher.engine
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, req: ServeRequest) -> bool:
+        """Admit one request.  Returns whether it was QUEUED; either way
+        the request will be completed (``queue_full``/``shed`` responses
+        are delivered synchronously here), so ``req.result()`` always
+        terminates."""
+        now = self.clock()
+        if req.expired(now):
+            # dead on arrival: shed at admission, never queued
+            self._respond_shed(req, detail="expired_at_admission")
+            return False
+        if not self.queue.submit(req):
+            self.metrics.rejected(req.tenant)
+            req.complete(ServeResponse(
+                status="queue_full", request_id=req.request_id,
+                tenant=req.tenant,
+                detail=f"queue at capacity ({self.queue.cap})",
+            ))
+            return False
+        self.metrics.submitted(req.tenant, len(self.queue))
+        return True
+
+    # -- scheduling iteration ------------------------------------------------
+    def run_once(self) -> bool:
+        """One scheduler iteration (shed → stage → dispatch → fetch the
+        previous batch).  Returns whether any work happened — the worker
+        parks when three consecutive concerns (queue, batcher, inflight)
+        are empty.  Exposed for single-threaded policy tests."""
+        now = self.clock()
+        worked = False
+        for req in self.queue.shed_expired(now):
+            self._respond_shed(req, detail="expired_in_queue")
+            worked = True
+        for req in self.batcher.shed_expired(now):
+            self._respond_shed(req, detail="expired_in_batcher")
+            worked = True
+        # stage ahead of the device, but boundedly: the queue keeps
+        # backpressure accounting while the batcher only holds what the
+        # next few dispatches can consume
+        stage_cap = self.config.max_batch * _STAGE_AHEAD_BATCHES
+        while self.batcher.staged() < stage_cap:
+            req = self.queue.pop()
+            if req is None:
+                break
+            self.batcher.offer(req)
+            worked = True
+        drain = self._inflight is None and len(self.queue) == 0
+        batch = self.batcher.take_ready(now, drain=drain)
+        handle = None
+        if batch:
+            worked = True
+            live: List[ServeRequest] = []
+            for req in batch:
+                # last call: a deadline can lapse between staging and
+                # batch formation, and an expired request must not ride
+                # a device slot even when its batch is already formed
+                if req.expired(now):
+                    self._respond_shed(req, detail="expired_at_dispatch")
+                else:
+                    live.append(req)
+            if live:
+                handle = self._dispatch_guarded(live)
+        if self._inflight is not None:
+            # fetch the PREVIOUS batch only after this iteration's
+            # dispatch is in flight: its round trip overlapped the
+            # shed/stage/dispatch host work above
+            self._fetch_guarded(self._inflight)
+            self._inflight = None
+            worked = True
+        if handle is not None:
+            self._inflight = handle
+        return worked
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self.run_once():
+                timeout = self.batcher.next_ready_in() or _IDLE_WAIT_S
+                self.queue.wait_for_work(min(timeout, _IDLE_WAIT_S))
+        self._shutdown_drain()
+
+    def _shutdown_drain(self) -> None:
+        """Complete everything still in the system: the in-flight batch
+        fetches normally (results exist), everything else errors out —
+        a stopped loop must not leave submitters parked forever."""
+        if self._inflight is not None:
+            self._fetch_guarded(self._inflight)
+            self._inflight = None
+        pending: List[ServeRequest] = []
+        while True:
+            req = self.queue.pop()
+            if req is None:
+                break
+            pending.append(req)
+        pending.extend(self.batcher.take_ready(drain=True) or [])
+        while self.batcher.staged():
+            pending.extend(self.batcher.take_ready(drain=True) or [])
+        for req in pending:
+            self.metrics.errors(req.tenant)
+            req.complete(ServeResponse(
+                status="error", request_id=req.request_id,
+                tenant=req.tenant, detail="serve loop stopped",
+            ))
+
+    # -- guarded device path -------------------------------------------------
+    def _dispatch_guarded(
+        self, batch: List[ServeRequest]
+    ) -> Optional[BatchHandle]:
+        if not self.breaker.allow():
+            # open breaker: answer WITHOUT touching the device — the
+            # degraded path doubles as load shedding while broken
+            for req in batch:
+                self._respond_degraded(req, detail="circuit_open")
+            return None
+        try:
+            handle = self.dispatcher.dispatch(batch, now=self.clock())
+        except Exception as exc:
+            record_fault("serve.dispatch", exc)
+            self.breaker.record_failure()
+            for req in batch:
+                self._respond_degraded(
+                    req, detail=f"dispatch_failed:{type(exc).__name__}"
+                )
+            return None
+        self.device_batches += 1
+        return handle
+
+    def _fetch_guarded(self, handle: BatchHandle) -> None:
+        try:
+            results = self.dispatcher.fetch(handle)
+        except Exception as exc:
+            # async dispatch errors surface at the fetch — same breaker,
+            # same degraded answer
+            record_fault("serve.fetch", exc)
+            self.breaker.record_failure()
+            for req in handle.requests:
+                self._respond_degraded(
+                    req, detail=f"fetch_failed:{type(exc).__name__}"
+                )
+            return
+        self.breaker.record_success()
+        now = self.clock()
+        width = len(handle.requests)
+        self.metrics.record_batch(width)
+        for req, result in zip(handle.requests, results):
+            ranked = [dict(r) for r in result.ranked]
+            self._remember(req.graph_key, ranked)
+            queue_ms = max(
+                0.0, (handle.dispatched_at - req.enqueued_at) * 1e3
+            )
+            self.metrics.answered(req.tenant, queue_ms)
+            self._store_note(req, result)
+            req.complete(ServeResponse(
+                status="ok", request_id=req.request_id, tenant=req.tenant,
+                ranked=ranked, queue_ms=round(queue_ms, 3),
+                batch_size=width,
+                deadline_missed=req.expired(now),
+                result=result,
+            ))
+
+    # -- response helpers ----------------------------------------------------
+    def _remember(self, key: GraphKey, ranked: List[dict]) -> None:
+        self._last_known[key] = ranked
+        self._last_known.move_to_end(key)
+        while len(self._last_known) > _LAST_KNOWN_CAP:
+            self._last_known.popitem(last=False)
+
+    def _respond_shed(self, req: ServeRequest, detail: str) -> None:
+        self.metrics.shed(req.tenant)
+        req.complete(ServeResponse(
+            status="shed", request_id=req.request_id, tenant=req.tenant,
+            detail=detail,
+        ))
+
+    def _respond_degraded(self, req: ServeRequest, detail: str) -> None:
+        stale = self._last_known.get(req.graph_key)
+        if stale is not None:
+            self.metrics.degraded(req.tenant)
+            req.complete(ServeResponse(
+                status="degraded", request_id=req.request_id,
+                tenant=req.tenant, ranked=[dict(r) for r in stale],
+                detail=detail + " (serving last known ranking)",
+            ))
+        else:
+            self.metrics.errors(req.tenant)
+            req.complete(ServeResponse(
+                status="error", request_id=req.request_id,
+                tenant=req.tenant, detail=detail,
+            ))
+
+    def _store_note(self, req: ServeRequest, result) -> None:
+        """Optional investigation-store append for served requests — the
+        serve path's writes ride the store's fcntl locking, so concurrent
+        workers/submitters on one investigation cannot lose updates.  A
+        store failure must not fail the response (suppressed → bounded
+        fault log)."""
+        if self.store is None or req.investigation_id is None:
+            return
+        top = result.ranked[0]["component"] if result.ranked else None
+        with suppressed("serve.store_note"):
+            self.store.add_message(
+                req.investigation_id, "serve",
+                {
+                    "request_id": req.request_id,
+                    "tenant": req.tenant,
+                    "top_component": top,
+                    "engine": result.engine,
+                },
+            )

@@ -1,0 +1,100 @@
+"""Per-tenant serving metrics, flowing through the obslog accumulators.
+
+One :class:`ServeMetrics` per :class:`rca_tpu.serve.loop.ServeLoop`:
+counters per tenant (submitted / answered / shed / rejected / degraded /
+errors), time-in-queue samples per tenant (p50/p99 via
+:class:`rca_tpu.obslog.profiling.PhaseStats` — the same accumulator the
+streaming tick phases use), instantaneous queue depth at each admission,
+and batch occupancy per device dispatch.  Everything is thread-safe: the
+submit path and the serve worker record concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from rca_tpu.obslog.profiling import PhaseStats
+
+_COUNTER_KEYS = (
+    "submitted", "answered", "shed", "rejected", "degraded", "errors",
+)
+
+
+class ServeMetrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, Dict[str, int]] = {}
+        self._queue_ms = PhaseStats()      # one phase per tenant
+        self._occupancy: List[int] = []
+        self._depth_peak = 0
+        self.dispatched_requests = 0
+
+    def _tenant(self, tenant: str) -> Dict[str, int]:
+        return self._counts.setdefault(
+            tenant, {k: 0 for k in _COUNTER_KEYS}
+        )
+
+    # -- recording -----------------------------------------------------------
+    def submitted(self, tenant: str, queue_depth: int) -> None:
+        with self._lock:
+            self._tenant(tenant)["submitted"] += 1
+            self._depth_peak = max(self._depth_peak, queue_depth)
+
+    def answered(self, tenant: str, queue_ms: float) -> None:
+        with self._lock:
+            self._tenant(tenant)["answered"] += 1
+            self._queue_ms.record(tenant, queue_ms)
+
+    def shed(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant(tenant)["shed"] += 1
+
+    def rejected(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant(tenant)["rejected"] += 1
+
+    def degraded(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant(tenant)["degraded"] += 1
+
+    def errors(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant(tenant)["errors"] += 1
+
+    def record_batch(self, size: int) -> None:
+        with self._lock:
+            self._occupancy.append(int(size))
+            self.dispatched_requests += int(size)
+
+    # -- reporting -----------------------------------------------------------
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            per_tenant = {}
+            for tenant, counts in sorted(self._counts.items()):
+                per_tenant[tenant] = {
+                    **counts,
+                    "queue_ms_p50": self._queue_ms.quantile(tenant, 0.50),
+                    "queue_ms_p99": self._queue_ms.quantile(tenant, 0.99),
+                }
+            occ = list(self._occupancy)
+            occ_sorted = sorted(occ)
+            return {
+                "tenants": per_tenant,
+                "batches": len(occ),
+                "dispatched_requests": self.dispatched_requests,
+                "batch_occupancy_mean": (
+                    round(sum(occ) / len(occ), 2) if occ else None
+                ),
+                "batch_occupancy_p50": (
+                    occ_sorted[len(occ_sorted) // 2] if occ_sorted else None
+                ),
+                "batch_occupancy_max": max(occ) if occ else None,
+                "queue_depth_peak": self._depth_peak,
+                "shed_total": sum(
+                    c["shed"] for c in self._counts.values()
+                ),
+                "rejected_total": sum(
+                    c["rejected"] for c in self._counts.values()
+                ),
+            }

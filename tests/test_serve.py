@@ -1,0 +1,522 @@
+"""ISSUE 3: multi-tenant serving scheduler (rca_tpu/serve, SERVING.md).
+
+Covers the serving contracts:
+
+- scheduling policy (fake clock, no device): bounded admission
+  (``queue_full``), weighted-fair + priority service order, per-tenant
+  FIFO, deadline shedding at every stage — an expired request NEVER
+  consumes a device slot;
+- shape-bucket flush policy: full batch flushes immediately, the wait
+  bound flushes partial groups, an idle engine never sits out the wait
+  window, distinct graphs never coalesce;
+- resilience: dispatch/fetch failures answer ``degraded`` (last-known
+  ranking) or ``error``, the breaker opens and answers without touching
+  the device, every request resolves exactly once;
+- batching parity: a request served from a coalesced batch is
+  bit-identical to the same request served alone, across bucket sizes
+  and tenant mixes, including under chaos faults;
+- the end-to-end selftest behind ``rca serve --selftest`` (the tier-1
+  smoke) and the coordinator's ``serve=`` integration;
+- ``RCA_SERVE_*`` env-var validation round trip.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.generator import synthetic_cascade_arrays
+from rca_tpu.config import ServeConfig
+from rca_tpu.engine import GraphEngine
+from rca_tpu.serve import (
+    PRIORITY_HIGH,
+    BatchDispatcher,
+    RequestQueue,
+    ServeClient,
+    ServeLoop,
+    ServeRequest,
+    ShapeBucketBatcher,
+    serve_selftest,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _req(tenant="t", n=8, k=3, seed=0, **kw) -> ServeRequest:
+    rng = np.random.default_rng(seed)
+    feats = rng.uniform(0, 1, (n, 4)).astype(np.float32)
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    return ServeRequest(
+        tenant=tenant, features=feats, dep_src=src, dep_dst=dst, k=k, **kw
+    )
+
+
+class StubHandle:
+    def __init__(self, requests, dispatched_at):
+        self.requests = requests
+        self.dispatched_at = dispatched_at
+
+
+class StubResult:
+    def __init__(self, tag):
+        self.ranked = [{"component": f"svc-{tag}", "score": 1.0}]
+        self.engine = "stub"
+        self.score = np.ones(1, np.float32)
+
+
+class StubDispatcher:
+    """Device-free dispatcher: records every batch, optional scripted
+    failures per op ("dispatch"/"fetch")."""
+
+    engine = None
+    engine_tag = "stub"
+
+    def __init__(self):
+        self.dispatched = []   # list of batch widths
+        self.fail_next = []    # ops to fail, consumed front-first
+
+    def dispatch(self, batch, now=None):
+        if self.fail_next and self.fail_next[0] == "dispatch":
+            self.fail_next.pop(0)
+            raise RuntimeError("injected dispatch failure")
+        self.dispatched.append(len(batch))
+        return StubHandle(list(batch), now if now is not None else 0.0)
+
+    def fetch(self, handle):
+        if self.fail_next and self.fail_next[0] == "fetch":
+            self.fail_next.pop(0)
+            raise RuntimeError("injected fetch failure")
+        return [StubResult(i) for i, _ in enumerate(handle.requests)]
+
+
+def _policy_loop(clock=None, **cfg_kw):
+    """Single-threaded loop over a stub dispatcher (never start()ed)."""
+    clock = clock or FakeClock()
+    stub = StubDispatcher()
+    loop = ServeLoop(
+        config=ServeConfig(**cfg_kw), clock=clock, dispatcher=stub,
+    )
+    return loop, stub, clock
+
+
+def _drain(loop, iters=10):
+    for _ in range(iters):
+        loop.run_once()
+
+
+# -- config (satellite: RCA_SERVE_* validation) ------------------------------
+
+def test_serve_config_env_round_trip(monkeypatch):
+    monkeypatch.setenv("RCA_SERVE_MAX_BATCH", "32")
+    monkeypatch.setenv("RCA_SERVE_MAX_WAIT_US", "500")
+    monkeypatch.setenv("RCA_SERVE_QUEUE_CAP", "77")
+    cfg = ServeConfig.from_env()
+    assert (cfg.max_batch, cfg.max_wait_us, cfg.queue_cap) == (32, 500, 77)
+
+
+def test_serve_config_defaults_when_unset(monkeypatch):
+    for name in ("RCA_SERVE_MAX_BATCH", "RCA_SERVE_MAX_WAIT_US",
+                 "RCA_SERVE_QUEUE_CAP"):
+        monkeypatch.delenv(name, raising=False)
+    cfg = ServeConfig.from_env()
+    assert (cfg.max_batch, cfg.max_wait_us, cfg.queue_cap) == (16, 2000, 256)
+
+
+@pytest.mark.parametrize("name,bad", [
+    ("RCA_SERVE_MAX_BATCH", "0"),
+    ("RCA_SERVE_MAX_BATCH", "5000"),
+    ("RCA_SERVE_MAX_BATCH", "abc"),
+    ("RCA_SERVE_MAX_WAIT_US", "-1"),
+    ("RCA_SERVE_QUEUE_CAP", "0"),
+])
+def test_serve_config_rejects_bad_env(monkeypatch, name, bad):
+    monkeypatch.setenv(name, bad)
+    with pytest.raises(ValueError, match=name):
+        ServeConfig.from_env()
+
+
+def test_serve_config_rejects_bad_direct_construction():
+    with pytest.raises(ValueError):
+        ServeConfig(max_batch=0)
+    with pytest.raises(ValueError):
+        ServeConfig(max_wait_us=-5)
+    with pytest.raises(ValueError):
+        ServeConfig(queue_cap=0)
+
+
+# -- queue policy ------------------------------------------------------------
+
+def test_queue_caps_admission():
+    q = RequestQueue(cap=2, clock=FakeClock())
+    assert q.submit(_req("a"))
+    assert q.submit(_req("a"))
+    assert not q.submit(_req("b"))  # full: rejected, NOT queued
+    assert len(q) == 2
+
+
+def test_queue_weighted_fair_interleaves_flooding_tenant():
+    q = RequestQueue(cap=64, clock=FakeClock())
+    for i in range(6):
+        q.submit(_req("flood", seed=i))
+    for i in range(2):
+        q.submit(_req("light", seed=10 + i))
+    order = [q.pop().tenant for _ in range(8)]
+    # start-time fair queuing: the light tenant's 2 requests interleave
+    # with the flood's first two instead of waiting behind all six
+    assert order[:4].count("light") == 2
+
+
+def test_queue_weight_scales_drain_rate():
+    q = RequestQueue(cap=64, clock=FakeClock())
+    q.set_weight("heavy", 2.0)
+    for i in range(8):
+        q.submit(_req("heavy", seed=i))
+        q.submit(_req("light", seed=100 + i))
+    first6 = [q.pop().tenant for _ in range(6)]
+    # weight 2 drains twice as fast under contention
+    assert first6.count("heavy") == 4
+    assert first6.count("light") == 2
+
+
+def test_queue_priority_pops_before_normal():
+    q = RequestQueue(cap=64, clock=FakeClock())
+    q.submit(_req("a", seed=1))
+    q.submit(_req("a", seed=2))
+    q.submit(_req("b", seed=3, priority=PRIORITY_HIGH))
+    assert q.pop().tenant == "b"
+
+
+def test_queue_per_tenant_fifo():
+    q = RequestQueue(cap=64, clock=FakeClock())
+    reqs = [_req("a", seed=i) for i in range(5)]
+    for r in reqs:
+        q.submit(r)
+    popped = [q.pop().request_id for _ in range(5)]
+    assert popped == [r.request_id for r in reqs]
+
+
+def test_queue_sheds_expired_only():
+    clock = FakeClock()
+    q = RequestQueue(cap=64, clock=clock)
+    q.submit(_req("a", deadline_s=1.0))
+    q.submit(_req("a", deadline_s=100.0))
+    clock.advance(5.0)
+    shed = q.shed_expired()
+    assert len(shed) == 1 and shed[0].deadline_s == 1.0
+    assert len(q) == 1
+
+
+# -- batcher flush policy ----------------------------------------------------
+
+def _offer(b, req, now):
+    req.enqueued_at = now
+    b.offer(req)
+
+
+def test_batcher_full_batch_flushes_immediately():
+    clock = FakeClock()
+    b = ShapeBucketBatcher(max_batch=3, max_wait_us=10_000_000, clock=clock)
+    for i in range(3):
+        _offer(b, _req("a", seed=i), clock())
+    batch = b.take_ready()
+    assert batch is not None and len(batch) == 3
+    assert b.staged() == 0
+
+
+def test_batcher_partial_waits_then_flushes():
+    clock = FakeClock()
+    b = ShapeBucketBatcher(max_batch=8, max_wait_us=2000, clock=clock)
+    _offer(b, _req("a"), clock())
+    assert b.take_ready() is None          # worth holding for batchmates
+    clock.advance(0.0021)                  # past the 2000 us wait bound
+    batch = b.take_ready()
+    assert batch is not None and len(batch) == 1
+
+
+def test_batcher_drain_skips_wait_window():
+    clock = FakeClock()
+    b = ShapeBucketBatcher(max_batch=8, max_wait_us=10_000_000, clock=clock)
+    _offer(b, _req("a"), clock())
+    # idle engine (drain): a lone request's latency is one dispatch,
+    # not max_wait plus one
+    assert b.take_ready(drain=True) is not None
+
+
+def test_batcher_never_mixes_graphs():
+    clock = FakeClock()
+    b = ShapeBucketBatcher(max_batch=8, max_wait_us=0, clock=clock)
+    _offer(b, _req("a", n=8), clock())
+    _offer(b, _req("a", n=16), clock())    # different graph_key
+    first = b.take_ready()
+    second = b.take_ready()
+    assert len(first) == 1 and len(second) == 1
+    assert first[0].graph_key != second[0].graph_key
+
+
+def test_batcher_sheds_expired():
+    clock = FakeClock()
+    b = ShapeBucketBatcher(max_batch=8, max_wait_us=0, clock=clock)
+    _offer(b, _req("a", deadline_s=1.0), clock())
+    clock.advance(2.0)
+    assert len(b.shed_expired()) == 1
+    assert b.staged() == 0 and b.take_ready() is None
+
+
+# -- loop policy (single-threaded, stub device) ------------------------------
+
+def test_loop_queue_full_response_at_admission():
+    loop, stub, _ = _policy_loop(queue_cap=2)
+    r1, r2, r3 = _req("a", seed=1), _req("a", seed=2), _req("b", seed=3)
+    assert loop.submit(r1) and loop.submit(r2)
+    assert not loop.submit(r3)
+    resp = r3.result(timeout=0)        # completed synchronously
+    assert resp.status == "queue_full"
+    assert stub.dispatched == []       # never touched the device
+
+
+def test_loop_expired_request_never_consumes_device_slot():
+    clock = FakeClock()
+    loop, stub, clock = _policy_loop(clock=clock, max_wait_us=0)
+    dead = _req("a", deadline_s=5.0)
+    live = _req("a", seed=9, deadline_s=100.0)
+    loop.submit(dead)
+    loop.submit(live)
+    clock.advance(10.0)                # dead expires while queued
+    _drain(loop)
+    assert dead.result(timeout=0).status == "shed"
+    assert live.result(timeout=0).status == "ok"
+    # the shed request got no device slot: only the live one dispatched
+    assert sum(stub.dispatched) == 1
+
+
+def test_loop_dead_on_arrival_is_shed_at_admission():
+    clock = FakeClock(100.0)
+    loop, stub, _ = _policy_loop(clock=clock)
+    doa = _req("a", deadline_s=50.0)   # already past deadline
+    assert not loop.submit(doa)
+    assert doa.result(timeout=0).status == "shed"
+    assert len(loop.queue) == 0 and stub.dispatched == []
+
+
+def test_loop_ok_response_carries_batch_accounting():
+    loop, stub, _ = _policy_loop(max_wait_us=0)
+    reqs = [_req("a", seed=i) for i in range(3)]
+    for r in reqs:
+        loop.submit(r)
+    _drain(loop)
+    resps = [r.result(timeout=0) for r in reqs]
+    assert all(r.status == "ok" for r in resps)
+    assert {r.batch_size for r in resps} == {3}
+    assert loop.device_batches == 1
+
+
+def test_loop_fetch_failure_degrades_with_last_known():
+    loop, stub, _ = _policy_loop(max_wait_us=0)
+    first = _req("a", seed=1)
+    loop.submit(first)
+    _drain(loop)
+    assert first.result(timeout=0).status == "ok"   # seeds last-known
+
+    stub.fail_next = ["fetch"]
+    second = _req("a", seed=2)                      # same graph shape/edges
+    loop.submit(second)
+    _drain(loop)
+    resp = second.result(timeout=0)
+    assert resp.status == "degraded"
+    assert resp.ranked == first.result(timeout=0).ranked  # the stale copy
+
+
+def test_loop_error_when_no_last_known():
+    loop, stub, _ = _policy_loop(max_wait_us=0)
+    stub.fail_next = ["dispatch"]
+    r = _req("a")
+    loop.submit(r)
+    _drain(loop)
+    assert r.result(timeout=0).status == "error"
+
+
+def test_loop_open_breaker_answers_without_device():
+    loop, stub, clock = _policy_loop(max_wait_us=0)
+    # three consecutive failures open the breaker
+    for i in range(3):
+        stub.fail_next = ["dispatch"]
+        r = _req("a", seed=i)
+        loop.submit(r)
+        _drain(loop)
+        assert r.result(timeout=0).status == "error"
+    assert loop.breaker.state == "open"
+    dispatched_before = len(stub.dispatched)
+    r = _req("a", seed=99)
+    loop.submit(r)
+    _drain(loop)
+    assert r.result(timeout=0).status == "error"    # circuit_open, no stale
+    assert "circuit_open" in r.result(timeout=0).detail
+    assert len(stub.dispatched) == dispatched_before  # device untouched
+
+
+def test_loop_shutdown_resolves_everything():
+    loop, stub, _ = _policy_loop(max_wait_us=10_000_000, max_batch=64)
+    reqs = [_req("a", seed=i) for i in range(4)]
+    for r in reqs:
+        loop.submit(r)
+    loop.start()
+    loop.stop()
+    assert all(r.done() for r in reqs)  # nobody left parked forever
+
+
+# -- batching parity (real engine) -------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    return GraphEngine()
+
+
+def test_dispatcher_parity_across_widths(engine):
+    """A lane of any coalesced width is bit-identical to the solo
+    analysis: same vmapped executable, lanes do not interact."""
+    case = synthetic_cascade_arrays(60, n_roots=1, seed=3)
+    rng = np.random.default_rng(0)
+    disp = BatchDispatcher(engine)
+    for width in (1, 2, 3, 5):
+        reqs = [
+            ServeRequest(
+                tenant=f"t{i % 2}",
+                features=np.clip(
+                    case.features + rng.uniform(
+                        0, 0.05, case.features.shape
+                    ).astype(np.float32), 0, 1),
+                dep_src=case.dep_src, dep_dst=case.dep_dst,
+                names=case.names, k=3,
+            )
+            for i in range(width)
+        ]
+        results = disp.fetch(disp.dispatch(reqs))
+        assert len(results) == width
+        for req, res in zip(reqs, results):
+            solo = engine.analyze_arrays(
+                req.features, case.dep_src, case.dep_dst, case.names, k=3,
+            )
+            assert res.ranked == solo.ranked
+            assert np.array_equal(res.score, solo.score)
+
+
+def test_selftest_contract(engine):
+    """The tier-1 smoke behind ``rca serve --selftest``: 32 mixed-tenant
+    requests over three shape buckets, concurrent submitters — all
+    answered or shed within deadline, coalesced-vs-solo bit parity."""
+    out = serve_selftest(n_requests=32, seed=0, engine=engine)
+    assert out["ok"], out
+    assert out["all_resolved"] and out["parity_ok"]
+    assert out["by_status"].get("shed", 0) >= out["expected_shed_min"]
+    # batching actually happened: far fewer device batches than requests
+    assert out["device_batches"] < out["requests"] // 2
+    assert out["metrics"]["batch_occupancy_max"] > 1
+
+
+def test_selftest_parity_under_chaos(engine):
+    """Seeded dispatch/fetch faults: every request still resolves, and
+    every ok ranking is still bit-identical to solo (degraded responses
+    are stale by contract and excluded from parity)."""
+    out = serve_selftest(n_requests=24, seed=3, engine=engine, chaos=True)
+    assert out["all_resolved"], out
+    assert out["parity_ok"], out
+    assert out["ok"], out
+
+
+# -- coordinator integration -------------------------------------------------
+
+def test_coordinator_routes_correlation_through_serve(engine, five_svc_client):
+    from rca_tpu.coordinator import RCACoordinator
+
+    with ServeClient(engine=engine) as client:
+        coord = RCACoordinator(
+            five_svc_client, serve=client, tenant="coord-test",
+        )
+        record = coord.run_analysis("comprehensive", "test-microservices")
+        assert record["status"] == "completed", record.get("error")
+        correlated = record["results"]["correlated"]
+        # the fusion result came through the serving queue
+        assert correlated["engine"] == "serve+single"
+        assert correlated["root_causes"]
+        assert client.loop.device_batches >= 1
+
+
+def test_coordinator_rejects_engine_and_serve(five_svc_client):
+    from rca_tpu.coordinator import RCACoordinator
+
+    with ServeClient(dispatcher=StubDispatcher()) as client:
+        with pytest.raises(ValueError, match="not both"):
+            RCACoordinator(
+                five_svc_client, serve=client, engine=object(),
+            )
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_metrics_summary_shape():
+    loop, stub, _ = _policy_loop(max_wait_us=0)
+    for i in range(3):
+        loop.submit(_req("a", seed=i))
+    _drain(loop)
+    m = loop.metrics.summary()
+    assert m["tenants"]["a"]["answered"] == 3
+    assert m["tenants"]["a"]["queue_ms_p50"] is not None
+    assert m["batches"] == 1
+    assert m["batch_occupancy_mean"] == 3.0
+    assert m["dispatched_requests"] == 3
+
+
+def test_phase_stats_quantile():
+    from rca_tpu.obslog.profiling import PhaseStats
+
+    ps = PhaseStats()
+    for v in range(1, 101):
+        ps.record("q", float(v))
+    assert ps.quantile("q", 0.0) == 1.0
+    assert ps.quantile("q", 0.5) == 51.0   # nearest-rank on 100 samples
+    assert ps.quantile("q", 1.0) == 100.0
+    assert ps.quantile("missing", 0.5) is None
+    assert ps.count("q") == 100
+
+
+# -- concurrent submission through the client --------------------------------
+
+def test_concurrent_submitters_all_resolve(engine):
+    case = synthetic_cascade_arrays(48, n_roots=1, seed=1)
+    rng = np.random.default_rng(0)
+    feats = [
+        np.clip(case.features + rng.uniform(
+            0, 0.05, case.features.shape
+        ).astype(np.float32), 0, 1)
+        for _ in range(16)
+    ]
+    with ServeClient(engine=engine) as client:
+        reqs = [None] * 16
+
+        def submit(w):
+            for i in range(w, 16, 4):
+                reqs[i] = client.submit(
+                    feats[i], case.dep_src, case.dep_dst,
+                    tenant=f"t{w}", k=3,
+                )
+
+        threads = [threading.Thread(target=submit, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        resps = [r.result(120.0) for r in reqs]
+    assert all(r.status == "ok" for r in resps)
+    # one graph key: the sweep coalesced instead of 16 solo dispatches
+    assert client.loop.device_batches < 16

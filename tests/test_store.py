@@ -89,6 +89,136 @@ def test_concurrent_writers_do_not_lose_messages(store):
     assert len(got["conversation"]) == 60
 
 
+def _serve_stub_parts():
+    """Device-free serve loop parts for the store-contention tests."""
+
+    class _Handle:
+        def __init__(self, requests, at):
+            self.requests, self.dispatched_at = requests, at
+
+    class _Result:
+        ranked = [{"component": "svc-0", "score": 1.0}]
+        engine = "stub"
+
+    class _Stub:
+        engine = None
+
+        def dispatch(self, batch, now=None):
+            return _Handle(list(batch), now if now is not None else 0.0)
+
+        def fetch(self, handle):
+            return [_Result() for _ in handle.requests]
+
+    import numpy as np
+
+    feats = np.ones((8, 4), np.float32)
+    src = np.arange(7, dtype=np.int32)
+    dst = np.arange(1, 8, dtype=np.int32)
+    return _Stub(), feats, src, dst
+
+
+def test_serve_path_concurrent_appends_no_lost_updates(store):
+    """ISSUE 3 satellite: N threads appending to ONE investigation
+    through the serve path — submitter threads write user messages while
+    the serve worker appends its per-request serve notes to the same
+    file.  The store's fcntl locking must lose nothing."""
+    import threading
+
+    from rca_tpu.config import ServeConfig
+    from rca_tpu.serve import ServeLoop, ServeRequest
+
+    stub, feats, src, dst = _serve_stub_parts()
+    inv = store.create_investigation("serve stress")
+    iid = inv["id"]
+    loop = ServeLoop(
+        config=ServeConfig(max_batch=4, max_wait_us=0, queue_cap=256),
+        dispatcher=stub, store=store,
+    ).start()
+    n, workers = 32, 8
+    reqs = [None] * n
+
+    def submitter(w):
+        for i in range(w, n, workers):
+            store.add_message(iid, "user", f"query-{i}")
+            reqs[i] = ServeRequest(
+                tenant=f"t{w}", features=feats, dep_src=src, dep_dst=dst,
+                investigation_id=iid,
+            )
+            loop.submit(reqs[i])
+
+    threads = [
+        threading.Thread(target=submitter, args=(w,)) for w in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    resps = [r.result(60.0) for r in reqs]
+    loop.stop()
+    assert all(r.status == "ok" for r in resps)
+    got = store.get_investigation(iid)
+    roles = [m["role"] for m in got["conversation"]]
+    assert roles.count("user") == n       # no lost submitter appends
+    assert roles.count("serve") == n      # no lost worker appends
+
+
+def test_lock_released_when_writer_crashes_mid_update(store):
+    """A worker crashing INSIDE the locked read-modify-write section must
+    release the fcntl lock (the context manager's finally), so the next
+    writer proceeds instead of deadlocking."""
+    import threading
+
+    inv = store.create_investigation("crash")
+    iid = inv["id"]
+
+    def crasher():
+        def mutate(_inv):
+            raise RuntimeError("worker crash mid-update")
+
+        with pytest.raises(RuntimeError):
+            store._update(iid, mutate)
+
+    t = threading.Thread(target=crasher)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    done = []
+    t2 = threading.Thread(
+        target=lambda: done.append(store.add_message(iid, "user", "after"))
+    )
+    t2.start()
+    t2.join(timeout=10)
+    assert done and done[0] is not None   # lock was released, not leaked
+    got = store.get_investigation(iid)
+    assert [m["content"] for m in got["conversation"]] == ["after"]
+
+
+def test_serve_store_note_failure_does_not_fail_response(store):
+    """A store failure on the serve worker's note append is suppressed
+    (bounded fault log) — the request is still answered ok."""
+    from rca_tpu.config import ServeConfig
+    from rca_tpu.serve import ServeLoop, ServeRequest
+
+    stub, feats, src, dst = _serve_stub_parts()
+
+    class _BrokenStore:
+        def add_message(self, *a, **kw):
+            raise OSError("disk full")
+
+    loop = ServeLoop(
+        config=ServeConfig(max_wait_us=0),
+        dispatcher=stub, store=_BrokenStore(),
+    ).start()
+    req = ServeRequest(
+        tenant="t", features=feats, dep_src=src, dep_dst=dst,
+        investigation_id="whatever",
+    )
+    loop.submit(req)
+    resp = req.result(30.0)
+    loop.stop()
+    assert resp.status == "ok"
+
+
 def test_evidence_logger_roundtrip(tmp_path):
     ev = EvidenceLogger(root=str(tmp_path / "ev"))
     p1 = ev.log_hypothesis(

@@ -30,13 +30,22 @@ SYNC_ATTRS = ("device_get", "block_until_ready")
 
 # tick-path modules -> function names allowed to synchronize there.
 # fetch() is THE sync point; everything else on the capture/dispatch path
-# must stay async.
+# must stay async.  The serving scheduler (ISSUE 3) joins the same
+# contract: its worker overlaps batch N's device round trip with batch
+# N+1's assembly, so a sync anywhere outside BatchDispatcher.fetch
+# re-serializes the serve pipeline exactly like a stray sync in a tick.
 TICK_MODULES: Dict[str, Set[str]] = {
     os.path.join("rca_tpu", "engine", "streaming.py"): {"fetch"},
     os.path.join("rca_tpu", "parallel", "streaming.py"): {"fetch"},
     os.path.join("rca_tpu", "engine", "live.py"): set(),
     os.path.join("rca_tpu", "features", "extract.py"): set(),
     os.path.join("rca_tpu", "cluster", "snapshot.py"): set(),
+    os.path.join("rca_tpu", "serve", "dispatcher.py"): {"fetch"},
+    os.path.join("rca_tpu", "serve", "loop.py"): set(),
+    os.path.join("rca_tpu", "serve", "queue.py"): set(),
+    os.path.join("rca_tpu", "serve", "batcher.py"): set(),
+    os.path.join("rca_tpu", "serve", "client.py"): set(),
+    os.path.join("rca_tpu", "serve", "metrics.py"): set(),
 }
 
 
