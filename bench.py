@@ -54,6 +54,25 @@ def chaos_metrics(seed: int = 7, ticks: int = 100) -> dict:
     }
 
 
+def lint_metrics() -> dict:
+    """graftlint wall time (ISSUE 4 satellite): the analyzer gates every
+    PR, so its cost is tracked like any other latency — if a new rule
+    makes ``rca lint`` crawl, this row catches it before the gate starts
+    getting skipped.  ``findings`` must stay 0 (the repo ships clean with
+    an empty baseline; ANALYSIS.md)."""
+    from rca_tpu.analysis import run_lint
+
+    result = run_lint()
+    slowest = max(result.per_rule_ms.items(), key=lambda kv: kv[1])
+    return {
+        "wall_ms": round(result.wall_ms, 1),
+        "files": result.files_scanned,
+        "findings": len(result.findings),
+        "slowest_rule": slowest[0],
+        "slowest_rule_ms": round(slowest[1], 1),
+    }
+
+
 def serve_throughput_metrics(
     engine, case, concurrency: int = 16, n_requests: int = 64,
 ) -> dict:
@@ -765,6 +784,8 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         "noisyor_path": noisyor_choice,
         "xla_noisyor_50k_ms": r(xla_nor_ms),
         "pallas_noisyor_50k_ms": r(pallas_nor_ms),
+        # analyzer wall time: lint gates every PR, so it is benched too
+        "graftlint": lint_metrics(),
         "backend": "jax",
         "engine": result.engine,  # which engine the analyze boundary ran
     }

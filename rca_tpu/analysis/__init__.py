@@ -1,0 +1,39 @@
+"""graftlint: JAX/TPU-aware static analysis for this codebase (ANALYSIS.md).
+
+Usage::
+
+    python -m rca_tpu.analysis            # or: python -m rca_tpu lint
+    python -m rca_tpu.analysis --json
+    python -m rca_tpu.analysis --tracecheck
+
+Programmatic surface: :func:`run_lint` (static rules),
+:func:`run_tracecheck` (dynamic recompile gate), :func:`all_rules`.
+"""
+
+from rca_tpu.analysis.core import (
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    default_baseline_path,
+    load_baseline,
+    register,
+    repo_root,
+    run_lint,
+    write_baseline,
+)
+from rca_tpu.analysis.tracecheck import run_tracecheck
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "default_baseline_path",
+    "load_baseline",
+    "register",
+    "repo_root",
+    "run_lint",
+    "run_tracecheck",
+    "write_baseline",
+]

@@ -1,0 +1,153 @@
+"""``python -m rca_tpu.analysis`` / ``rca lint``: the graftlint CLI.
+
+Exit-code contract (stable for CI):
+
+- **0** — no findings (suppressed/baselined hits do not count); with
+  ``--tracecheck``, additionally no second-call recompilation;
+- **1** — findings (or a tracecheck recompile);
+- **2** — usage or internal error (unknown rule, malformed baseline).
+
+``--json`` emits one machine-readable JSON object on stdout and nothing
+else — the same stdout hygiene contract as bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from rca_tpu.analysis.core import (
+    all_rules,
+    default_baseline_path,
+    repo_root,
+    run_lint,
+    write_baseline,
+)
+
+EPILOG = """\
+suppressions:
+  # graftlint: disable=<rule>[,<rule>]    on the flagged line
+  # graftlint: disable-file=<rule>        anywhere in the file
+  (the rule name `all` disables every rule)
+
+baseline:
+  accepted legacy hits live in rca_tpu/analysis/baseline.json as content
+  fingerprints; --write-baseline regenerates it from the current findings
+  (policy: new-rule violations get FIXED, not baselined — see ANALYSIS.md)
+"""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rca lint",
+        description=("graftlint: JAX/TPU-aware static analysis — tracer "
+                     "leaks, retrace hazards, RNG key reuse, lock and env "
+                     "discipline, tick-sync and swallowed-fault contracts "
+                     "(ANALYSIS.md)"),
+        epilog=EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: the repo scan set)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset (see --list-rules)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable JSON on stdout (sole output)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: rca_tpu/analysis/"
+                   "baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined hits too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into the baseline")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the registered rules and exit")
+    p.add_argument("--tracecheck", action="store_true",
+                   help="also jit the public engine entry points twice "
+                   "and fail on second-call recompilation")
+    p.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    root = args.root or repo_root()
+
+    if args.list_rules:
+        rules = all_rules()
+        if args.as_json:
+            print(json.dumps({
+                name: {"summary": r.summary, "why": r.why}
+                for name, r in rules.items()
+            }, indent=2))
+        else:
+            for name, r in rules.items():
+                print(f"{name:18s} {r.summary}")
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        result = run_lint(
+            root=root, rules=rules,
+            baseline_path=args.baseline,
+            paths=args.paths or None,
+            use_baseline=not args.no_baseline,
+        )
+    except (KeyError, FileNotFoundError, ValueError) as exc:
+        print(f"graftlint: error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        bpath = args.baseline or default_baseline_path(root)
+        write_baseline(bpath, result.findings)
+        if not args.as_json:
+            print(f"graftlint: wrote {len(result.findings)} entr"
+                  f"{'y' if len(result.findings) == 1 else 'ies'} to "
+                  f"{bpath}")
+        return 0
+
+    trace = None
+    if args.tracecheck:
+        from rca_tpu.analysis.tracecheck import run_tracecheck
+
+        trace = run_tracecheck()
+
+    if args.as_json:
+        out = result.to_dict()
+        if trace is not None:
+            out["tracecheck"] = trace
+            out["clean"] = out["clean"] and trace["ok"]
+        print(json.dumps(out))
+        return 0 if out["clean"] else 1
+
+    for f in result.findings:
+        print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+        if f.snippet:
+            print(f"  | {f.snippet}")
+    for e in result.stale_baseline:
+        print(f"graftlint: stale baseline entry {e['rule']} @ {e['path']} "
+              f"({e['fingerprint']}) — the code it excused is gone; "
+              "remove it (or --write-baseline)")
+    counts = (f"{len(result.findings)} finding(s), "
+              f"{result.suppressed} suppressed, "
+              f"{result.baselined} baselined, "
+              f"{result.files_scanned} files in "
+              f"{result.wall_ms:.0f} ms")
+    if trace is not None:
+        for e in trace["entries"]:
+            status = "ok" if e["ok"] else (
+                f"RECOMPILED {e['recompiles']}x ({', '.join(e['recompiled'])})"
+            )
+            print(f"tracecheck: {e['entry']}: {status} "
+                  f"[warmup {e['warmup_compiles']} compiles, "
+                  f"{e['wall_ms']:.0f} ms]")
+    clean = result.clean and (trace is None or trace["ok"])
+    print(f"graftlint: {'clean' if clean else 'FAILED'} ({counts})")
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,347 @@
+"""graftlint core: rule registry, suppressions, baseline, runner.
+
+The framework behind ``rca lint`` (ANALYSIS.md).  PR 1 and PR 2 each
+shipped an invariant defended by a bespoke script (``tools/lint_*.py``);
+this package replaces one-rule-one-script with a pluggable AST analyzer
+so the next invariant is a ~50-line rule module, not another parallel
+toolchain.  The moving parts:
+
+- :class:`Rule` subclasses register themselves via :func:`register`; each
+  rule scopes itself (``applies_to``), carries per-file/per-function
+  allowlists (``allow``), and emits :class:`Finding`\\ s from one shared
+  parse of each file;
+- ``# graftlint: disable=<rule>[,<rule>]`` on a flagged line suppresses it;
+  ``# graftlint: disable-file=<rule>`` anywhere in a file suppresses the
+  rule for the whole file (``all`` works in both);
+- a checked-in baseline (``rca_tpu/analysis/baseline.json``) holds
+  accepted legacy hits as content fingerprints (rule + path + source
+  line), so baselined findings survive line drift but die with the code
+  that earned them; stale entries are reported so the baseline only ever
+  shrinks;
+- exit-code contract (``python -m rca_tpu.analysis``): 0 clean, 1
+  findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# scanned by default, relative to the repo root (rules narrow further via
+# applies_to; tests are included so e.g. swallowed-fault hygiene covers
+# the test suite exactly as the PR-1 script did)
+SCAN_DIRS = ("rca_tpu", "tools", "tests")
+SCAN_FILES = ("bench.py",)
+
+_SUPPRESS_LINE = re.compile(r"#\s*graftlint:\s*disable=([\w,\- ]+)")
+_SUPPRESS_FILE = re.compile(r"^\s*#\s*graftlint:\s*disable-file=([\w,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    message: str
+    snippet: str = ""  # stripped source of the flagged line
+    func: str = ""     # enclosing function ("<module>" at top level)
+
+    def fingerprint(self) -> str:
+        """Content fingerprint for the baseline: stable across pure line
+        drift (code above moving), invalidated when the flagged line
+        itself changes — a baselined hit cannot silently mutate."""
+        blob = f"{self.rule}|{self.path}|{self.snippet}"
+        return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message, "snippet": self.snippet,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+class FileContext:
+    """One parsed file, shared by every rule that scans it."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.AST):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.cache: Dict[str, object] = {}  # cross-rule analysis memos
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: "Rule", lineno: int, message: str,
+                func: str = "") -> Finding:
+        return Finding(
+            rule=rule.name, path=self.relpath, line=lineno,
+            message=message, snippet=self.line(lineno), func=func,
+        )
+
+    def file_suppressed(self) -> Set[str]:
+        """Rule names disabled for the whole file."""
+        out: Set[str] = set()
+        for line in self.lines:
+            m = _SUPPRESS_FILE.match(line)
+            if m:
+                out |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+        return out
+
+    def line_suppressed(self, lineno: int) -> Set[str]:
+        """Rule names disabled on one line (trailing comment)."""
+        m = _SUPPRESS_LINE.search(self.line(lineno))
+        if not m:
+            return set()
+        return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+class Rule:
+    """One lint rule.  Subclass, set ``name``/``summary``/``why``, implement
+    ``scan``, and decorate with :func:`register`."""
+
+    name: str = ""
+    summary: str = ""   # one line for --list-rules / README
+    why: str = ""       # the TPU/production failure mode this rule prevents
+    # per-file allowlist: repo-relative path -> function names exempt from
+    # this rule in that file (the framework filters on Finding.func)
+    allow: Dict[str, Set[str]] = {}
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate the rule and add it to the registry."""
+    rule = cls()
+    if not rule.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if rule.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    _REGISTRY[rule.name] = rule
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """The registry, importing the bundled rule modules on first use."""
+    import rca_tpu.analysis.rules  # noqa: F401  (registers on import)
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def default_baseline_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or repo_root(), "rca_tpu", "analysis",
+                        "baseline.json")
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str) -> List[dict]:
+    """Baseline entries (``[]`` when the file is absent)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("entries", [])
+    for e in entries:
+        if not {"rule", "path", "fingerprint"} <= set(e):
+            raise ValueError(f"malformed baseline entry: {e!r}")
+    return entries
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "fingerprint": f.fingerprint(),
+         "snippet": f.snippet}
+        for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line))
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+# -- runner -----------------------------------------------------------------
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: int
+    baselined: int
+    stale_baseline: List[dict]
+    files_scanned: int
+    wall_ms: float
+    per_rule_ms: Dict[str, float]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict:
+        return {
+            "clean": self.clean,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "stale_baseline": self.stale_baseline,
+            "files_scanned": self.files_scanned,
+            "wall_ms": round(self.wall_ms, 3),
+            "per_rule_ms": {
+                k: round(v, 3) for k, v in sorted(self.per_rule_ms.items())
+            },
+        }
+
+
+def discover_files(root: str, paths: Optional[Sequence[str]] = None
+                   ) -> List[str]:
+    """Repo-relative paths (forward slashes) to scan.  Explicit ``paths``
+    (files or directories, relative to root or absolute) override the
+    default scan set."""
+    rels: List[str] = []
+    if paths:
+        for p in paths:
+            full = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isdir(full):
+                for dirpath, _dirs, files in os.walk(full):
+                    rels += [
+                        os.path.join(dirpath, f)
+                        for f in files if f.endswith(".py")
+                    ]
+            elif os.path.exists(full):
+                rels.append(full)
+            else:
+                raise FileNotFoundError(p)
+        return sorted(
+            os.path.relpath(r, root).replace(os.sep, "/") for r in rels
+        )
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, _dirs, files in os.walk(base):
+            rels += [
+                os.path.join(dirpath, f) for f in files if f.endswith(".py")
+            ]
+    rels += [
+        os.path.join(root, f) for f in SCAN_FILES
+        if os.path.exists(os.path.join(root, f))
+    ]
+    return sorted(
+        os.path.relpath(r, root).replace(os.sep, "/") for r in rels
+    )
+
+
+def run_lint(
+    root: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+    paths: Optional[Sequence[str]] = None,
+    use_baseline: bool = True,
+) -> LintResult:
+    """Run the selected rules over the repo (or ``paths``) and fold in
+    suppressions + baseline.  Pure function of the tree on disk."""
+    t0 = time.perf_counter()
+    root = root or repo_root()
+    registry = all_rules()
+    if rules:
+        unknown = set(rules) - set(registry)
+        if unknown:
+            raise KeyError(
+                f"unknown rule(s): {', '.join(sorted(unknown))} "
+                f"(have: {', '.join(registry)})"
+            )
+        selected = [registry[r] for r in rules]
+    else:
+        selected = list(registry.values())
+
+    raw: List[Finding] = []
+    suppressed = 0
+    per_rule_ms: Dict[str, float] = {r.name: 0.0 for r in selected}
+    files = discover_files(root, paths)
+    for rel in files:
+        full = os.path.join(root, rel)
+        applicable = [r for r in selected if r.applies_to(rel)]
+        if not applicable:
+            continue
+        try:
+            with open(full, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, OSError) as exc:
+            lineno = getattr(exc, "lineno", 0) or 0
+            raw.append(Finding(
+                rule="parse-error", path=rel, line=lineno,
+                message=f"{type(exc).__name__}: {exc}",
+            ))
+            continue
+        ctx = FileContext(rel, source, tree)
+        file_off = ctx.file_suppressed()
+        for rule in applicable:
+            if rule.name in file_off or "all" in file_off:
+                continue
+            rt0 = time.perf_counter()
+            for finding in rule.scan(ctx):
+                allowed_funcs = rule.allow.get(rel, set())
+                line_off = ctx.line_suppressed(finding.line)
+                if finding.func in allowed_funcs:
+                    continue
+                if rule.name in line_off or "all" in line_off:
+                    suppressed += 1
+                    continue
+                raw.append(finding)
+            per_rule_ms[rule.name] += (time.perf_counter() - rt0) * 1e3
+
+    # baseline filter: consume entries as a multiset so N identical
+    # baselined lines absorb exactly N findings, not unlimited ones
+    baselined = 0
+    stale: List[dict] = []
+    findings = raw
+    if use_baseline:
+        bpath = baseline_path or default_baseline_path(root)
+        entries = load_baseline(bpath)
+        budget = collections.Counter(
+            (e["rule"], e["path"], e["fingerprint"]) for e in entries
+        )
+        findings = []
+        for f in raw:
+            key = (f.rule, f.path, f.fingerprint())
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+                baselined += 1
+            else:
+                findings.append(f)
+        ran = {r.name for r in selected} | {"parse-error"}
+        scanned = set(files)
+        stale = [
+            {"rule": rule, "path": path, "fingerprint": fp, "count": n}
+            for (rule, path, fp), n in sorted(budget.items()) if n > 0
+            # only entries this run could have matched count as stale
+            if rule in ran and path in scanned
+        ]
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(
+        findings=findings, suppressed=suppressed, baselined=baselined,
+        stale_baseline=stale, files_scanned=len(files),
+        wall_ms=(time.perf_counter() - t0) * 1e3, per_rule_ms=per_rule_ms,
+    )

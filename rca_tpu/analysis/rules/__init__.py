@@ -1,0 +1,14 @@
+"""Bundled graftlint rules: importing this package registers them all.
+
+One module per rule (plus :mod:`jitscan`, the shared JAX-aware AST
+helpers).  A new invariant is a new module here with a ``@register``
+class — see ANALYSIS.md for the authoring contract.
+"""
+
+from rca_tpu.analysis.rules import env       # noqa: F401
+from rca_tpu.analysis.rules import faults    # noqa: F401
+from rca_tpu.analysis.rules import locks     # noqa: F401
+from rca_tpu.analysis.rules import retrace   # noqa: F401
+from rca_tpu.analysis.rules import rng       # noqa: F401
+from rca_tpu.analysis.rules import ticksync  # noqa: F401
+from rca_tpu.analysis.rules import tracer    # noqa: F401

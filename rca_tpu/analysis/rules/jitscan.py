@@ -1,0 +1,249 @@
+"""Shared JAX-aware AST analysis: which functions trace under jit, which
+of their names hold traced values.
+
+Both the tracer-leak and retrace-hazard rules need the same two facts
+about a module:
+
+1. **jit-reachable functions** — decorated with ``jax.jit`` / ``jit`` /
+   ``functools.partial(jax.jit, ...)``, passed by name to a ``jax.jit(fn)``
+   call anywhere in the module, or nested inside either (a closure traced
+   by its enclosing jit function traces too);
+2. **traced names** inside such a function — parameters not named static
+   by ``static_argnames``/``static_argnums``, plus locals assigned from
+   expressions involving traced names or ``jnp``/``jax.lax`` calls.
+   Shape/dtype accessors (``x.shape``, ``x.ndim``, ``x.dtype``,
+   ``x.size``, ``len(x)``) are *static under trace* and deliberately do
+   not propagate taint — ``if x.shape[0] > 4`` is legal jit Python.
+
+This is a linter, not an abstract interpreter: the dataflow is a single
+forward pass per function, which is exactly enough to catch the bug
+classes that land in review (host branching on device values, per-call
+literals) without drowning the repo in false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+# calls whose results are traced arrays when they appear inside a jit
+# function (module roots; `jnp.zeros(...)`, `jax.lax.scan(...)`, ...)
+_TRACED_ROOTS = ("jnp", "lax")
+# attribute accesses that are static under trace even on a traced value
+STATIC_ATTRS = ("shape", "ndim", "dtype", "size", "weak_type", "sharding")
+
+
+def _dec_is_jit(dec: ast.expr) -> Optional[Tuple[Set[str], Set[int]]]:
+    """If ``dec`` marks a function as jit, return (static_argnames,
+    static_argnums); else None."""
+
+    def _is_jit_name(node: ast.expr) -> bool:
+        if isinstance(node, ast.Name) and node.id == "jit":
+            return True
+        return (
+            isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax"
+        )
+
+    if _is_jit_name(dec):
+        return set(), set()
+    if isinstance(dec, ast.Call):
+        f = dec.func
+        is_partial = (
+            (isinstance(f, ast.Name) and f.id == "partial")
+            or (isinstance(f, ast.Attribute) and f.attr == "partial")
+        )
+        if is_partial and dec.args and _is_jit_name(dec.args[0]):
+            return _static_kwargs(dec)
+        if _is_jit_name(f):  # @jax.jit(static_argnames=...) direct call form
+            return _static_kwargs(dec)
+    return None
+
+
+def _static_kwargs(call: ast.Call) -> Tuple[Set[str], Set[int]]:
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names |= _const_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            nums |= _const_ints(kw.value)
+    return names, nums
+
+
+def _const_strs(node: ast.expr) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in node.elts:
+            out |= _const_strs(e)
+        return out
+    return set()
+
+
+def _const_ints(node: ast.expr) -> Set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for e in node.elts:
+            out |= _const_ints(e)
+        return out
+    return set()
+
+
+class JitFunction:
+    """One jit-traced function plus its statically-known params."""
+
+    def __init__(self, node, static_names: Set[str], static_nums: Set[int]):
+        self.node = node
+        args = node.args
+        ordered = [a.arg for a in args.posonlyargs + args.args]
+        static = set(static_names)
+        static |= {ordered[i] for i in static_nums if i < len(ordered)}
+        self.params = set(ordered) | {a.arg for a in args.kwonlyargs}
+        self.static = static
+        self.traced_params = self.params - static
+
+
+def jit_functions(ctx) -> List["JitFunction"]:
+    """Per-file memo of :func:`collect_jit_functions` (several rules need
+    the same scan; the walk is the analyzer's most expensive pass)."""
+    if "jit_functions" not in ctx.cache:
+        ctx.cache["jit_functions"] = collect_jit_functions(ctx.tree)
+    return ctx.cache["jit_functions"]
+
+
+def collect_jit_functions(tree: ast.AST) -> List[JitFunction]:
+    """Every function in the module that traces under jit (see module
+    docstring for the three spellings), outermost only — nested defs are
+    analyzed as part of their enclosing jit function's body."""
+    # names passed to a bare jax.jit(fn, ...) call anywhere in the module
+    wrapped: Dict[str, Tuple[Set[str], Set[int]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _dec_is_jit(node.func) is not None:
+            if node.args and isinstance(node.args[0], ast.Name):
+                wrapped[node.args[0].id] = _static_kwargs(node)
+
+    out: List[JitFunction] = []
+    claimed: Set[ast.AST] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spec = None
+            for dec in node.decorator_list:
+                spec = _dec_is_jit(dec)
+                if spec is not None:
+                    break
+            if spec is None and node.name in wrapped:
+                spec = wrapped[node.name]
+            if spec is not None and node not in claimed:
+                out.append(JitFunction(node, *spec))
+                # nested defs belong to this traced body
+                for child in ast.walk(node):
+                    claimed.add(child)
+        for child in ast.iter_child_nodes(node):
+            if child not in claimed:
+                visit(child)
+
+    visit(tree)
+    return out
+
+
+def is_jnp_call(node: ast.expr, attrs: Optional[Set[str]] = None) -> bool:
+    """Is ``node`` a call like ``jnp.<attr>`` / ``jax.lax.<attr>`` /
+    ``jax.nn.<attr>`` (optionally restricted to ``attrs``)?"""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if attrs is not None and f.attr not in attrs:
+        return False
+    base = f.value
+    if isinstance(base, ast.Name) and base.id in _TRACED_ROOTS:
+        return True
+    if (isinstance(base, ast.Attribute)
+            and base.attr in ("lax", "nn", "numpy")
+            and isinstance(base.value, ast.Name) and base.value.id == "jax"):
+        return True
+    return False
+
+
+def involves_traced(node: ast.expr, traced: Set[str]) -> bool:
+    """Does evaluating ``node`` touch a traced value?  Shape/dtype/len
+    accesses are static under trace and terminate the walk."""
+
+    def walk(n: ast.AST) -> bool:
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return False
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Name) and f.id in ("len", "isinstance"):
+                return False
+            if is_jnp_call(n):
+                return True
+        if isinstance(n, ast.Name) and n.id in traced:
+            return True
+        return any(walk(c) for c in ast.iter_child_nodes(n))
+
+    return walk(node)
+
+
+def _target_names(target: ast.expr) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in target.elts:
+            out += _target_names(e)
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    return []
+
+
+def traced_names(fn: JitFunction) -> Set[str]:
+    """Single forward dataflow pass: the set of names that may hold traced
+    values anywhere in the function.  Conservative in ONE direction — a
+    name once tainted stays tainted (loops may re-bind in either order),
+    so rules only report constructs whose *test expression* touches the
+    set, which keeps false positives to genuinely suspicious lines."""
+    traced: Set[str] = set(fn.traced_params)
+
+    class Tainter(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            # nested defs: params are traced too (closures under trace)
+            traced.update(a.arg for a in node.args.args)
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Assign(self, node):
+            if involves_traced(node.value, traced):
+                for t in node.targets:
+                    traced.update(_target_names(t))
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            if involves_traced(node.value, traced):
+                traced.update(_target_names(node.target))
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            if involves_traced(node.iter, traced):
+                traced.update(_target_names(node.target))
+            self.generic_visit(node)
+
+    # two passes so later-defined helpers that feed earlier loops settle;
+    # visit the BODY (visiting fn.node itself would re-taint the static
+    # params via the nested-def branch)
+    for _ in range(2):
+        before = len(traced)
+        tainter = Tainter()
+        for stmt in fn.node.body:
+            tainter.visit(stmt)
+        if len(traced) == before:
+            break
+    return traced

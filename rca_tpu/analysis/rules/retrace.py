@@ -1,0 +1,183 @@
+"""Rule ``retrace-hazard``: constructs that silently recompile or
+re-upload per call on the tick/serve hot paths.
+
+Three shapes, each a real way the "compile once per bucket, dispatch
+async" contract dies without any test failing:
+
+1. **per-call jnp literals** — ``jnp.array([0.0, 1.0])`` built inside a
+   hot-path function re-uploads a host constant every call (an H2D
+   transfer on the latency path) and, as a fresh Python object, defeats
+   jit donation/caching heuristics.  Hoist to a module-level constant.
+   Scoped to the same hot-path modules as the tick-sync rule, where a
+   per-tick transfer is real money.
+2. **data-dependent output shapes under jit** — one-arg ``jnp.where``,
+   ``jnp.nonzero``/``flatnonzero``/``argwhere``/``unique`` without
+   ``size=`` have value-dependent shapes: under jit they either raise or
+   (with shape polymorphism) force a retrace per distinct cardinality.
+3. **unhashable static args** — a ``static_argnames`` parameter whose
+   default (or a same-module call-site value) is a list/dict/set literal
+   raises ``ValueError: unhashable static arguments`` only on the first
+   call that actually hits the default — typically in production, not in
+   the test that always passes the argument.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+from rca_tpu.analysis.rules.jitscan import is_jnp_call, jit_functions
+from rca_tpu.analysis.rules.ticksync import TICK_MODULES
+
+# hot-path modules where a per-call host->device constant upload matters
+HOT_MODULES = set(TICK_MODULES)
+
+DATA_DEP = ("nonzero", "flatnonzero", "argwhere", "unique")
+
+MESSAGE_LITERAL = (
+    "per-call jnp literal on the hot path — hoist to a module-level "
+    "constant (each call re-uploads the constant host->device on the "
+    "latency path)"
+)
+MESSAGE_DATA_DEP = (
+    "`jnp.{fn}` without size= inside a jit function — data-dependent "
+    "output shape: raises under jit, or retraces per distinct "
+    "cardinality"
+)
+MESSAGE_UNHASHABLE = (
+    "static argument `{arg}` takes an unhashable {kind} — jit static "
+    "args are cache keys and must hash; use a tuple (raises "
+    "`ValueError: unhashable static arguments` on first real call)"
+)
+
+
+def _is_const_literal(node: ast.expr) -> bool:
+    """A list/tuple literal of constants (possibly nested)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_const_literal(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _is_const_literal(node.operand)
+    return False
+
+
+@register
+class RetraceHazardRule(Rule):
+    name = "retrace-hazard"
+    summary = ("no per-call jnp literals on hot paths, no data-dependent "
+               "shapes or unhashable static args under jit")
+    why = ("each shape retraces or re-uploads silently: the latency "
+           "budget assumes one executable per shape bucket and zero "
+           "per-tick constant transfers")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("rca_tpu/")
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        hits: List[Finding] = []
+        hits += self._literal_uploads(ctx)
+        hits += self._data_dependent_shapes(ctx)
+        hits += self._unhashable_statics(ctx)
+        return hits
+
+    # -- 1: per-call literals on hot-path modules ---------------------------
+    def _literal_uploads(self, ctx: FileContext) -> List[Finding]:
+        if ctx.relpath not in HOT_MODULES:
+            return []
+        hits: List[Finding] = []
+
+        def walk(node: ast.AST, func: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = node.name
+            if (func != "<module>"
+                    and is_jnp_call(node, {"array", "asarray"})
+                    and node.args
+                    and isinstance(node.args[0], (ast.List, ast.Tuple))
+                    and _is_const_literal(node.args[0])):
+                hits.append(ctx.finding(self, node.lineno, MESSAGE_LITERAL,
+                                        func=func))
+            for child in ast.iter_child_nodes(node):
+                walk(child, func)
+
+        walk(ctx.tree, "<module>")
+        return hits
+
+    # -- 2: data-dependent shapes under jit ---------------------------------
+    def _data_dependent_shapes(self, ctx: FileContext) -> List[Finding]:
+        hits: List[Finding] = []
+        for fn in jit_functions(ctx):
+
+            def walk(node: ast.AST, func: str) -> None:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    func = node.name
+                if isinstance(node, ast.Call):
+                    kwargs = {kw.arg for kw in node.keywords}
+                    if "size" not in kwargs:
+                        if is_jnp_call(node, set(DATA_DEP)):
+                            hits.append(ctx.finding(
+                                self, node.lineno,
+                                MESSAGE_DATA_DEP.format(
+                                    fn=node.func.attr), func=func,
+                            ))
+                        elif (is_jnp_call(node, {"where"})
+                                and len(node.args) == 1):
+                            hits.append(ctx.finding(
+                                self, node.lineno,
+                                MESSAGE_DATA_DEP.format(fn="where"),
+                                func=func,
+                            ))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, func)
+
+            walk(fn.node, fn.node.name)
+        return hits
+
+    # -- 3: unhashable static args ------------------------------------------
+    def _unhashable_statics(self, ctx: FileContext) -> List[Finding]:
+        hits: List[Finding] = []
+        static_by_fn: dict = {}
+        for fn in jit_functions(ctx):
+            node = fn.node
+            static_by_fn[node.name] = fn.static
+            args = node.args
+            ordered = args.posonlyargs + args.args
+            # defaults align to the TAIL of the positional params
+            for param, default in zip(
+                ordered[len(ordered) - len(args.defaults):], args.defaults
+            ):
+                self._check_static_value(
+                    ctx, hits, fn.static, param.arg, default, node.name
+                )
+            for param, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None:
+                    self._check_static_value(
+                        ctx, hits, fn.static, param.arg, default, node.name
+                    )
+        # same-module call sites passing a literal for a static kwarg
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in static_by_fn):
+                continue
+            static = static_by_fn[node.func.id]
+            for kw in node.keywords:
+                if kw.arg in static:
+                    self._check_static_value(
+                        ctx, hits, static, kw.arg, kw.value, "<call>"
+                    )
+        return hits
+
+    def _check_static_value(self, ctx: FileContext, hits: List[Finding],
+                            static: Set[str], arg: str, value: ast.expr,
+                            func: str) -> None:
+        kind = {ast.List: "list", ast.Dict: "dict", ast.Set: "set",
+                ast.ListComp: "list", ast.DictComp: "dict",
+                ast.SetComp: "set"}.get(type(value))
+        if arg in static and kind is not None:
+            hits.append(ctx.finding(
+                self, value.lineno,
+                MESSAGE_UNHASHABLE.format(arg=arg, kind=kind), func=func,
+            ))

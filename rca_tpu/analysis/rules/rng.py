@@ -1,0 +1,192 @@
+"""Rule ``rng-key-reuse``: a ``jax.random`` key is consumed at most once.
+
+JAX PRNG keys are values, not stateful generators: passing the same key
+to two samplers yields **identical** (or pathologically correlated)
+draws.  In this codebase that failure mode is vicious precisely because
+nothing crashes — a domain-randomized training sweep or a chaos schedule
+silently loses entropy and every downstream accuracy number is quietly
+wrong.  The rule does a forward pass per function: names bound from
+``PRNGKey``/``key``/``split``/``fold_in`` are live keys; any other
+``jax.random.*`` call consumes its first argument; a second consumption
+without an intervening re-bind is a finding, as is any consumption
+inside a loop of a key created outside it (iteration two reuses it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+
+# jax.random calls that MAKE keys rather than consuming entropy for output
+KEY_MAKERS = ("PRNGKey", "key", "split", "fold_in", "wrap_key_data", "clone")
+
+MESSAGE_REUSE = (
+    "PRNG key `{name}` consumed twice without split — identical draws "
+    "from both sites (split the key, use a fresh subkey per consumer)"
+)
+MESSAGE_LOOP = (
+    "PRNG key `{name}` (created outside the loop) consumed inside a loop "
+    "body — iteration 2 reuses iteration 1's key; fold_in or split per "
+    "iteration"
+)
+
+
+def _random_aliases(tree: ast.AST) -> Set[str]:
+    """Module-level names bound to ``jax.random`` (``import jax.random as
+    jr`` / ``from jax import random [as r]``)."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                # bare `import jax.random` binds `jax`; the jax.random.<fn>
+                # attribute chain is matched structurally, not via aliases
+                if a.name == "jax.random" and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        aliases.add(a.asname or "random")
+    return aliases
+
+
+class _KeyTracker(ast.NodeVisitor):
+    """Forward pass over ONE function body (statement order)."""
+
+    def __init__(self, rule: Rule, ctx: FileContext, aliases: Set[str],
+                 func: str):
+        self.rule = rule
+        self.ctx = ctx
+        self.aliases = aliases
+        self.func = func
+        self.live: Set[str] = set()        # key names not yet consumed
+        self.consumed: Dict[str, int] = {}  # key name -> first-use line
+        self.loop_depth = 0
+        self.outer_keys: List[Set[str]] = []  # keys live at each loop entry
+        self.hits: List[Finding] = []
+
+    # -- helpers ------------------------------------------------------------
+    def _is_random_call(self, node: ast.expr) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return False
+        base = f.value
+        if isinstance(base, ast.Name) and base.id in self.aliases:
+            return True
+        # jax.random.<fn>
+        return (isinstance(base, ast.Attribute) and base.attr == "random"
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "jax")
+
+    def _consume(self, node: ast.Call) -> None:
+        """Record the key argument of a jax.random call as consumed."""
+        if not node.args:
+            return
+        arg = node.args[0]
+        if not isinstance(arg, ast.Name):
+            return
+        name = arg.id
+        if name in self.consumed:
+            self.hits.append(self.ctx.finding(
+                self.rule, node.lineno, MESSAGE_REUSE.format(name=name),
+                func=self.func,
+            ))
+            return
+        if self.loop_depth and any(
+            name in outer for outer in self.outer_keys
+        ):
+            self.hits.append(self.ctx.finding(
+                self.rule, node.lineno, MESSAGE_LOOP.format(name=name),
+                func=self.func,
+            ))
+            return
+        if name in self.live:
+            self.live.discard(name)
+            self.consumed[name] = node.lineno
+
+    def _bind(self, target: ast.expr) -> None:
+        """Targets of a key-producing expression become fresh live keys."""
+        if isinstance(target, ast.Name):
+            self.live.add(target.id)
+            self.consumed.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value)
+
+    # -- visitors -----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._is_random_call(node):
+            fn = node.func.attr
+            if fn not in KEY_MAKERS:
+                self._consume(node)
+            elif fn in ("split", "fold_in"):
+                # split/fold_in retire the parent key too: using it again
+                # after splitting is the same correlated-draws bug
+                self._consume(node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        if isinstance(node.value, ast.Call) \
+                and self._is_random_call(node.value) \
+                and node.value.func.attr in KEY_MAKERS:
+            for t in node.targets:
+                self._bind(t)
+        # subscripts of a split result: keys[0], keys[1]...
+        elif (isinstance(node.value, ast.Subscript)
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id in self.live | set(self.consumed)):
+            for t in node.targets:
+                self._bind(t)
+
+    def _visit_loop(self, node) -> None:
+        self.loop_depth += 1
+        self.outer_keys.append(set(self.live) | set(self.consumed))
+        self.generic_visit(node)
+        self.outer_keys.pop()
+        self.loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_FunctionDef(self, node) -> None:
+        # nested defs get their own tracker; don't mix key states
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@register
+class RngKeyReuseRule(Rule):
+    name = "rng-key-reuse"
+    summary = ("a jax.random key is consumed once — reuse without split "
+               "silently correlates draws")
+    why = ("two samplers fed the same key return identical values: "
+           "domain randomization, chaos schedules, and init all lose "
+           "entropy with zero crashes or test failures")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("rca_tpu/")
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        aliases = _random_aliases(ctx.tree)
+        hits: List[Finding] = []
+
+        def visit_functions(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    tracker = _KeyTracker(self, ctx, aliases, child.name)
+                    for stmt in child.body:
+                        tracker.visit(stmt)
+                    hits.extend(tracker.hits)
+                visit_functions(child)
+
+        visit_functions(ctx.tree)
+        return hits

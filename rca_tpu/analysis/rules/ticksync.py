@@ -1,0 +1,76 @@
+"""Rule ``tick-sync``: no device synchronization outside fetch on the
+tick/serve hot paths (absorbs ``tools/lint_tick_sync.py``, PR 2/3).
+
+The streaming tick pipeline and the serving scheduler only deliver their
+latency wins because JAX dispatch is async: tick N's device round trip
+hides behind tick N+1's host capture, batch N's behind batch N+1's
+assembly.  ONE stray ``jax.device_get`` / ``.block_until_ready()`` in a
+capture or dispatch path re-serializes the whole pipeline — silently,
+with no test failing, just the win gone.  The designated sync points are
+``StreamingHostState.fetch`` and ``BatchDispatcher.fetch`` (and only
+them): every module on the hot path below lists the functions allowed to
+synchronize; a sync spelling anywhere else in those files fails the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+
+# the banned synchronization spellings (attribute accesses — catches
+# jax.device_get, jax.block_until_ready, and x.block_until_ready())
+SYNC_ATTRS = ("device_get", "block_until_ready")
+
+# hot-path modules -> function names allowed to synchronize there
+TICK_MODULES = {
+    "rca_tpu/engine/streaming.py": {"fetch"},
+    "rca_tpu/parallel/streaming.py": {"fetch"},
+    "rca_tpu/engine/live.py": set(),
+    "rca_tpu/features/extract.py": set(),
+    "rca_tpu/cluster/snapshot.py": set(),
+    "rca_tpu/serve/dispatcher.py": {"fetch"},
+    "rca_tpu/serve/loop.py": set(),
+    "rca_tpu/serve/queue.py": set(),
+    "rca_tpu/serve/batcher.py": set(),
+    "rca_tpu/serve/client.py": set(),
+    "rca_tpu/serve/metrics.py": set(),
+}
+
+MESSAGE = (
+    "`{attr}` in the tick capture/dispatch path — device sync belongs "
+    "ONLY in StreamingHostState.fetch (it re-serializes the tick "
+    "pipeline; see PERF.md round-6)"
+)
+
+
+@register
+class TickSyncRule(Rule):
+    name = "tick-sync"
+    summary = ("no jax.device_get / block_until_ready outside fetch() on "
+               "the tick/serve hot paths")
+    why = ("a stray sync re-serializes the dispatch/fetch pipeline: the "
+           "device round trip stops hiding behind host capture and every "
+           "tick pays the full tunnel RTT again")
+    allow = TICK_MODULES
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath in TICK_MODULES
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        hits: List[Finding] = []
+
+        def walk(node: ast.AST, func: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = node.name
+            if isinstance(node, ast.Attribute) and node.attr in SYNC_ATTRS:
+                hits.append(ctx.finding(
+                    self, node.lineno, MESSAGE.format(attr=node.attr),
+                    func=func,
+                ))
+            for child in ast.iter_child_nodes(node):
+                walk(child, func)
+
+        walk(ctx.tree, "<module>")
+        return hits

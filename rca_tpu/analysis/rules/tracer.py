@@ -1,0 +1,82 @@
+"""Rule ``tracer-leak``: no host Python branching on traced arrays inside
+jit-reachable engine functions.
+
+``if``/``while``/``bool()``/``int()``/``float()`` on a traced array
+forces JAX to concretize the tracer.  Best case that raises
+``ConcretizationTypeError`` in CI; worst case (when the value happens to
+be weakly-typed or the branch sits behind a rarely-taken path) it
+silently splits the trace — the function recompiles per branch outcome
+and the "compiled once per bucket" latency story quietly dies (the GNN
+survey's classic host/device serialization trap, arXiv:2306.14052 §4).
+Inside jit, control flow belongs to ``jax.lax.cond`` / ``jnp.where`` /
+``jax.lax.while_loop``; host branching is fine on static arguments and
+on shapes (``x.shape[0]``), which the taint analysis treats as static.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+from rca_tpu.analysis.rules.jitscan import (
+    involves_traced,
+    jit_functions,
+    traced_names,
+)
+
+MESSAGE_BRANCH = (
+    "Python `{kind}` on a traced value inside a jit function — use "
+    "jax.lax.cond/jnp.where/jax.lax.while_loop (host branching "
+    "concretizes the tracer: ConcretizationTypeError, or a silent "
+    "per-branch retrace)"
+)
+MESSAGE_CAST = (
+    "`{kind}()` on a traced value inside a jit function — a host cast "
+    "concretizes the tracer and serializes the dispatch"
+)
+
+
+@register
+class TracerLeakRule(Rule):
+    name = "tracer-leak"
+    summary = ("no Python if/while/bool/int/float on traced arrays inside "
+               "jit-reachable functions")
+    why = ("a concretized tracer either crashes "
+           "(ConcretizationTypeError) or silently re-traces per branch "
+           "outcome, destroying the compile-once-per-bucket guarantee "
+           "the tick latency budget is built on")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("rca_tpu/")
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        hits: List[Finding] = []
+        for fn in jit_functions(ctx):
+            traced = traced_names(fn)
+
+            def walk(node: ast.AST, func: str) -> None:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    func = node.name
+                if isinstance(node, (ast.If, ast.While)):
+                    if involves_traced(node.test, traced):
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        hits.append(ctx.finding(
+                            self, node.lineno,
+                            MESSAGE_BRANCH.format(kind=kind), func=func,
+                        ))
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in ("bool", "int", "float")
+                        and node.args
+                        and involves_traced(node.args[0], traced)):
+                    hits.append(ctx.finding(
+                        self, node.lineno,
+                        MESSAGE_CAST.format(kind=node.func.id), func=func,
+                    ))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, func)
+
+            walk(fn.node, fn.node.name)
+        return hits

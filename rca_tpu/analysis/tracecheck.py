@@ -1,0 +1,199 @@
+"""Dynamic recompile gate: the public engine entry points compile once.
+
+The static rules (tracer-leak, retrace-hazard) catch the *code shapes*
+that cause silent recompilation; this companion closes the gap they
+cannot see by actually running each public entry point twice with
+identically-shaped inputs under ``jax_log_compiles`` and failing if the
+second call compiles anything.  A recompile on call two means some cache
+key changed between bit-identical calls — a fresh ``jnp`` constant, an
+unhashable static, a shape that escaped bucketing — exactly the
+regression class that lands with every test green and shows up weeks
+later as a 30 s stall on the first production tick of a new pod.
+
+Run via ``python -m rca_tpu.analysis --tracecheck`` (or ``rca lint
+--tracecheck``); tests/test_analysis.py gates it under tier-1.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+N_SERVICES = 24  # small synthetic graph: compile cost, not engine scale
+
+
+@contextlib.contextmanager
+def compile_log_capture(records: List[str]):
+    """Collect XLA "Compiling <name>" log lines emitted inside the block.
+
+    ``jax_log_compiles`` promotes the compile-path logs to WARNING on the
+    ``jax.*`` loggers; a handler on the package root sees them all.  The
+    logger's propagation is suspended so enabling the flag does not spray
+    compile chatter onto the caller's stderr."""
+    import jax
+
+    logger = logging.getLogger("jax")
+
+    class _Handler(logging.Handler):
+        def emit(self, record: logging.LogRecord) -> None:
+            msg = record.getMessage()
+            if msg.startswith("Compiling "):
+                records.append(msg)
+
+    handler = _Handler(level=logging.WARNING)
+    prev_level = logger.level
+    prev_propagate = logger.propagate
+    prev_handlers = list(logger.handlers)
+    prev_flag = jax.config.jax_log_compiles
+    # ours is the ONLY handler for the duration: jax installs its own
+    # stderr StreamHandler on the package logger, which would otherwise
+    # spray every promoted compile log onto the operator's terminal
+    logger.handlers = [handler]
+    if logger.level > logging.WARNING or logger.level == logging.NOTSET:
+        logger.setLevel(logging.WARNING)
+    logger.propagate = False
+    jax.config.update("jax_log_compiles", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_log_compiles", prev_flag)
+        logger.handlers = prev_handlers
+        logger.setLevel(prev_level)
+        logger.propagate = prev_propagate
+
+
+def _case():
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+
+    return synthetic_cascade_arrays(N_SERVICES, n_roots=1, seed=0)
+
+
+def _entry_analyze() -> Callable[[], None]:
+    from rca_tpu.engine.runner import GraphEngine
+
+    engine = GraphEngine()
+    case = _case()
+
+    def call() -> None:
+        engine.analyze_case(case, k=5)
+
+    return call
+
+
+def _entry_analyze_batch() -> Callable[[], None]:
+    import numpy as np
+
+    from rca_tpu.engine.sharded_runner import make_engine
+
+    engine = make_engine()
+    case = _case()
+    batch = np.repeat(np.asarray(case.features, np.float32)[None], 4, axis=0)
+
+    def call() -> None:
+        engine.analyze_batch(batch, case.dep_src, case.dep_dst,
+                             names=case.names, k=5)
+
+    return call
+
+
+def _entry_streaming_tick() -> Callable[[], None]:
+    import numpy as np
+
+    from rca_tpu.engine.streaming import StreamingSession
+
+    case = _case()
+    session = StreamingSession(
+        case.names, case.dep_src, case.dep_dst,
+        num_features=case.features.shape[1], k=5,
+    )
+    session.set_all(np.asarray(case.features, np.float32))
+    row = np.asarray(case.features[0], np.float32)
+
+    def call() -> None:
+        # one changed row per tick: the steady-state hot path
+        session.update(0, row)
+        session.tick()
+
+    return call
+
+
+def _entry_propagate() -> Callable[[], None]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rca_tpu.config import RCAConfig, bucket_for
+    from rca_tpu.engine.propagate import default_params, propagate_jit
+
+    case = _case()
+    cfg = RCAConfig()
+    n_pad = bucket_for(N_SERVICES + 1, cfg.shape_buckets)
+    e_pad = bucket_for(max(len(case.dep_src), 1), cfg.shape_buckets)
+    dummy = n_pad - 1
+    f = np.zeros((n_pad, case.features.shape[1]), np.float32)
+    f[:N_SERVICES] = case.features
+    s = np.full(e_pad, dummy, np.int32)
+    d = np.full(e_pad, dummy, np.int32)
+    s[: len(case.dep_src)] = case.dep_src
+    d[: len(case.dep_dst)] = case.dep_dst
+    features = jnp.asarray(f)
+    src = jnp.asarray(s)
+    dst = jnp.asarray(d)
+    p = default_params(cfg.propagation_steps)
+    aw, hw = p.weight_arrays()
+
+    def call() -> None:
+        propagate_jit(
+            features, src, dst, aw, hw, steps=p.steps, decay=p.decay,
+            explain_strength=p.explain_strength,
+            impact_bonus=p.impact_bonus,
+        )
+
+    return call
+
+
+ENTRY_POINTS: Dict[str, Callable[[], Callable[[], None]]] = {
+    "engine.analyze_case": _entry_analyze,
+    "engine.analyze_batch": _entry_analyze_batch,
+    "streaming.tick": _entry_streaming_tick,
+    "propagate_jit": _entry_propagate,
+}
+
+
+def run_tracecheck(
+    entries: Optional[List[str]] = None,
+) -> dict:
+    """Each entry point: warm-up call (compiles expected), then a second
+    bit-identical call that must be compile-free.  Returns a summary dict
+    with ``ok`` plus per-entry compile counts."""
+    selected: List[Tuple[str, Callable]] = [
+        (name, builder) for name, builder in ENTRY_POINTS.items()
+        if entries is None or name in entries
+    ]
+    if entries:
+        unknown = set(entries) - {n for n, _ in selected}
+        if unknown:
+            raise KeyError(f"unknown tracecheck entries: {sorted(unknown)}")
+    results = []
+    for name, builder in selected:
+        t0 = time.perf_counter()
+        call = builder()
+        warm: List[str] = []
+        second: List[str] = []
+        with compile_log_capture(warm):
+            call()
+        with compile_log_capture(second):
+            call()
+        results.append({
+            "entry": name,
+            "warmup_compiles": len(warm),
+            "recompiles": len(second),
+            "recompiled": sorted({m.split()[1] for m in second}),
+            "ok": not second,
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        })
+    return {
+        "ok": all(r["ok"] for r in results),
+        "entries": results,
+    }

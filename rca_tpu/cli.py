@@ -13,6 +13,8 @@
 - ``chaos``     seeded fault-injection soak over a synthetic world
 - ``serve``     multi-tenant serving scheduler (continuous shape-bucketed
                 batching; ``--selftest`` asserts the serving contract)
+- ``lint``      graftlint static analysis: JAX/TPU-correctness rules +
+                recompile tracecheck (``rca lint --help``; ANALYSIS.md)
 - ``investigations``  list / show persisted investigations
 - ``ui``        launch the Streamlit app (when streamlit is installed)
 
@@ -362,8 +364,7 @@ def cmd_chaos(args) -> int:
     bit-identical to a fault-free baseline session.  Exit 0 only when the
     contract holds.  ``--seed`` (or ``RCA_CHAOS_SEED``) seeds the fault
     schedule; ``--world-seed`` seeds the synthetic world."""
-    import os
-
+    from rca_tpu.config import env_int
     from rca_tpu.resilience.chaos import ChaosConfig, run_chaos_soak
 
     m = re.fullmatch(r"(\d+)svc", args.fixture or "50svc")
@@ -374,7 +375,7 @@ def cmd_chaos(args) -> int:
     n_services = int(m.group(1))
     seed = (
         args.seed if args.seed is not None
-        else int(os.environ.get("RCA_CHAOS_SEED", "7"))
+        else env_int("RCA_CHAOS_SEED", 7, 0, 2**31 - 1)
     )
 
     def make_world():
@@ -477,6 +478,16 @@ def cmd_serve(args) -> int:
         "metrics": loop.metrics.summary(),
     }, indent=None if args.compact else 2, default=str))
     return 0 if by_status.get("ok", 0) == args.requests else 1
+
+
+def cmd_lint(args) -> int:
+    """graftlint (ANALYSIS.md): delegate to the analyzer CLI so
+    ``rca lint ...`` and ``python -m rca_tpu.analysis ...`` are the same
+    tool with the same exit-code contract (0 clean / 1 findings /
+    2 usage error)."""
+    from rca_tpu.analysis.__main__ import main as lint_main
+
+    return lint_main(args.lint_args)
 
 
 def cmd_investigations(args) -> int:
@@ -677,6 +688,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_serve)
 
+    sp = sub.add_parser(
+        "lint",
+        help="graftlint static analysis: tracer leaks, retrace hazards, "
+        "RNG reuse, lock/env discipline, tick-sync + swallowed-fault "
+        "contracts; --tracecheck adds the dynamic recompile gate",
+        add_help=False,  # every flag (incl. --help) goes to the analyzer
+    )
+    sp.set_defaults(fn=cmd_lint, lint_args=[])
+
     sp = sub.add_parser("investigations", help="list/show investigations")
     sp.add_argument("--id", default=None)
     sp.add_argument("--log-dir", default="logs")
@@ -690,6 +710,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # `rca lint` forwards its whole tail to the analyzer's own parser
+    # (argparse.REMAINDER cannot: it refuses leading optionals)
+    if argv and argv[0] == "lint":
+        from rca_tpu.analysis.__main__ import main as lint_main
+
+        return lint_main(list(argv[1:]))
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -21,6 +21,7 @@ import shutil
 import subprocess
 from typing import Any, Dict, List, Optional
 
+from rca_tpu.config import env_int, env_raw
 from rca_tpu.findings import utcnow_iso
 from rca_tpu.resilience.policy import Retry, suppressed
 
@@ -108,13 +109,13 @@ class K8sApiClient:
         # reference: app.py:39-42)
         self._errors: List[Dict[str, str]] = []
         self._kubectl = shutil.which("kubectl")
-        self._kubeconfig = kubeconfig or os.environ.get("KUBECONFIG")
+        self._kubeconfig = kubeconfig or env_raw("KUBECONFIG")
         self._context = context
         self._verify_ssl = verify_ssl
         # transient API flakes retry with backoff before landing in the
         # degraded-mode error channel (RCA_API_RETRIES=0 disables)
         self._retry = Retry(
-            attempts=int(os.environ.get("RCA_API_RETRIES", "2")),
+            attempts=env_int("RCA_API_RETRIES", 2, 0, 100),
             base_delay=0.1, max_delay=2.0, seed=0,
         )
         self._connect()

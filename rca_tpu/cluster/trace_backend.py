@@ -49,6 +49,8 @@ import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
+from rca_tpu.config import env_raw, env_str
+
 DEFAULT_TIMEOUT_S = 5.0
 DEFAULT_LOOKBACK_S = 3600
 _MS = 1000.0  # Jaeger span times are microseconds
@@ -270,7 +272,7 @@ class JaegerTraceBackend:
 def make_trace_backend() -> Optional[JaegerTraceBackend]:
     """Backend from ``RCA_TRACE_ENDPOINT`` (unset → None, the empty-trace
     behavior the live client always had)."""
-    endpoint = (os.environ.get("RCA_TRACE_ENDPOINT") or "").strip()
+    endpoint = env_str("RCA_TRACE_ENDPOINT", "")
     if not endpoint:
         return None
     # accept an explicit scheme prefix ("jaeger:http://...") for future
@@ -279,5 +281,5 @@ def make_trace_backend() -> Optional[JaegerTraceBackend]:
         endpoint = endpoint[len("jaeger:"):]
     return JaegerTraceBackend(
         endpoint,
-        service_suffix=os.environ.get("RCA_TRACE_SERVICE_SUFFIX", ""),
+        service_suffix=env_raw("RCA_TRACE_SERVICE_SUFFIX", "") or "",
     )

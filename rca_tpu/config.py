@@ -16,6 +16,59 @@ from typing import Optional
 VALID_BACKENDS = ("jax", "deterministic", "llm")
 
 
+# -- central env access (graftlint: env-discipline) --------------------------
+# Every env read in rca_tpu/ goes through one of these three accessors (the
+# env-discipline rule in rca_tpu/analysis flags raw ``os.environ`` anywhere
+# else in the package), so each knob is validated in exactly one place and a
+# typo'd value fails loudly instead of silently selecting a default.
+
+def env_str(name: str, default: str = "", *, choices=None,
+            lower: bool = False) -> str:
+    """A string env knob; empty/unset means ``default`` (which is NOT
+    checked against ``choices`` — an unset knob is always legal)."""
+    raw = (os.environ.get(name) or "").strip()
+    if not raw:
+        return default
+    if lower:
+        raw = raw.lower()
+    if choices is not None and raw not in choices:
+        raise ValueError(
+            f"{name}={raw!r}: expected one of {tuple(choices)}"
+        )
+    return raw
+
+
+def env_int(name: str, default: int, lo: int, hi: int) -> int:
+    """A range-checked integer env knob; empty/unset means ``default``."""
+    raw = (os.environ.get(name) or "").strip()
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer in [{lo}, {hi}]")
+    if not lo <= value <= hi:
+        raise ValueError(f"{name}={value}: out of range [{lo}, {hi}]")
+    return value
+
+
+def env_int_opt(name: str, lo: int, hi: int) -> Optional[int]:
+    """Like :func:`env_int` but unset/empty means None (for knobs like
+    ``JAX_PROCESS_ID`` where 0 is a meaningful value and absence is a
+    signal of its own)."""
+    raw = (os.environ.get(name) or "").strip()
+    if not raw:
+        return None
+    return env_int(name, 0, lo, hi)
+
+
+def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """A free-form env value (path, address, API key): pass-through with
+    no validation beyond centralizing the read.  None when unset."""
+    value = os.environ.get(name)
+    return default if value is None else value
+
+
 @dataclasses.dataclass(frozen=True)
 class RCAConfig:
     # Correlation backend: jax | deterministic | llm

@@ -22,18 +22,18 @@ groups: {component: [finding,...]}, backend, summary}``.
 
 from __future__ import annotations
 
-import os
 import re
 from typing import AbstractSet, Any, Dict, List, Optional
 
 from rca_tpu.agents.base import AnalysisContext
+from rca_tpu.config import env_str
 from rca_tpu.findings import max_severity, severity_rank
 
 _SERVICE_SUFFIX = re.compile(r"-[a-z0-9]{8,10}-[a-z0-9]{5}$")
 
 
 def default_backend() -> str:
-    return os.environ.get("RCA_BACKEND", "jax").lower()
+    return env_str("RCA_BACKEND", "jax", lower=True)
 
 
 def _component_service(

@@ -27,6 +27,7 @@ import os
 import jax
 import jax.numpy as jnp
 
+from rca_tpu.config import env_str
 from rca_tpu.engine.propagate import _noisy_or
 
 BLOCK_S = 1024
@@ -94,7 +95,7 @@ def pallas_supported() -> bool:
     is a separate opt-in decision (:func:`pallas_enabled`), because the
     measured result on real TPU is a wash (module docstring)."""
     global _SUPPORTED
-    flag = os.environ.get("RCA_PALLAS", "auto")
+    flag = env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1"))
     if flag == "0":
         return False
     if _SUPPORTED is None:
@@ -122,7 +123,10 @@ def pallas_enabled() -> bool:
     Opt-in (``RCA_PALLAS=1``) because the kernel measures as a wash vs XLA
     on real TPU (module docstring) — capability is kept and proven by
     tests/bench, but the default hot path stays with XLA's fusion."""
-    return os.environ.get("RCA_PALLAS", "auto") == "1" and pallas_supported()
+    return (
+        env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1")) == "1"
+        and pallas_supported()
+    )
 
 
 _AUTOTUNED_PATH = None
@@ -147,7 +151,7 @@ def noisyor_autotune(refresh: bool = False) -> str:
     global _AUTOTUNED_PATH
     if _AUTOTUNED_PATH is not None and not refresh:
         return _AUTOTUNED_PATH
-    flag = os.environ.get("RCA_PALLAS", "auto")
+    flag = env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1"))
     if flag == "1":
         # forced: pallas_supported raises loudly if the compile fails
         pallas_supported()

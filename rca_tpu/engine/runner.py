@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rca_tpu.config import RCAConfig, bucket_for
+from rca_tpu.config import RCAConfig, bucket_for, env_raw, env_str
 from rca_tpu.engine.ell import EllGraph, propagate_ell
 from rca_tpu.engine.propagate import (
     PropagationParams,
@@ -131,13 +131,12 @@ def edge_layout() -> str:
     - ``ell``: both scans over width-capped gather tables + overflow
       (validated alternative for stacks where scatter lowers poorly;
       measured slower on v5e because hub fan-in forces a wide table)."""
-    # `or`: an empty env var conventionally means unset, not an error
-    layout = (os.environ.get("RCA_EDGE_LAYOUT") or "hybrid").lower()
-    if layout not in ("hybrid", "coo", "ell"):
-        raise ValueError(
-            f"RCA_EDGE_LAYOUT={layout!r}: expected hybrid, coo, or ell"
-        )
-    return layout
+    # empty env var conventionally means unset, not an error; a typo'd
+    # layout fails loudly inside the choice-validated accessor
+    return env_str(
+        "RCA_EDGE_LAYOUT", "hybrid", choices=("hybrid", "coo", "ell"),
+        lower=True,
+    )
 
 
 @functools.partial(
@@ -348,7 +347,7 @@ def resolve_params(
     a checkpoint must not silently disable the documented config knob
     (its recorded steps value is training metadata)."""
     if params is None:
-        ckpt = os.environ.get("RCA_WEIGHTS")
+        ckpt = env_raw("RCA_WEIGHTS")
         if ckpt and ckpt.lower() in ("off", "none", "defaults"):
             return default_params(config.propagation_steps)
         from rca_tpu.engine.train import load_params, packaged_params

@@ -45,6 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from rca_tpu.config import env_int, env_str
+
 LANES = 128
 # beyond this edge tier the [R, 128] working set stops fitting VMEM
 # comfortably (4 live copies of e_pad * 4 bytes)
@@ -106,7 +108,7 @@ def interpret_mode() -> bool:
     interpret engages automatically when the default backend is not TPU, so
     a forced ``RCA_SEGSCAN=1`` on CPU/GPU runs the kernel through the
     interpreter instead of crashing at Mosaic dispatch (ADVICE r4)."""
-    env = (os.environ.get("SEGSCAN_INTERPRET") or "").strip()
+    env = env_str("SEGSCAN_INTERPRET", "", choices=("0", "1"))
     if env:
         return env == "1"
     try:
@@ -267,16 +269,16 @@ def segscan_engaged(n_pad: int, e_pad: int) -> bool:
     """Static host-side decision per (backend, tier, env).  A forced
     ``RCA_SEGSCAN=1`` is safe on any backend: off-TPU the kernel runs in
     interpret mode automatically (:func:`interpret_mode`)."""
-    mode = (os.environ.get("RCA_SEGSCAN") or "").strip()
+    mode = env_str("RCA_SEGSCAN", "", choices=("0", "1"))
     if mode == "0":
         return False
     if e_pad % LANES or e_pad > MAX_EPAD:
         return False
-    if os.environ.get("SEGSCAN_INTERPRET") == "1" or mode == "1":
+    if env_str("SEGSCAN_INTERPRET", "", choices=("0", "1")) == "1" or mode == "1":
         return True
     try:
         on_tpu = jax.devices()[0].platform == "tpu"
     except Exception:
         return False
-    min_npad = int(os.environ.get("RCA_SEGSCAN_MIN", "1024"))
+    min_npad = env_int("RCA_SEGSCAN_MIN", 1024, 0, 2**31 - 1)
     return on_tpu and n_pad >= min_npad

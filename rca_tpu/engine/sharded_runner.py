@@ -30,7 +30,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from rca_tpu.config import RCAConfig, bucket_for
+from rca_tpu.config import RCAConfig, bucket_for, env_str
 from rca_tpu.engine.propagate import PropagationParams
 from rca_tpu.engine.runner import (
     EngineAPI,
@@ -243,7 +243,7 @@ def shard_requested() -> Tuple[bool, Optional[str]]:
     ``RCA_SHARD=0/off/single`` forces the single-device engine;
     anything else ("auto", "sp=4,dp=2") forces sharding with that layout.
     """
-    spec = os.environ.get("RCA_SHARD", "").strip().lower()
+    spec = env_str("RCA_SHARD", "", lower=True)
     if spec in ("0", "off", "single", "none", "false"):
         return False, None
     if spec:

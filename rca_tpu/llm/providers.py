@@ -20,6 +20,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+
+from rca_tpu.config import env_raw, env_str
 from typing import Any, Callable, Dict, List, Optional
 
 DEFAULT_OPENAI_MODEL = "gpt-4o"
@@ -77,7 +79,7 @@ class OpenAIProvider(Provider):
     name = "openai"
 
     def __init__(self, model: str = DEFAULT_OPENAI_MODEL):
-        key = os.environ.get("OPENAI_API_KEY")
+        key = env_raw("OPENAI_API_KEY")
         if not key:
             raise LLMUnavailable("OPENAI_API_KEY is not set")
         try:
@@ -151,7 +153,7 @@ class AnthropicProvider(Provider):
     name = "anthropic"
 
     def __init__(self, model: str = DEFAULT_ANTHROPIC_MODEL):
-        key = os.environ.get("ANTHROPIC_API_KEY")
+        key = env_raw("ANTHROPIC_API_KEY")
         if not key:
             raise LLMUnavailable("ANTHROPIC_API_KEY is not set")
         try:
@@ -336,7 +338,7 @@ def make_provider(name: Optional[str] = None) -> Provider:
     anthropic/openai whose key+SDK is available, else offline (reference
     default order: app.py:45-67).
     """
-    name = (name or os.environ.get("RCA_LLM_PROVIDER") or "").lower()
+    name = (name or env_str("RCA_LLM_PROVIDER", "")).lower()
     if name == "openai":
         return OpenAIProvider()
     if name == "anthropic":

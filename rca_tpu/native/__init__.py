@@ -26,6 +26,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from rca_tpu.config import env_raw, env_str
+
 D = "\x01"   # one digit
 W = "\x02"   # one or more word chars
 WS0 = "\x03"  # zero or more whitespace
@@ -155,7 +157,7 @@ def _compile_cached(source: Path, out_prefix: str,
     # ABI-independent but rides the same scheme harmlessly)
     abi = sysconfig.get_config_var("SOABI") or "unknown-abi"
     tag = hashlib.sha256(src + abi.encode()).hexdigest()[:16]
-    env_dir = os.environ.get("RCA_NATIVE_CACHE")
+    env_dir = env_raw("RCA_NATIVE_CACHE")
     if env_dir:
         # an explicitly-configured path may be the user's own symlink to a
         # private scratch dir; check the TARGET's ownership, not the
@@ -267,7 +269,7 @@ def load_native() -> Optional[ctypes.CDLL]:
     if _load_attempted:
         return _lib
     _load_attempted = True
-    if os.environ.get("RCA_NATIVE_SCAN", "auto") == "0":
+    if env_str("RCA_NATIVE_SCAN", "auto", choices=("auto", "0", "1")) == "0":
         return None
     path = _build_library()
     if path is None:
@@ -337,7 +339,8 @@ def load_sanitize():
     if _san_load_attempted:
         return _san_mod
     _san_load_attempted = True
-    if os.environ.get("RCA_NATIVE_SANITIZE", "auto") == "0":
+    if env_str("RCA_NATIVE_SANITIZE", "auto",
+               choices=("auto", "0", "1")) == "0":
         return None
     path = _build_sanitize_ext()
     if path is None:

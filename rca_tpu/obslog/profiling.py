@@ -13,6 +13,8 @@ from __future__ import annotations
 import contextlib
 import os
 import time
+
+from rca_tpu.config import env_raw
 from typing import Dict, List, Optional
 
 
@@ -102,7 +104,7 @@ class PhaseStats:
 @contextlib.contextmanager
 def maybe_jax_profile(tag: str):
     """Device trace when RCA_JAX_PROFILE=<dir> is set; no-op otherwise."""
-    trace_dir: Optional[str] = os.environ.get("RCA_JAX_PROFILE")
+    trace_dir: Optional[str] = env_raw("RCA_JAX_PROFILE")
     if not trace_dir:
         yield
         return

@@ -27,6 +27,8 @@ from typing import Any, Dict, Optional
 
 import jax
 
+from rca_tpu.config import env_int_opt, env_raw
+
 _initialized = False
 
 
@@ -44,19 +46,19 @@ def initialize_distributed(
     """
     global _initialized
 
-    coordinator_address = coordinator_address or os.environ.get(
+    coordinator_address = coordinator_address or env_raw(
         "JAX_COORDINATOR_ADDRESS"
     )
-    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
-        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
-    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
-        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if num_processes is None:
+        num_processes = env_int_opt("JAX_NUM_PROCESSES", 1, 2**31 - 1)
+    if process_id is None:
+        process_id = env_int_opt("JAX_PROCESS_ID", 0, 2**31 - 1)
 
     # TPU pods auto-detect all three through the TPU metadata server; only
     # skip when nothing indicates a multi-process run at all.
     on_tpu_pod = bool(
-        os.environ.get("TPU_WORKER_HOSTNAMES")
-        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        env_raw("TPU_WORKER_HOSTNAMES")
+        or env_raw("MEGASCALE_COORDINATOR_ADDRESS")
     )
 
     # recognize a runtime someone else already brought up, so a second

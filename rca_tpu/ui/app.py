@@ -10,8 +10,7 @@ components/visualization.py).  Run via ``python -m rca_tpu ui`` or
 
 from __future__ import annotations
 
-import os
-
+from rca_tpu.config import env_str
 from rca_tpu.ui.render import (
     analysis_chart_series,
     comprehensive_chart_series,
@@ -34,7 +33,7 @@ def _build_services():
     from rca_tpu.obslog import EvidenceLogger, get_logger
     from rca_tpu.store import InvestigationStore
 
-    fixture = os.environ.get("RCA_FIXTURE", "")
+    fixture = env_str("RCA_FIXTURE", "")
     if fixture:
         from rca_tpu.cluster.fixtures import five_service_world
         from rca_tpu.cluster.mock_client import MockClusterClient

@@ -1,0 +1,407 @@
+"""graftlint (rca_tpu/analysis, ANALYSIS.md): every rule fires on its
+fixture, suppressions and the baseline round-trip, the repo itself is
+clean with an EMPTY baseline, and the dynamic tracecheck proves the
+public engine entry points compile once."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from rca_tpu.analysis import (
+    all_rules,
+    default_baseline_path,
+    load_baseline,
+    repo_root,
+    run_lint,
+    write_baseline,
+)
+
+ROOT = repo_root()
+
+
+# ---------------------------------------------------------------------------
+# fixture snippets: one failing example per rule.  Each entry is
+# (rule, path-inside-a-fake-repo, source, expected minimum finding count).
+# ---------------------------------------------------------------------------
+
+FIXTURES = {
+    "tracer-leak": ("rca_tpu/engine/bad_tracer.py", """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    if y > 0:                      # host branch on a traced value
+        return y
+    return float(y)                # host cast on a traced value
+""", 2),
+    "retrace-hazard": ("rca_tpu/engine/streaming.py", """\
+import functools
+import jax
+import jax.numpy as jnp
+
+def capture():
+    return jnp.array([1.0, 2.0])   # per-call literal on the hot path
+
+@jax.jit
+def g(x):
+    return jnp.where(x > 0)        # data-dependent output shape
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def h(x, opts=[1, 2]):             # unhashable static default
+    return x
+""", 3),
+    "rng-key-reuse": ("rca_tpu/engine/bad_rng.py", """\
+import jax
+
+def sample():
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))   # same key, second draw
+    return a, b
+
+def loopy():
+    key = jax.random.PRNGKey(0)
+    out = []
+    for _ in range(4):
+        out.append(jax.random.normal(key, (2,)))  # reused per iteration
+    return out
+""", 2),
+    "lock-discipline": ("rca_tpu/serve/bad_locks.py", """\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def racy_put(self, x):
+        self._items.append(x)      # lock-owned attr, no lock held
+
+    def leaky(self):
+        self._lock.acquire()       # no try/finally release
+        self._items.pop()
+        self._lock.release()
+""", 2),
+    "env-discipline": ("rca_tpu/engine/bad_env.py", """\
+import os
+
+def depth():
+    return int(os.environ.get("RCA_PIPELINE_DEPTH", "1"))
+""", 1),
+    "tick-sync": ("rca_tpu/engine/live.py", """\
+import jax
+
+class S:
+    def poll(self):
+        return jax.device_get(self.x)   # sync outside fetch
+""", 1),
+    "swallowed-faults": ("rca_tpu/agents/bad_faults.py", """\
+def f():
+    try:
+        g()
+    except Exception:
+        pass
+""", 1),
+}
+
+
+def _fake_repo(tmp_path, *entries):
+    """A minimal repo layout holding the given (relpath, source) files."""
+    for rel, src in entries:
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(src)
+    return str(tmp_path)
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_fires_on_fixture(tmp_path, rule):
+    rel, src, expected = FIXTURES[rule]
+    root = _fake_repo(tmp_path, (rel, src))
+    result = run_lint(root=root, rules=[rule], use_baseline=False)
+    got = [f for f in result.findings if f.rule == rule]
+    assert len(got) >= expected, (
+        f"{rule} found {len(got)} < {expected}: {result.findings}"
+    )
+    for f in got:
+        assert f.path == rel
+        assert f.snippet  # human output carries the flagged source line
+
+
+def test_clean_twin_fixtures_pass(tmp_path):
+    """The corrected twin of each fixture produces zero findings — the
+    rules flag the bug, not the neighborhood."""
+    root = _fake_repo(
+        tmp_path,
+        ("rca_tpu/engine/good_tracer.py", """\
+import functools
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    return jnp.where(y > 0, y, -y)
+
+@functools.partial(jax.jit, static_argnames=("debug",))
+def g(x, debug=False):
+    if debug:                     # static arg: host branch is fine
+        return x * 0
+    if x.shape[0] > 4:            # shapes are static under trace
+        return x
+    return -x
+"""),
+        ("rca_tpu/engine/good_rng.py", """\
+import jax
+
+def sample():
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    return jax.random.normal(k1, (3,)), jax.random.uniform(k2, (3,))
+"""),
+        ("rca_tpu/serve/good_locks.py", """\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def legacy_put(self, x):
+        self._lock.acquire()
+        try:
+            self._items.append(x)
+        finally:
+            self._lock.release()
+"""),
+    )
+    result = run_lint(root=root, use_baseline=False)
+    assert result.clean, result.findings
+
+
+def test_static_arg_branching_not_flagged():
+    """Regression guard for the taint pass: the real engine branches on
+    static_argnames params (use_pallas, error_contrast) inside jit — the
+    exact pattern that must stay legal."""
+    result = run_lint(
+        root=ROOT, rules=["tracer-leak"], use_baseline=False,
+        paths=["rca_tpu/engine/runner.py", "rca_tpu/engine/streaming.py",
+               "rca_tpu/engine/ell.py"],
+    )
+    assert result.clean, result.findings
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+# ---------------------------------------------------------------------------
+
+def test_line_suppression(tmp_path):
+    rel, src, _ = FIXTURES["env-discipline"]
+    src = src.replace(
+        'return int(os.environ.get("RCA_PIPELINE_DEPTH", "1"))',
+        'return int(os.environ.get("RCA_PIPELINE_DEPTH", "1"))'
+        '  # graftlint: disable=env-discipline',
+    )
+    root = _fake_repo(tmp_path, (rel, src))
+    result = run_lint(root=root, rules=["env-discipline"],
+                      use_baseline=False)
+    assert result.clean
+    assert result.suppressed == 1
+
+
+def test_file_suppression(tmp_path):
+    rel, src, _ = FIXTURES["swallowed-faults"]
+    src = "# graftlint: disable-file=swallowed-faults\n" + src
+    root = _fake_repo(tmp_path, (rel, src))
+    result = run_lint(root=root, rules=["swallowed-faults"],
+                      use_baseline=False)
+    assert result.clean
+
+
+def test_suppressing_all_rules(tmp_path):
+    rel, src, _ = FIXTURES["tick-sync"]
+    src = src.replace(
+        "jax.device_get(self.x)   # sync outside fetch",
+        "jax.device_get(self.x)  # graftlint: disable=all",
+    )
+    root = _fake_repo(tmp_path, (rel, src))
+    result = run_lint(root=root, use_baseline=False)
+    assert result.clean
+
+
+def test_baseline_round_trip(tmp_path):
+    rel, src, expected = FIXTURES["rng-key-reuse"]
+    root = _fake_repo(tmp_path, (rel, src))
+    bpath = str(tmp_path / "baseline.json")
+
+    first = run_lint(root=root, use_baseline=False)
+    assert len(first.findings) >= expected
+    write_baseline(bpath, first.findings)
+
+    # accepted hits vanish; nothing is stale while the code stands
+    second = run_lint(root=root, baseline_path=bpath)
+    assert second.clean
+    assert second.baselined == len(first.findings)
+    assert second.stale_baseline == []
+
+    # fixing the code turns the entries stale (the baseline only shrinks)
+    (tmp_path / rel).write_text("""\
+import jax
+
+def sample():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    return jax.random.normal(k1, (3,)), jax.random.uniform(k2, (3,))
+""")
+    third = run_lint(root=root, baseline_path=bpath)
+    assert third.clean
+    assert third.baselined == 0
+    assert len(third.stale_baseline) >= 1
+
+
+def test_baseline_consumed_as_multiset(tmp_path):
+    """Two identical flagged lines need two baseline entries — one entry
+    must not absorb every future copy of the same bug."""
+    rel = "rca_tpu/engine/bad_env.py"
+    src = FIXTURES["env-discipline"][1]
+    root = _fake_repo(tmp_path, (rel, src))
+    bpath = str(tmp_path / "baseline.json")
+    write_baseline(bpath, run_lint(root=root, use_baseline=False).findings)
+
+    dup = src + "\n\ndef depth2():\n" \
+        "    return int(os.environ.get(\"RCA_PIPELINE_DEPTH\", \"1\"))\n"
+    (tmp_path / rel).write_text(dup)
+    result = run_lint(root=root, baseline_path=bpath)
+    assert len(result.findings) == 1  # the new copy is NOT absorbed
+
+
+# ---------------------------------------------------------------------------
+# repo-wide gates (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """THE gate: `rca lint` exits 0 on the repo."""
+    result = run_lint(root=ROOT)
+    assert result.clean, "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in result.findings
+    )
+
+
+def test_baseline_is_empty():
+    """Acceptance criterion: every violation the new rules found was
+    FIXED, not baselined."""
+    assert load_baseline(default_baseline_path(ROOT)) == []
+
+
+def test_all_seven_rules_registered():
+    assert set(all_rules()) == {
+        "tick-sync", "swallowed-faults", "tracer-leak", "retrace-hazard",
+        "rng-key-reuse", "lock-discipline", "env-discipline",
+    }
+    for rule in all_rules().values():
+        assert rule.summary and rule.why
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+def test_cli_json_and_exit_codes(tmp_path):
+    from rca_tpu.analysis.__main__ import main
+
+    rel, src, _ = FIXTURES["env-discipline"]
+    root = _fake_repo(tmp_path, (rel, src))
+    # findings -> 1; clean subset -> 0; unknown rule -> 2
+    assert main(["--root", root, "--no-baseline"]) == 1
+    assert main(["--root", root, "--no-baseline",
+                 "--rules", "tick-sync"]) == 0
+    assert main(["--root", root, "--rules", "no-such-rule"]) == 2
+
+
+def test_cli_json_shape(tmp_path, capsys):
+    from rca_tpu.analysis.__main__ import main
+
+    rel, src, _ = FIXTURES["swallowed-faults"]
+    root = _fake_repo(tmp_path, (rel, src))
+    rc = main(["--root", root, "--no-baseline", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["clean"] is False
+    f = out["findings"][0]
+    assert {"rule", "path", "line", "message", "snippet",
+            "fingerprint"} <= set(f)
+
+
+def test_rca_lint_subcommand_forwards():
+    from rca_tpu.cli import main
+
+    assert main(["lint", "--list-rules"]) == 0
+
+
+def test_shims_keep_their_contract():
+    """The PR-1/PR-2 scripts still run standalone with the same clean
+    message (their tier-1 gates in test_resilience / test_tick_pipeline
+    invoke them exactly like this)."""
+    for script, marker in (
+        ("lint_tick_sync.py", "lint_tick_sync: clean"),
+        ("lint_swallowed_faults.py", "lint_swallowed_faults: clean"),
+    ):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", script)],
+            capture_output=True, text=True, cwd="/",
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert marker in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# dynamic companion: recompile gate
+# ---------------------------------------------------------------------------
+
+def test_tracecheck_entry_points_compile_once():
+    from rca_tpu.analysis import run_tracecheck
+
+    summary = run_tracecheck()
+    assert summary["ok"], summary
+    names = {e["entry"] for e in summary["entries"]}
+    assert {"engine.analyze_case", "engine.analyze_batch",
+            "streaming.tick", "propagate_jit"} <= names
+    for e in summary["entries"]:
+        assert e["recompiles"] == 0, e
+
+
+def test_tracecheck_detects_a_recompile():
+    """The gate actually gates: a function whose cache key changes every
+    call (fresh shape) must be reported."""
+    import numpy as np
+
+    from rca_tpu.analysis.tracecheck import compile_log_capture
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    sizes = iter([8, 16])
+
+    records = []
+    f(jnp.zeros(next(sizes)))  # warm
+    with compile_log_capture(records):
+        f(jnp.zeros(next(sizes)))  # different shape: must compile
+    assert len(records) >= 1
+    assert np.all([r.startswith("Compiling") for r in records])

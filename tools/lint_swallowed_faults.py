@@ -1,92 +1,36 @@
 #!/usr/bin/env python3
 """Lint: no silently-swallowed faults outside the resilience layer.
 
-Fails (exit 1) when any ``except Exception: pass`` / bare ``except: pass``
-handler appears in the codebase outside ``rca_tpu/resilience/``.  A
-swallowed fault must go through a policy —
-:func:`rca_tpu.resilience.policy.suppressed` records it into the bounded
-fault log the streaming health records drain, so "it failed and nobody
-ever knew" cannot happen again.  Narrow handlers (``except OSError:
-pass``) stay allowed: catching a SPECIFIC exception is a decision; catching
-everything and discarding it is a bug farm.
+Thin shim over the graftlint framework (PR 4): the invariant now lives in
+:mod:`rca_tpu.analysis.rules.faults` as the ``swallowed-faults`` rule,
+next to the other six JAX/TPU-correctness rules, with suppression-comment
+and baseline support.  This script keeps the PR-1 CLI contract
+byte-for-byte (same messages, same exit codes) for the tier-1 gate in
+tests/test_resilience.py and any operator muscle memory.
 
-Run directly (``python tools/lint_swallowed_faults.py``) or via
-tests/test_resilience.py, which gates it under tier-1.
+Run directly (``python tools/lint_swallowed_faults.py``) or use the full
+analyzer: ``python -m rca_tpu.analysis`` / ``rca lint``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Tuple
 
-# directories scanned, relative to the repo root
-SCAN_DIRS = ("rca_tpu", "tools", "tests")
-SCAN_FILES = ("bench.py",)
-# the one place allowed to swallow: the policy layer itself
-ALLOWED_PREFIX = os.path.join("rca_tpu", "resilience") + os.sep
-
-
-def _is_swallow(handler: ast.ExceptHandler) -> bool:
-    """True for ``except Exception:``/bare ``except:`` whose body is only
-    ``pass`` (docstring-style constants also count as doing nothing)."""
-    if handler.type is not None:
-        # only the catch-everything shapes are banned
-        if not (isinstance(handler.type, ast.Name)
-                and handler.type.id in ("Exception", "BaseException")):
-            return False
-    return all(
-        isinstance(stmt, ast.Pass)
-        or (isinstance(stmt, ast.Expr)
-            and isinstance(stmt.value, ast.Constant))
-        for stmt in handler.body
-    )
-
-
-def scan_file(path: str) -> List[Tuple[str, int]]:
-    try:
-        tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
-    except SyntaxError as exc:
-        return [(path, exc.lineno or 0)]
-    hits = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and _is_swallow(node):
-            hits.append((path, node.lineno))
-    return hits
-
-
-def run(root: str) -> List[Tuple[str, int]]:
-    hits: List[Tuple[str, int]] = []
-    targets = list(SCAN_FILES)
-    for d in SCAN_DIRS:
-        for dirpath, _dirnames, filenames in os.walk(os.path.join(root, d)):
-            targets += [
-                os.path.join(dirpath, f)
-                for f in filenames if f.endswith(".py")
-            ]
-    for path in targets:
-        full = path if os.path.isabs(path) else os.path.join(root, path)
-        if not os.path.exists(full):
-            continue
-        rel = os.path.relpath(full, root)
-        if rel.startswith(ALLOWED_PREFIX):
-            continue
-        hits += [(os.path.relpath(p, root), ln) for p, ln in scan_file(full)]
-    return hits
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    hits = run(root)
-    for path, lineno in hits:
-        print(
-            f"{path}:{lineno}: swallowed fault — replace "
-            "`except Exception: pass` with "
-            "rca_tpu.resilience.policy.suppressed(op)"
-        )
-    if hits:
-        print(f"{len(hits)} swallowed fault(s) outside rca_tpu/resilience/")
+    from rca_tpu.analysis import run_lint
+
+    result = run_lint(rules=["swallowed-faults"])
+    for f in result.findings:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if result.findings:
+        print(f"{len(result.findings)} swallowed fault(s) outside "
+              "rca_tpu/resilience/")
         return 1
     print("lint_swallowed_faults: clean")
     return 0

@@ -1,96 +1,35 @@
 #!/usr/bin/env python3
 """Lint: no device synchronization inside the tick capture/dispatch paths.
 
-The streaming tick pipeline (ISSUE 2) only works because JAX dispatch is
-async: tick N's device round trip hides behind tick N+1's host capture.
-ONE stray ``jax.device_get`` / ``.block_until_ready()`` in the capture or
-dispatch path re-serializes the whole pipeline — silently, with no test
-failing, just the latency win gone.  Same spirit as
-``lint_swallowed_faults.py``: make the regression impossible to land
-quietly.
+Thin shim over the graftlint framework (PR 4): the invariant now lives in
+:mod:`rca_tpu.analysis.rules.ticksync` as the ``tick-sync`` rule, next to
+the other six JAX/TPU-correctness rules, with suppression-comment and
+baseline support.  This script keeps the PR-2 CLI contract byte-for-byte
+(same messages, same exit codes) for the tier-1 gate in
+tests/test_tick_pipeline.py and any operator muscle memory.
 
-The designated sync point is ``StreamingHostState.fetch`` (and only it):
-every module on the tick path below lists the functions allowed to
-synchronize; a sync call anywhere else in those files fails the lint.
-
-Run directly (``python tools/lint_tick_sync.py``) or via
-tests/test_tick_pipeline.py, which gates it under tier-1.
+Run directly (``python tools/lint_tick_sync.py``) or use the full
+analyzer: ``python -m rca_tpu.analysis`` / ``rca lint``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Dict, List, Set, Tuple
 
-# the banned synchronization spellings (attribute accesses — catches
-# jax.device_get, jax.block_until_ready, and x.block_until_ready())
-SYNC_ATTRS = ("device_get", "block_until_ready")
-
-# tick-path modules -> function names allowed to synchronize there.
-# fetch() is THE sync point; everything else on the capture/dispatch path
-# must stay async.  The serving scheduler (ISSUE 3) joins the same
-# contract: its worker overlaps batch N's device round trip with batch
-# N+1's assembly, so a sync anywhere outside BatchDispatcher.fetch
-# re-serializes the serve pipeline exactly like a stray sync in a tick.
-TICK_MODULES: Dict[str, Set[str]] = {
-    os.path.join("rca_tpu", "engine", "streaming.py"): {"fetch"},
-    os.path.join("rca_tpu", "parallel", "streaming.py"): {"fetch"},
-    os.path.join("rca_tpu", "engine", "live.py"): set(),
-    os.path.join("rca_tpu", "features", "extract.py"): set(),
-    os.path.join("rca_tpu", "cluster", "snapshot.py"): set(),
-    os.path.join("rca_tpu", "serve", "dispatcher.py"): {"fetch"},
-    os.path.join("rca_tpu", "serve", "loop.py"): set(),
-    os.path.join("rca_tpu", "serve", "queue.py"): set(),
-    os.path.join("rca_tpu", "serve", "batcher.py"): set(),
-    os.path.join("rca_tpu", "serve", "client.py"): set(),
-    os.path.join("rca_tpu", "serve", "metrics.py"): set(),
-}
-
-
-def scan_file(path: str, allowed: Set[str]) -> List[Tuple[int, str]]:
-    try:
-        tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
-    except SyntaxError as exc:
-        return [(exc.lineno or 0, "syntax error")]
-
-    hits: List[Tuple[int, str]] = []
-
-    def walk(node: ast.AST, func: str) -> None:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            func = node.name
-        if (isinstance(node, ast.Attribute)
-                and node.attr in SYNC_ATTRS and func not in allowed):
-            hits.append((node.lineno, node.attr))
-        for child in ast.iter_child_nodes(node):
-            walk(child, func)
-
-    walk(tree, "<module>")
-    return hits
-
-
-def run(root: str) -> List[Tuple[str, int, str]]:
-    out: List[Tuple[str, int, str]] = []
-    for rel, allowed in sorted(TICK_MODULES.items()):
-        full = os.path.join(root, rel)
-        if not os.path.exists(full):
-            continue
-        out += [(rel, ln, attr) for ln, attr in scan_file(full, allowed)]
-    return out
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    hits = run(root)
-    for rel, lineno, attr in hits:
-        print(
-            f"{rel}:{lineno}: `{attr}` in the tick capture/dispatch path — "
-            "device sync belongs ONLY in StreamingHostState.fetch (it "
-            "re-serializes the tick pipeline; see PERF.md round-6)"
-        )
-    if hits:
-        print(f"{len(hits)} stray device sync(s) in tick paths")
+    from rca_tpu.analysis import run_lint
+
+    result = run_lint(rules=["tick-sync"])
+    for f in result.findings:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if result.findings:
+        print(f"{len(result.findings)} stray device sync(s) in tick paths")
         return 1
     print("lint_tick_sync: clean")
     return 0
