@@ -54,6 +54,71 @@ def chaos_metrics(seed: int = 7, ticks: int = 100) -> dict:
     }
 
 
+def replay_metrics(n_services: int = 50, ticks: int = 40) -> dict:
+    """Flight-recorder row (ISSUE 5): what recording COSTS (tick-time
+    overhead vs an unrecorded twin and log bytes/tick) and what replay
+    BUYS (ticks/s re-driving the real engine from the log, vs the live
+    capture's tick rate) — plus the parity bit, because a recorder whose
+    replays diverge is measuring nothing."""
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.replay import Recorder, replay_stream
+
+    def run_session(recorder=None):
+        world = synthetic_cascade_world(n_services, n_roots=1, seed=0)
+        sess = LiveStreamingSession(
+            MockClusterClient(world), "synthetic", k=5,
+            topology_check_every=10, recorder=recorder,
+        )
+        times = []
+        rng = np.random.default_rng(1)
+        for t in range(ticks):
+            if t % 3 == 0:
+                # journaled churn so recorded ticks carry real deltas
+                i = int(rng.integers(0, n_services))
+                name = f"pod-svc-{i:05d}" if n_services > 5 else "pod-0"
+                world.touch("pod_metrics", "synthetic", name)
+            t0 = time.perf_counter()
+            sess.poll()
+            times.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(times))
+
+    plain_ms = run_session()
+    tmp = tempfile.mkdtemp(prefix="rca_replay_bench_")
+    rec_path = f"{tmp}/rec"
+    try:
+        recorder = Recorder(rec_path)
+        recorded_ms = run_session(recorder)
+        recorder.close()
+        bytes_per_tick = recorder.bytes_written / max(1, ticks)
+        t0 = time.perf_counter()
+        report = replay_stream(rec_path)
+        replay_s = time.perf_counter() - t0
+        return {
+            "ticks": ticks,
+            "tick_ms_unrecorded": round(plain_ms, 3),
+            "tick_ms_recorded": round(recorded_ms, 3),
+            "record_overhead_pct": round(
+                100.0 * (recorded_ms - plain_ms) / max(plain_ms, 1e-9), 1
+            ),
+            "log_bytes_per_tick": round(bytes_per_tick, 1),
+            "replay_ticks_per_sec": round(
+                report["ticks_replayed"] / max(replay_s, 1e-9), 1
+            ),
+            "live_ticks_per_sec": round(1e3 / max(recorded_ms, 1e-9), 1),
+            "replay_parity_ok": report["parity_ok"],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def lint_metrics() -> dict:
     """graftlint wall time (ISSUE 4 satellite): the analyzer gates every
     PR, so its cost is tracked like any other latency — if a new rule
@@ -784,6 +849,8 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         "noisyor_path": noisyor_choice,
         "xla_noisyor_50k_ms": r(xla_nor_ms),
         "pallas_noisyor_50k_ms": r(pallas_nor_ms),
+        # flight recorder: record overhead, log size, replay throughput
+        "replay": replay_metrics(),
         # analyzer wall time: lint gates every PR, so it is benched too
         "graftlint": lint_metrics(),
         "backend": "jax",

@@ -1,0 +1,174 @@
+"""Rule ``nondet-discipline``: replay-covered modules read no wall clock
+and draw no unseeded randomness outside the injectable seams.
+
+The flight recorder (rca_tpu/replay, REPLAY.md) replays a session by
+re-serving its recorded cluster responses to the real engine — which is
+only sound while every OTHER input is deterministic.  A stray
+``time.time()`` feeding a feature, a ``datetime.now()`` in a capture
+path, or a module-level ``random.random()`` makes recordings
+host-dependent and replay divergence unexplainable.  This rule fences
+the replay-covered modules:
+
+- **forbidden**: direct CALLS to ``time.time/monotonic/perf_counter``
+  (and ``_ns`` twins), ``datetime.now/utcnow/today``, the ``findings``
+  helper ``utcnow_iso``, module-level ``random.<fn>()`` draws, and
+  UNSEEDED RNG constructors (``random.Random()`` /
+  ``np.random.default_rng()`` with no arguments);
+- **seams (legal)**: passing a clock FUNCTION into an injectable
+  parameter (``clock: Callable = time.monotonic`` — a reference, not a
+  call; every covered module times through ``self._clock()``), and
+  SEEDED RNG construction (``random.Random(seed)``,
+  ``default_rng(seed)`` — a (seed, call-sequence) pair replays exactly,
+  which is the chaos scheduler's whole design).
+
+Ships with the standard per-file allowlist mechanism; the two entries it
+carries ARE seams: ``MockClusterClient.get_current_time`` (wall time only
+behind its ``frozen_time=False`` escape hatch) and the recorder's
+``wall_now`` (header metadata — nothing replayed depends on it).
+Baseline ships empty: every pre-existing read was routed through the
+seams in the same PR that added the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: replay-covered scope: everything a stream or serve recording's
+#: determinism argument rests on (prefix match on repo-relative paths)
+REPLAY_SCOPE = (
+    "rca_tpu/replay/",
+    "rca_tpu/engine/streaming.py",
+    "rca_tpu/engine/live.py",
+    "rca_tpu/parallel/streaming.py",
+    "rca_tpu/serve/",
+    "rca_tpu/cluster/watch_pump.py",
+    "rca_tpu/cluster/mock_client.py",
+    "rca_tpu/cluster/world.py",
+    "rca_tpu/cluster/snapshot.py",
+    "rca_tpu/features/extract.py",
+    "rca_tpu/resilience/chaos.py",
+    "rca_tpu/resilience/policy.py",
+)
+
+_TIME_FNS = frozenset({
+    "time", "time_ns", "monotonic", "monotonic_ns",
+    "perf_counter", "perf_counter_ns",
+})
+_DATETIME_FNS = frozenset({"now", "utcnow", "today"})
+
+MSG_TIME = (
+    "direct {call}() in a replay-covered module — time through the "
+    "injectable clock seam (self._clock / the clock= parameter) so "
+    "recordings stay host-independent"
+)
+MSG_RANDOM = (
+    "module-level random.{fn}() in a replay-covered module — draw from a "
+    "seeded random.Random(seed) instance so a (seed, call-sequence) pair "
+    "replays exactly"
+)
+MSG_UNSEEDED = (
+    "unseeded {ctor}() in a replay-covered module — pass a seed so the "
+    "stream is replayable"
+)
+MSG_WALLHELPER = (
+    "utcnow_iso() in a replay-covered module — wall time must come from "
+    "the client (get_current_time) or an allowlisted metadata seam"
+)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an attribute chain ('np.random.default_rng')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+@register
+class NondetDisciplineRule(Rule):
+    name = "nondet-discipline"
+    summary = ("no wall-clock reads or unseeded randomness in "
+               "replay-covered modules outside the clock/RNG seams")
+    why = ("the flight recorder replays recorded cluster responses "
+           "through the real engine; one stray time.time() or global "
+           "random draw makes the replay diverge on a different host "
+           "with nothing in the log to explain why")
+
+    allow = {
+        # frozen_time=False escape hatch — the documented wall seam
+        "rca_tpu/cluster/mock_client.py": {"get_current_time"},
+        # recording METADATA stamp (header created_at); never replayed
+        "rca_tpu/replay/recorder.py": {"wall_now"},
+    }
+
+    def applies_to(self, relpath: str) -> bool:
+        return any(relpath.startswith(p) for p in REPLAY_SCOPE)
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        hits: List[Finding] = []
+
+        def check_call(node: ast.Call, func: str) -> None:
+            dotted = _dotted(node.func)
+            parts = dotted.split(".")
+            if len(parts) < 1:
+                return
+            head, tail = parts[0], parts[-1]
+            # time.<fn>() — only as a CALL; a bare reference passed into
+            # a clock= parameter is the seam itself and stays legal
+            if head == "time" and len(parts) == 2 and tail in _TIME_FNS:
+                hits.append(ctx.finding(
+                    self, node.lineno, MSG_TIME.format(call=dotted),
+                    func=func,
+                ))
+                return
+            # datetime.now()/utcnow()/today() (datetime.datetime.now too)
+            if tail in _DATETIME_FNS and "datetime" in parts[:-1]:
+                hits.append(ctx.finding(
+                    self, node.lineno, MSG_TIME.format(call=dotted),
+                    func=func,
+                ))
+                return
+            if dotted == "utcnow_iso":
+                hits.append(ctx.finding(
+                    self, node.lineno, MSG_WALLHELPER, func=func,
+                ))
+                return
+            # random.<fn>() module-level draws; random.Random(seed) and
+            # any seeded constructor stay legal
+            if head == "random" and len(parts) == 2:
+                if tail == "Random":
+                    if not node.args and not node.keywords:
+                        hits.append(ctx.finding(
+                            self, node.lineno,
+                            MSG_UNSEEDED.format(ctor=dotted), func=func,
+                        ))
+                else:
+                    hits.append(ctx.finding(
+                        self, node.lineno,
+                        MSG_RANDOM.format(fn=tail), func=func,
+                    ))
+                return
+            # np.random.default_rng() / numpy.random.default_rng() unseeded
+            if (tail == "default_rng" and "random" in parts[:-1]
+                    and not node.args and not node.keywords):
+                hits.append(ctx.finding(
+                    self, node.lineno, MSG_UNSEEDED.format(ctor=dotted),
+                    func=func,
+                ))
+
+        def walk(node: ast.AST, func: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = node.name
+            if isinstance(node, ast.Call):
+                check_call(node, func)
+            for child in ast.iter_child_nodes(node):
+                walk(child, func)
+
+        walk(ctx.tree, "<module>")
+        return hits

@@ -11,8 +11,13 @@
 - ``train``     fit propagation weights; save an orbax checkpoint
 - ``stream``    poll-driven live streaming analysis (1 Hz loop)
 - ``chaos``     seeded fault-injection soak over a synthetic world
+                (``--record`` writes a flight recording + replay-parity leg)
 - ``serve``     multi-tenant serving scheduler (continuous shape-bucketed
                 batching; ``--selftest`` asserts the serving contract)
+- ``replay``    deterministic incident replay from a flight recording:
+                tick-for-tick bit-parity, ``--seek`` time travel,
+                ``--bisect`` first-divergent-tick search, ``--mint``
+                corpus fixtures (REPLAY.md)
 - ``lint``      graftlint static analysis: JAX/TPU-correctness rules +
                 recompile tracecheck (``rca lint --help``; ANALYSIS.md)
 - ``investigations``  list / show persisted investigations
@@ -318,9 +323,15 @@ def cmd_stream(args) -> int:
     client, ns = _make_client(args.fixture, args.seed,
                               getattr(args, 'fault_mix', 'crash'))
     namespace = args.namespace or ns or "default"
+    recorder = None
+    if getattr(args, "record", None):
+        from rca_tpu.replay import Recorder
+
+        recorder = Recorder(args.record, mode="stream")
     live = LiveStreamingSession(
         client, namespace, k=args.top,
         pipeline_depth=getattr(args, "pipeline_depth", None),
+        recorder=recorder,
     )
     for i in range(args.ticks):
         out = live.poll()
@@ -353,6 +364,13 @@ def cmd_stream(args) -> int:
         print(json.dumps(line, default=str), flush=True)
         if args.interval > 0 and i + 1 < args.ticks:
             _time.sleep(args.interval)
+    if recorder is not None:
+        recorder.close()
+        print(json.dumps({
+            "recording": recorder.path,
+            "ticks_recorded": recorder.ticks_recorded,
+            "bytes": recorder.bytes_written,
+        }), file=sys.stderr)
     return 0
 
 
@@ -390,12 +408,16 @@ def cmd_chaos(args) -> int:
         make_world, "synthetic", seed=seed, ticks=args.ticks, k=args.top,
         config=ChaosConfig(seed=seed),
         topology_check_every=args.topology_check_every,
+        record_path=args.record,
+        pipeline_depth=getattr(args, "pipeline_depth", None),
     )
     print(json.dumps(summary, indent=None if args.compact else 2))
     ok = (
         summary["uncaught_exceptions"] == 0
         and summary["parity_ok"]
         and (summary["all_classes_observed"] or args.ticks < 100)
+        # --record adds the record→replay parity leg to the contract
+        and summary.get("replay", {}).get("parity_ok", True)
     )
     return 0 if ok else 1
 
@@ -446,7 +468,13 @@ def cmd_serve(args) -> int:
         int(m.group(1)), n_roots=1, seed=args.seed
     )
     rng = np.random.default_rng(args.seed)
-    loop = ServeLoop(engine=make_engine(), config=config)
+    recorder = None
+    if args.record:
+        from rca_tpu.replay import Recorder
+
+        recorder = Recorder(args.record, mode="serve")
+    loop = ServeLoop(engine=make_engine(), config=config,
+                     recorder=recorder)
     tenants = [f"tenant-{i}" for i in range(args.tenants)]
     t0 = _time.perf_counter()
     with loop:
@@ -463,12 +491,17 @@ def cmd_serve(args) -> int:
         ]
         responses = [r.result(timeout=300.0) for r in reqs]
     wall_s = _time.perf_counter() - t0
+    if recorder is not None:
+        recorder.close()
     by_status = {}
     for resp in responses:
         by_status[resp.status] = by_status.get(resp.status, 0) + 1
     print(json.dumps({
         "requests": args.requests,
         "tenants": len(tenants),
+        **({"recording": recorder.path,
+            "serve_recorded": recorder.serve_recorded}
+           if recorder is not None else {}),
         "by_status": by_status,
         "wall_s": round(wall_s, 3),
         "analyses_per_sec": round(
@@ -478,6 +511,79 @@ def cmd_serve(args) -> int:
         "metrics": loop.metrics.summary(),
     }, indent=None if args.compact else 2, default=str))
     return 0 if by_status.get("ok", 0) == args.requests else 1
+
+
+def _replay_engine(choice: Optional[str]):
+    """Engine for a replay run: ``auto`` (None) lets the replayer pick
+    the RECORDED engine kind — the bitwise contract is like-for-like;
+    ``single``/``sharded`` force a cross-engine replay (stream rankings
+    stay parity-locked across kinds; REPLAY.md)."""
+    if choice in (None, "", "auto"):
+        return None
+    if choice == "single":
+        from rca_tpu.engine.runner import GraphEngine
+
+        return GraphEngine()
+    if choice == "sharded":
+        from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+        return ShardedGraphEngine()
+    raise SystemExit(f"unknown engine {choice!r} (want auto|single|sharded)")
+
+
+def cmd_replay(args) -> int:
+    """Deterministic incident replay (REPLAY.md).  Re-drives the REAL
+    engine from a flight recording and asserts tick-for-tick (stream) or
+    request-for-request (serve) bit-identity; exit 0 = parity holds.
+    ``--seek`` time-travels to one tick, ``--bisect`` binary-searches a
+    diverging log to its first divergent tick and dumps both sides'
+    tensors, ``--mint`` compacts a recording into a one-file corpus
+    fixture, ``--investigation`` resolves the log from a stored
+    investigation's ``recording_ref``."""
+    from rca_tpu.replay import (
+        bisect_divergence,
+        load_recording,
+        mint_recording,
+        replay_serve,
+        replay_stream,
+    )
+
+    path = args.log
+    if args.investigation:
+        from rca_tpu.store import InvestigationStore
+
+        store = InvestigationStore(root=args.log_dir)
+        path = store.get_recording_ref(args.investigation)
+        if not path:
+            print(json.dumps({
+                "error": f"investigation {args.investigation} has no "
+                "recording_ref",
+            }))
+            return 1
+    if not path:
+        raise SystemExit("replay needs a LOG path or --investigation ID")
+    if args.mint:
+        stats = mint_recording(path, args.mint)
+        print(json.dumps(stats, indent=None if args.compact else 2))
+        return 0
+    engine = _replay_engine(args.engine)
+    rec = load_recording(path)
+    if rec.mode == "serve":
+        report = replay_serve(path, engine=engine)
+    elif args.bisect:
+        report = bisect_divergence(
+            path, engine=engine, pipeline_depth=args.pipeline_depth,
+            dump_path=args.dump,
+        )
+    else:
+        report = replay_stream(
+            path, engine=engine, pipeline_depth=args.pipeline_depth,
+            seek=args.seek, ticks=args.ticks,
+        )
+    print(json.dumps(report, indent=None if args.compact else 2,
+                     default=str))
+    ok = report.get("parity_ok", not report.get("divergent", False))
+    return 0 if ok else 1
 
 
 def cmd_lint(args) -> int:
@@ -621,6 +727,9 @@ def build_parser() -> argparse.ArgumentParser:
                     "or 1): 2 overlaps each tick's device round trip with "
                     "the next poll's capture; rankings arrive depth-1 "
                     "ticks late")
+    sp.add_argument("--record", default=None, metavar="PATH",
+                    help="flight-record every tick to PATH (a directory); "
+                    "re-drive later with `rca replay PATH`")
     sp.set_defaults(fn=cmd_stream)
 
     sp = sub.add_parser("train", help="fit propagation weights on "
@@ -654,6 +763,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--top", type=int, default=5)
     sp.add_argument("--topology-check-every", type=int, default=5,
                     dest="topology_check_every")
+    sp.add_argument("--record", default=None, metavar="PATH",
+                    help="flight-record the chaos session to PATH and add "
+                    "the record→replay bit-parity leg to the contract")
+    sp.add_argument("--pipeline-depth", type=int, default=None,
+                    dest="pipeline_depth",
+                    help="tick pipeline depth for the soaked session")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_chaos)
 
@@ -685,8 +800,51 @@ def build_parser() -> argparse.ArgumentParser:
                     help="override RCA_SERVE_MAX_WAIT_US")
     sp.add_argument("--queue-cap", type=int, default=None, dest="queue_cap",
                     help="override RCA_SERVE_QUEUE_CAP")
+    sp.add_argument("--record", default=None, metavar="PATH",
+                    help="flight-record every served request to PATH "
+                    "(load-demo mode); re-check with `rca replay PATH`")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "replay",
+        help="deterministic incident replay from a flight recording: "
+        "bit-parity check, --seek time travel, --bisect divergence "
+        "search, --mint corpus fixtures (REPLAY.md)",
+    )
+    sp.add_argument("log", nargs="?", default=None,
+                    help="recording directory (or minted single file)")
+    sp.add_argument("--seek", type=int, default=None, metavar="TICK",
+                    help="replay up to TICK and attach its full detail "
+                    "(both rankings, feature digests) to the report")
+    sp.add_argument("--bisect", action="store_true",
+                    help="on divergence, binary-search to the FIRST "
+                    "divergent tick and dump both feature/ranking "
+                    "tensors for diffing")
+    sp.add_argument("--mint", default=None, metavar="OUT",
+                    help="compact the recording into one compressed file "
+                    "(the committed tests/corpus fixture form)")
+    sp.add_argument("--dump", default=None, metavar="PATH",
+                    help="where --bisect writes the divergence tensors "
+                    "(default: <log>.divergence.json)")
+    sp.add_argument("--pipeline-depth", type=int, default=None,
+                    dest="pipeline_depth",
+                    help="replay at this depth (default: the recorded "
+                    "one; a different depth compares lag-stripped "
+                    "serial sequences)")
+    sp.add_argument("--engine", default="auto",
+                    help="auto (= the recorded engine kind) | single | "
+                    "sharded (stream rankings are parity-locked across "
+                    "kinds; serve per-node channels are bitwise only "
+                    "like-for-like)")
+    sp.add_argument("--ticks", type=int, default=None,
+                    help="replay only the first N ticks")
+    sp.add_argument("--investigation", default=None, metavar="ID",
+                    help="resolve the recording from this stored "
+                    "investigation's recording_ref")
+    sp.add_argument("--log-dir", default="logs")
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_replay)
 
     sp = sub.add_parser(
         "lint",

@@ -31,6 +31,9 @@ class MockClusterClient:
         return True
 
     def get_current_time(self) -> str:
+        # the one wall-clock seam in the mock (nondet-discipline
+        # allowlists exactly this function): frozen by default so
+        # recorded/replayed captures are host-independent
         return MOCK_TIME if self._frozen_time else utcnow_iso()
 
     def get_cluster_info(self) -> Dict[str, Any]:

@@ -45,6 +45,14 @@ late pushes land in an orphaned journal no consumer reads.
 
 Tested hermetically with a stub ``kubernetes`` module
 (tests/test_watch.py) — the same technique as the provider contract tests.
+
+Replay note (ISSUE 5): this module is inside the flight recorder's
+nondet-discipline fence (rca_tpu/analysis/rules/nondet.py) — it holds no
+wall-clock reads by design.  Pump retry backoff sleeps through the
+injectable seeded :class:`rca_tpu.resilience.policy.Retry`, and every
+notification a consumer drains reaches the recorder as a
+``watch_changes`` call result, so recordings capture the feed's OUTPUT
+and never depend on pump thread timing.
 """
 
 from __future__ import annotations

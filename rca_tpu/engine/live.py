@@ -106,6 +106,8 @@ class LiveStreamingSession:
         topology_check_every: int = 5,
         use_watch: bool = True,
         pipeline_depth: Optional[int] = None,
+        recorder=None,
+        clock=None,
     ):
         """``topology_check_every``: do a full sweep + dependency-edge
         compare on every Nth poll — the edge build is the most expensive
@@ -122,15 +124,37 @@ class LiveStreamingSession:
         host capture.  Rankings are identical to serial, delivered N-1
         polls late (the first N-1 polls are pipeline-fill ticks carrying
         the last known ranking); the lag is surfaced in every tick's
-        health record."""
-        self.client = client
+        health record.
+
+        ``recorder`` (a :class:`rca_tpu.replay.recorder.Recorder`) makes
+        this session a FLIGHT-RECORDED one: the client is wrapped so
+        every call it answers (bootstrap capture included) lands in the
+        log, and each poll seals a tick frame with the delivered ranking
+        — ``rca replay`` re-drives the real engine from that log and
+        asserts bit-identity (REPLAY.md).  ``clock`` is the injectable
+        monotonic timer (default ``time.perf_counter``) so latency
+        accounting never reads the wall directly (nondet-discipline)."""
         self.namespace = namespace
         self.k = k
+        self._clock = clock or time.perf_counter
         # tick pipeline (ISSUE 2 tentpole): in-flight handles, oldest first
         self.pipeline_depth = (
             pipeline_depth_from_env() if pipeline_depth is None
             else max(1, int(pipeline_depth))
         )
+        # flight recorder (ISSUE 5): wrap BEFORE the bootstrap capture so
+        # the recording replays the session from construction, not from
+        # some mid-life tick
+        self.recorder = recorder
+        if recorder is not None:
+            recorder.begin_session({
+                "namespace": namespace, "k": int(k),
+                "topology_check_every": int(max(1, topology_check_every)),
+                "use_watch": bool(use_watch),
+                "pipeline_depth": self.pipeline_depth,
+            })
+            client = recorder.wrap_client(client)
+        self.client = client
         self._inflight: "collections.deque" = collections.deque()
         self.pipeline_flushed = 0  # in-flight ticks dropped by degradation
         # incremental capture cache (busy polls re-derive only changed
@@ -148,6 +172,10 @@ class LiveStreamingSession:
 
             engine = make_engine()
         self.engine = engine
+        if recorder is not None:
+            # forensics only: replay may run ANY engine kind (the engines
+            # are parity-locked), so the tag informs, never constrains
+            recorder.begin_session({"engine": type(engine).__name__})
         self.topology_check_every = max(1, int(topology_check_every))
         self._polls = 0
         self.resyncs = -1  # first _resync is initialization, not a resync
@@ -222,7 +250,7 @@ class LiveStreamingSession:
         self.session = make_streaming_session(
             self._names, src, dst,
             num_features=self._features.shape[1],
-            engine=self.engine, k=self.k,
+            engine=self.engine, k=self.k, clock=self._clock,
         )
         self.session.set_all(self._features)
         is_init = self.resyncs < 0
@@ -466,6 +494,8 @@ class LiveStreamingSession:
         output is bit-identical to the pre-resilience behavior (PARITY.md
         invariant)."""
         self._polls += 1
+        if self.recorder is not None:
+            self.recorder.begin_tick(self._polls)
         try:
             out = self._poll_inner()
             out["degraded"] = bool(out.pop("_tick_degraded", False))
@@ -484,6 +514,8 @@ class LiveStreamingSession:
             }
         self._last_ranked = list(out.get("ranked", []))
         out["health"] = self._health_record(out)
+        if self.recorder is not None:
+            self.recorder.end_tick(out, features=self._features)
         return out
 
     def _health_record(self, out: Dict[str, Any]) -> Dict[str, Any]:
@@ -559,7 +591,7 @@ class LiveStreamingSession:
             self.session = make_streaming_session(
                 self._names, src, dst,
                 num_features=self._features.shape[1],
-                engine=self.engine, k=self.k,
+                engine=self.engine, k=self.k, clock=self._clock,
             )
             self.session.set_all(self._features)
         # rung 2 ("interpret") keeps the single-device session and runs
@@ -693,7 +725,7 @@ class LiveStreamingSession:
     def _poll_inner(self) -> Dict[str, Any]:
         if not self._watch:
             return self._poll_sweep()
-        t0 = time.perf_counter()
+        t0 = self._clock()
         if self._pending_resync:
             # the previous poll drained notifications it could not apply;
             # a fresh full capture re-covers whatever they described
@@ -787,7 +819,7 @@ class LiveStreamingSession:
 
     def _finish(self, t0: float, changed: int, resynced: bool,
                 quiet: bool) -> Dict[str, Any]:
-        capture_ms = (time.perf_counter() - t0) * 1e3
+        capture_ms = (self._clock() - t0) * 1e3
         out = (
             self._tick_pipelined() if self.pipeline_depth > 1
             else self._guarded_tick()
@@ -805,7 +837,7 @@ class LiveStreamingSession:
     def _poll_sweep(self, check_edges: bool = False) -> Dict[str, Any]:
         """Full list + extract + diff (the only strategy without a change
         feed; the watch path's periodic topology check also lands here)."""
-        t0 = time.perf_counter()
+        t0 = self._clock()
         snap = ClusterSnapshot.capture(self.client, self.namespace)
         # full mode: sweeps exist to catch OUT-OF-BAND drift (trace-derived
         # edges, un-journaled mutations), which the rv-keyed row cache by

@@ -113,6 +113,7 @@ def make_streaming_session(
     num_features: int,
     engine=None,
     k: int = 5,
+    clock=None,
 ):
     """Streaming session matched to the engine kind: a
     :class:`rca_tpu.parallel.streaming.ShardedStreamingSession` when the
@@ -125,11 +126,11 @@ def make_streaming_session(
 
         return ShardedStreamingSession(
             names, dep_src, dep_dst, num_features=num_features,
-            engine=engine, k=k,
+            engine=engine, k=k, clock=clock,
         )
     return StreamingSession(
         names, dep_src, dep_dst, num_features=num_features,
-        engine=engine, k=k,
+        engine=engine, k=k, clock=clock,
     )
 
 
@@ -163,7 +164,10 @@ class StreamingHostState:
     session kinds."""
 
     # set by subclasses: names, k, _n, _n_pad, _num_features
-    def _init_host_state(self) -> None:
+    def _init_host_state(self, clock=None) -> None:
+        # injectable monotonic timer (nondet-discipline: latency stamps
+        # never read the clock module directly on the tick path)
+        self._clock = clock or time.perf_counter
         # pending row updates, keyed by service index (last write wins, so
         # the scatter never carries duplicate indices)
         self._pending: Dict[int, np.ndarray] = {}
@@ -243,11 +247,12 @@ class StreamingHostState:
         ``latency_ms`` is dispatch_ms + fetch_ms — the host time the tick
         COST, not the handle's age: a pipelined caller parks a handle for
         a whole poll interval, and age would read as latency."""
-        t1 = time.perf_counter()
+        clock = handle.session._clock
+        t1 = clock()
         vals, idx, n_bad = jax.device_get(
             (handle.vals, handle.idx, handle.n_bad)
         )
-        fetch_ms = (time.perf_counter() - t1) * 1e3
+        fetch_ms = (clock() - t1) * 1e3
         out = handle.session._render_tick(
             vals, idx, handle.dispatch_ms + fetch_ms, int(n_bad),
             upload_rows=handle.upload_rows,
@@ -272,6 +277,7 @@ class StreamingSession(StreamingHostState):
         num_features: int,
         engine: Optional[GraphEngine] = None,
         k: int = 5,
+        clock=None,
     ):
         self.engine = engine or GraphEngine()
         self.names = list(names)
@@ -310,7 +316,7 @@ class StreamingSession(StreamingHostState):
             self.noisyor_path == "pallas"
             and self._n_pad % min(self._n_pad, BLOCK_S) == 0
         )
-        self._init_host_state()
+        self._init_host_state(clock)
 
     def set_all(self, features: np.ndarray) -> None:
         """Full re-upload (session start or resync) — the one bulk path.
@@ -329,7 +335,7 @@ class StreamingSession(StreamingHostState):
         the in-flight handle; :meth:`fetch` renders it.  ``tick()`` (the
         serial path) is fetch(dispatch()) back to back."""
         p = self.engine.params
-        t0 = time.perf_counter()
+        t0 = self._clock()
         if self._pending:
             # fused path: scatter + propagate + top-k in a single dispatch
             _, u_pad, idx_h, rows_h = self._pack_pending(self._n_pad - 1)
@@ -354,7 +360,7 @@ class StreamingSession(StreamingHostState):
                 self._down_seg, self._up_seg,
                 error_contrast=p.error_contrast,
             )
-        now = time.perf_counter()
+        now = self._clock()
         return TickHandle(
             session=self, vals=vals, idx=idx, n_bad=n_bad,
             upload_rows=upload, dispatch_ms=(now - t0) * 1e3,

@@ -123,6 +123,7 @@ class ShardedStreamingSession(StreamingHostState):
         num_features: int,
         engine=None,
         k: int = 5,
+        clock=None,
     ):
         from rca_tpu.engine.sharded_runner import ShardedGraphEngine
 
@@ -169,7 +170,7 @@ class ShardedStreamingSession(StreamingHostState):
             jnp.zeros((self._n_pad, num_features), jnp.float32),
             self._feat_sharding,
         )
-        self._init_host_state()
+        self._init_host_state(clock)
 
     def set_all(self, features: np.ndarray) -> None:
         from rca_tpu.engine.runner import finite_mask_rows_np
@@ -197,7 +198,7 @@ class ShardedStreamingSession(StreamingHostState):
         from rca_tpu.engine.runner import finite_mask_rows_np
         from rca_tpu.engine.streaming import TickHandle
 
-        t0 = time.perf_counter()
+        t0 = self._clock()
         # pad slots target index n_pad: out of range for EVERY shard, so
         # the scatter drops them (quiet ticks run the same executable)
         u, u_pad, idx_h, rows_h = self._pack_pending(self._n_pad)
@@ -215,7 +216,7 @@ class ShardedStreamingSession(StreamingHostState):
         # deltas drop only once the dispatch is accepted (retryable on a
         # compile failure), matching the dense session's contract
         upload = self._account_upload(u_pad if u else 0)
-        now = time.perf_counter()
+        now = self._clock()
         return TickHandle(
             session=self, vals=vals, idx=idx, n_bad=sanitized,
             upload_rows=upload, dispatch_ms=(now - t0) * 1e3,

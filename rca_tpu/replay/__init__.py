@@ -1,0 +1,65 @@
+"""Flight recorder: deterministic incident record/replay + divergence
+bisect (REPLAY.md).
+
+- :mod:`rca_tpu.replay.format`    CRC-framed, chunked, schema-versioned
+  on-disk log (truncated tails and corrupt frames stop cleanly);
+- :mod:`rca_tpu.replay.recorder`  the :class:`Recorder`
+  ``LiveStreamingSession`` and ``ServeLoop`` write through — per-tick
+  client calls, rankings, feature digests, env fingerprint;
+- :mod:`rca_tpu.replay.source`    :class:`ReplaySource`, a cluster
+  client answered entirely from a recording (errors re-raise);
+- :mod:`rca_tpu.replay.replayer`  replay/seek/bisect/mint + the serve
+  replay path, behind ``rca replay``.
+"""
+
+from rca_tpu.replay.format import (
+    ReadStatus,
+    ReplayFormatError,
+    SCHEMA_VERSION,
+    decode_array,
+    digest_array,
+    digest_obj,
+    encode_array,
+    read_frames,
+)
+from rca_tpu.replay.recorder import (
+    FEATURES_FULL_CAP,
+    Recorder,
+    RecordingClusterClient,
+    env_fingerprint,
+)
+from rca_tpu.replay.replayer import (
+    Recording,
+    bisect_divergence,
+    load_recording,
+    mint_recording,
+    replay,
+    replay_serve,
+    replay_stream,
+)
+from rca_tpu.replay.source import ReplayMismatch, ReplaySource, ReplayedFault
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "FEATURES_FULL_CAP",
+    "ReadStatus",
+    "ReplayFormatError",
+    "Recorder",
+    "Recording",
+    "RecordingClusterClient",
+    "ReplayMismatch",
+    "ReplaySource",
+    "ReplayedFault",
+    "bisect_divergence",
+    "decode_array",
+    "digest_array",
+    "digest_obj",
+    "encode_array",
+    "env_fingerprint",
+    "load_recording",
+    "mint_recording",
+    "read_frames",
+    "replay",
+    "replay_serve",
+    "replay_stream",
+]

@@ -1,0 +1,267 @@
+"""Flight recorder: capture everything a session consumed, per tick.
+
+The determinism argument the whole subsystem rests on: a
+:class:`rca_tpu.engine.live.LiveStreamingSession` is a deterministic
+function of (a) its construction knobs and (b) the byte-for-byte sequence
+of cluster-client responses it observes — every other input (feature
+extraction, edge build, the jitted tick) is pure on one platform, which
+is exactly what the chaos-parity property has asserted since PR 1.  So
+the recorder does NOT snapshot engine internals; it wraps the client and
+records every call's (method, args, result-or-exception) inside tick
+boundaries, plus each tick's produced ranking and a digest of the host
+feature mirror.  Replay (:mod:`rca_tpu.replay.source`) re-serves those
+responses to the REAL engine and the rankings must come back
+bit-identical — at any pipeline depth and on either engine kind, because
+neither changes what the capture path asks the cluster.
+
+Chaos runs record faithfully: injected faults surface as client-call
+EXCEPTIONS (recorded, re-raised on replay) and ``drain_injected`` results
+(recorded like any call), so a replayed chaos soak walks the exact same
+degraded paths the live one did.
+
+Frame kinds written here (format.py owns the byte layout):
+
+- ``header``  once, first: schema, mode (stream/serve), session knobs,
+  env fingerprint, optional seeds;
+- ``call``    one per client call, tagged with the current tick
+  (tick 0 = the session's bootstrap capture);
+- ``tick``    one per poll: delivered ranking (+ digest), host feature
+  digest (full rows too, below the size cap), health excerpt;
+- ``serve``   one per served request (serve mode): full request inputs +
+  the ranking it got — self-contained, replayable without a cluster;
+- ``end``     on close: tick/serve counts.  A recording without it is a
+  crashed (possibly truncated) capture; replay still covers every
+  complete tick.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from rca_tpu.replay.format import (
+    SCHEMA_VERSION,
+    RecordingWriter,
+    digest_array,
+    digest_obj,
+    encode_array,
+    make_call_key,
+)
+
+#: record full per-tick feature rows only while the matrix stays under
+#: this many elements — above it, the digest alone rides along (bisect
+#: then diffs replayed tensors against the digest, not stored rows)
+FEATURES_FULL_CAP = 65536
+
+#: health-record keys copied into each tick frame (forensics; the parity
+#: contract itself is on the ranking digest)
+_HEALTH_KEYS = (
+    "sanitized_rows", "degradation", "resyncs_expired", "resyncs_topology",
+    "pipeline_fill", "retries",
+)
+
+
+def wall_now() -> str:
+    """Wall-clock stamp for recording METADATA (header ``created_at``).
+    The one legitimate wall read in the replay subsystem — nothing
+    replayed ever depends on it (nondet-discipline allowlists exactly
+    this function)."""
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def env_fingerprint() -> Dict[str, Any]:
+    """What machine/stack produced a recording — stamped into the header
+    so a cross-host parity failure is attributable before any bisect."""
+    import jax
+
+    from rca_tpu.config import env_raw, env_str
+    from rca_tpu.version import __version__
+
+    return {
+        "rca_tpu": __version__,
+        "jax": jax.__version__,
+        "numpy": np.__version__,
+        "jax_backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "rca_backend": env_str("RCA_BACKEND", "jax"),
+        "rca_shard": env_raw("RCA_SHARD"),
+        "rca_pallas": env_raw("RCA_PALLAS"),
+        "rca_pipeline_depth": env_raw("RCA_PIPELINE_DEPTH"),
+    }
+
+
+class Recorder:
+    """One recording in progress.  Thread-compat note: the streaming path
+    is single-threaded by construction; the serve path records from the
+    one serve-worker thread — neither needs a lock here."""
+
+    def __init__(
+        self,
+        path: str,
+        mode: str = "stream",
+        features_cap: int = FEATURES_FULL_CAP,
+        chunk_bytes: Optional[int] = None,
+        seeds: Optional[Dict[str, int]] = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ):
+        if mode not in ("stream", "serve"):
+            raise ValueError(f"mode must be stream|serve, got {mode!r}")
+        kw = {} if chunk_bytes is None else {"chunk_bytes": chunk_bytes}
+        self._writer = RecordingWriter(str(path), **kw)
+        self.path = str(path)
+        self.mode = mode
+        self.features_cap = int(features_cap)
+        self._tick = 0
+        self.ticks_recorded = 0
+        self.serve_recorded = 0
+        self.calls_recorded = 0
+        self._closed = False
+        self._header_written = False
+        self._pending_header: Dict[str, Any] = {
+            "kind": "header", "schema": SCHEMA_VERSION, "mode": mode,
+            "created_at": wall_now(), "env": env_fingerprint(),
+            "seeds": dict(seeds or {}), "meta": dict(meta or {}),
+            "session": {},
+        }
+
+    # -- header -------------------------------------------------------------
+    def begin_session(self, info: Dict[str, Any]) -> None:
+        """Session construction knobs (namespace, k, depth, engine tag...)
+        — merged into the header, which is written on the first frame so
+        it is always frame 0 even when info arrives in pieces."""
+        self._pending_header["session"].update(info)
+
+    def _ensure_header(self) -> None:
+        if not self._header_written:
+            self._writer.append(self._pending_header)
+            self._header_written = True
+
+    # -- client wrapping ----------------------------------------------------
+    def wrap_client(self, client: Any) -> "RecordingClusterClient":
+        return RecordingClusterClient(client, self)
+
+    def record_call(self, method: str, key: str, ok: bool,
+                    result: Any = None,
+                    error: Optional[BaseException] = None) -> None:
+        self._ensure_header()
+        frame: Dict[str, Any] = {
+            "kind": "call", "tick": self._tick, "method": method,
+            "key": key, "ok": bool(ok),
+        }
+        if ok:
+            frame["result"] = result
+        else:
+            frame["error_type"] = type(error).__name__
+            frame["error_msg"] = str(error)
+        self._writer.append(frame)
+        self.calls_recorded += 1
+
+    # -- tick boundaries ----------------------------------------------------
+    def begin_tick(self, tick: int) -> None:
+        self._ensure_header()
+        self._tick = int(tick)
+
+    def end_tick(self, out: Dict[str, Any],
+                 features: Optional[np.ndarray] = None) -> None:
+        """Seal one poll: the DELIVERED ranking (depth-lagged at pipeline
+        depth >= 2 — replay at the same depth reproduces the same lag) and
+        the host feature mirror's digest, with full rows while small."""
+        health = out.get("health", {}) or {}
+        frame: Dict[str, Any] = {
+            "kind": "tick", "tick": self._tick,
+            "ranked": out.get("ranked", []),
+            "ranked_digest": digest_obj(out.get("ranked", [])),
+            "quiet": bool(out.get("quiet", False)),
+            "resynced": bool(out.get("resynced", False)),
+            "degraded": bool(out.get("degraded", False)),
+            "changed_rows": int(out.get("changed_rows", 0)),
+            "health": {k: health.get(k) for k in _HEALTH_KEYS},
+        }
+        if features is not None:
+            f = np.asarray(features, np.float32)
+            frame["features_digest"] = digest_array(f)
+            frame["features_shape"] = list(f.shape)
+            if f.size <= self.features_cap:
+                frame["features"] = encode_array(f)
+        self._writer.append(frame)
+        self.ticks_recorded += 1
+
+    # -- serve records -------------------------------------------------------
+    def record_serve(self, req: Any, ranked: List[dict]) -> None:
+        """One served request, self-contained: the full inputs plus the
+        ranking the coalesced batch produced — replay re-runs the same
+        analysis solo and the serve parity contract (any batch width ==
+        solo) makes bit-identity the expectation, not a hope."""
+        self._ensure_header()
+        self._writer.append({
+            "kind": "serve", "index": self.serve_recorded,
+            "request_id": req.request_id, "tenant": req.tenant,
+            "k": int(req.k),
+            "names": list(req.names) if req.names is not None else None,
+            "features": encode_array(req.features),
+            "dep_src": encode_array(req.dep_src),
+            "dep_dst": encode_array(req.dep_dst),
+            "ranked": ranked,
+            "ranked_digest": digest_obj(ranked),
+        }, compress=True)
+        self.serve_recorded += 1
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def bytes_written(self) -> int:
+        return self._writer.bytes_written
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._ensure_header()
+        self._writer.append({
+            "kind": "end", "ticks": self.ticks_recorded,
+            "serve": self.serve_recorded, "calls": self.calls_recorded,
+        })
+        self._writer.close()
+        self._closed = True
+
+    def __enter__(self) -> "Recorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RecordingClusterClient:
+    """Transparent recording proxy over any ``ClusterClient`` (or a chaos
+    wrapper around one).  Every METHOD call passes through and its result
+    — or raised exception — is recorded under the current tick; results
+    are serialized at call time, so later in-place mutation by the caller
+    cannot retro-edit the tape.  Non-callable attributes pass through
+    unrecorded, and attributes the inner client lacks raise
+    ``AttributeError`` exactly as before, so ``hasattr``-gated optional
+    surfaces (``collect_errors``, ``drain_injected``, ``watch_close``)
+    keep their presence/absence semantics on replay."""
+
+    def __init__(self, inner: Any, recorder: Recorder):
+        self._rec_inner = inner
+        self._rec_recorder = recorder
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._rec_inner, name)  # AttributeError propagates
+        if not callable(attr):
+            return attr
+        recorder = self._rec_recorder
+
+        def recorded(*args: Any, **kwargs: Any) -> Any:
+            key = make_call_key(args, kwargs)
+            try:
+                result = attr(*args, **kwargs)
+            except Exception as exc:
+                recorder.record_call(name, key, ok=False, error=exc)
+                raise
+            recorder.record_call(name, key, ok=True, result=result)
+            return result
+
+        recorded.__name__ = name
+        return recorded

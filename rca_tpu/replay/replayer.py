@@ -1,0 +1,470 @@
+"""Deterministic replay: re-drive the real engine from a recording.
+
+``replay_stream`` rebuilds a :class:`LiveStreamingSession` with the
+knobs the recording's header captured, feeds it a
+:class:`rca_tpu.replay.source.ReplaySource` instead of a cluster, and
+asserts tick-by-tick bit-identity of the delivered rankings against the
+``tick`` frames.  Any engine kind may replay any recording — the capture
+path asks the cluster the same questions regardless of engine, and the
+dense/sharded engines are parity-locked — so a production incident
+recorded on a sharded TPU session re-drives on a laptop CPU.
+
+``bisect_divergence`` localizes a parity break: probe(T) replays a FRESH
+session from tick 1 through T and compares only tick T, and a binary
+search finds the minimal divergent T.  The monotonicity this relies on is
+the state-contamination property the chaos harness already leans on: a
+tick that computes from diverged state stays diverged until a full resync
+rewrites it — and a resync's inputs come from the same recorded calls, so
+a pre-resync divergence moves the probe boundary, not the verdict.  At
+the first divergent tick both sides' feature/ranking tensors dump to a
+JSON file for diffing.
+
+``replay_serve`` replays serve-mode recordings: every ``serve`` frame is
+self-contained (full request inputs + the ranking its coalesced batch
+produced), so replay re-runs each analysis solo and leans on the serving
+parity contract (any batch width == solo, SERVING.md) for bit-identity.
+
+``mint_recording`` compacts a recording directory into one
+frame-compressed file — the committed-corpus form consumed by
+``tests/corpus`` (every fixture there replays under tier-1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from rca_tpu.replay.format import (
+    ReadStatus,
+    RecordingWriter,
+    ReplayFormatError,
+    SCHEMA_VERSION,
+    decode_array,
+    digest_array,
+    digest_obj,
+    read_frames,
+)
+from rca_tpu.replay.recorder import env_fingerprint
+from rca_tpu.replay.source import ReplaySource
+
+#: mismatched ticks listed in full before the report truncates (the
+#: count and first divergence always survive)
+_MISMATCH_DETAIL_CAP = 8
+
+
+@dataclasses.dataclass
+class Recording:
+    """A parsed recording: header + frames partitioned by kind."""
+
+    path: str
+    header: Dict[str, Any]
+    calls: List[Dict[str, Any]]
+    ticks: Dict[int, Dict[str, Any]]
+    serve: List[Dict[str, Any]]
+    end: Optional[Dict[str, Any]]
+    status: ReadStatus
+
+    @property
+    def mode(self) -> str:
+        return self.header.get("mode", "stream")
+
+    @property
+    def session_info(self) -> Dict[str, Any]:
+        return self.header.get("session", {}) or {}
+
+    @property
+    def clean_close(self) -> bool:
+        """The recorder closed properly (end frame present, no broken
+        tail) — a crashed capture still replays its complete ticks."""
+        return self.end is not None and self.status.clean
+
+
+def load_recording(path: str) -> Recording:
+    frames, status = read_frames(path)
+    if not frames or frames[0].get("kind") != "header":
+        raise ReplayFormatError(f"{path}: recording has no header frame")
+    header = frames[0]
+    if header.get("schema") != SCHEMA_VERSION:
+        raise ReplayFormatError(
+            f"{path}: header schema {header.get('schema')!r}, this build "
+            f"reads {SCHEMA_VERSION}"
+        )
+    calls: List[Dict[str, Any]] = []
+    ticks: Dict[int, Dict[str, Any]] = {}
+    serve: List[Dict[str, Any]] = []
+    end = None
+    for fr in frames[1:]:
+        kind = fr.get("kind")
+        if kind == "call":
+            calls.append(fr)
+        elif kind == "tick":
+            ticks[int(fr["tick"])] = fr
+        elif kind == "serve":
+            serve.append(fr)
+        elif kind == "end":
+            end = fr
+    # calls are written before their tick frame seals the poll, so a tick
+    # frame's presence implies its calls all survived any truncation —
+    # ticks past the break simply have no frame and are not replayed
+    return Recording(path=str(path), header=header, calls=calls,
+                     ticks=ticks, serve=serve, end=end, status=status)
+
+
+# -- stream replay ----------------------------------------------------------
+
+@dataclasses.dataclass
+class _StreamRun:
+    session: Any
+    delivered: Dict[int, List[dict]]  # tick -> delivered ranking
+    mismatched: List[int]             # ticks whose digest diverged
+    unconsumed_calls: int             # recorded calls replay never made
+
+
+def _engine_for(rec: Recording, engine: Any) -> Any:
+    """Default replay engine: the RECORDED kind.  Stream rankings
+    (component + score) are parity-locked across engines, but serve
+    results carry per-node channels (downstream_impact, ...) whose
+    sharded psum reductions differ from the dense sum at the last ulp —
+    bitwise claims only hold like-for-like, so like-for-like is the
+    default and cross-engine replay is an explicit choice."""
+    if engine is not None:
+        return engine
+    tag = rec.session_info.get("engine")
+    if tag == "GraphEngine":
+        from rca_tpu.engine.runner import GraphEngine
+
+        return GraphEngine()
+    if tag == "ShardedGraphEngine":
+        from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+        return ShardedGraphEngine()
+    from rca_tpu.engine.sharded_runner import make_engine
+
+    return make_engine()
+
+
+def _replay_session(rec: Recording, source: ReplaySource, engine: Any,
+                    pipeline_depth: Optional[int]) -> Any:
+    from rca_tpu.engine.live import LiveStreamingSession
+
+    info = rec.session_info
+    return LiveStreamingSession(
+        source,
+        info.get("namespace", "default"),
+        k=int(info.get("k", 5)),
+        engine=engine,
+        topology_check_every=int(info.get("topology_check_every", 5)),
+        use_watch=bool(info.get("use_watch", True)),
+        pipeline_depth=pipeline_depth,
+    )
+
+
+def _run_stream(rec: Recording, engine: Any = None,
+                pipeline_depth: Optional[int] = None,
+                upto: Optional[int] = None,
+                compare: bool = True) -> _StreamRun:
+    info = rec.session_info
+    depth = (
+        int(info.get("pipeline_depth", 1)) if pipeline_depth is None
+        else max(1, int(pipeline_depth))
+    )
+    src_ticks = sorted(rec.ticks)
+    if upto is not None:
+        src_ticks = [t for t in src_ticks if t <= upto]
+    # bootstrap (tick 0) consumes the recorded initial capture
+    source = ReplaySource(rec.calls)
+    session = _replay_session(rec, source, _engine_for(rec, engine), depth)
+    delivered: Dict[int, List[dict]] = {}
+    mismatched: List[int] = []
+    unconsumed = 0
+    for t in src_ticks:
+        source.advance(t)
+        out = session.poll()
+        delivered[t] = out["ranked"]
+        unconsumed += source.unconsumed()
+        if compare and digest_obj(out["ranked"]) != (
+            rec.ticks[t]["ranked_digest"]
+        ):
+            mismatched.append(t)
+    return _StreamRun(session=session, delivered=delivered,
+                      mismatched=mismatched, unconsumed_calls=unconsumed)
+
+
+def _serial_sequence(by_tick: Dict[int, List[dict]], depth: int
+                     ) -> List[List[dict]]:
+    """Strip pipeline lag: delivered tick t carries serial ranking
+    t-(depth-1), so the serial sequence is the delivered one with the
+    first depth-1 (fill) entries dropped.  Exact for fault-free logs;
+    degradation flushes re-fill the pipeline and shift the tail."""
+    ordered = [by_tick[t] for t in sorted(by_tick)]
+    return ordered[max(0, depth - 1):]
+
+
+def replay_stream(
+    path: str,
+    engine: Any = None,
+    pipeline_depth: Optional[int] = None,
+    seek: Optional[int] = None,
+    ticks: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Replay a stream recording and score per-tick bit-identity.
+
+    ``seek`` replays up to that tick (time travel) and attaches its full
+    detail (both rankings, feature digests/rows) to the report.  When the
+    replay depth differs from the recorded one, per-tick delivered
+    rankings legitimately shift by the lag difference, so the report
+    compares the lag-stripped SERIAL sequences instead."""
+    rec = load_recording(path)
+    if rec.mode != "stream":
+        raise ValueError(f"{path}: {rec.mode!r} recording; use replay_serve")
+    info = rec.session_info
+    rec_depth = int(info.get("pipeline_depth", 1))
+    depth = rec_depth if pipeline_depth is None else max(1, int(pipeline_depth))
+    upto = seek
+    if ticks is not None:
+        upto = min(ticks, upto) if upto is not None else ticks
+    run = _run_stream(rec, engine=engine, pipeline_depth=depth, upto=upto,
+                      compare=(depth == rec_depth))
+    report: Dict[str, Any] = {
+        "mode": "stream",
+        "recording": rec.path,
+        "ticks_recorded": len(rec.ticks),
+        "ticks_replayed": len(run.delivered),
+        "clean_close": rec.clean_close,
+        "read_status": rec.status.to_dict(),
+        "pipeline_depth_recorded": rec_depth,
+        "pipeline_depth_replayed": depth,
+        "engine_recorded": info.get("engine"),
+        "engine_replayed": type(run.session.engine).__name__,
+        "unconsumed_calls": run.unconsumed_calls,
+        "env_recorded": rec.header.get("env", {}),
+        "env_replay": env_fingerprint(),
+    }
+    if depth == rec_depth:
+        report["parity_ok"] = (
+            not run.mismatched and run.unconsumed_calls == 0
+        )
+        report["mismatched_ticks"] = run.mismatched[:_MISMATCH_DETAIL_CAP]
+        report["first_divergent_tick"] = (
+            run.mismatched[0] if run.mismatched else None
+        )
+    else:
+        recorded_serial = _serial_sequence(
+            {t: rec.ticks[t]["ranked"] for t in run.delivered}, rec_depth
+        )
+        replayed_serial = _serial_sequence(run.delivered, depth)
+        n = min(len(recorded_serial), len(replayed_serial))
+        first = next(
+            (i for i in range(n)
+             if digest_obj(recorded_serial[i]) != digest_obj(
+                 replayed_serial[i])),
+            None,
+        )
+        report["serial_ticks_compared"] = n
+        report["parity_ok"] = first is None and run.unconsumed_calls == 0
+        report["first_divergent_serial"] = first
+    if seek is not None:
+        t = seek
+        recd = rec.ticks.get(t)
+        detail: Dict[str, Any] = {
+            "tick": t,
+            "replayed_ranked": run.delivered.get(t),
+            "recorded_ranked": recd["ranked"] if recd else None,
+            "recorded_features_digest": (
+                recd.get("features_digest") if recd else None
+            ),
+        }
+        feats = getattr(run.session, "_features", None)
+        if feats is not None:
+            detail["replayed_features_digest"] = digest_array(
+                np.asarray(feats, np.float32)
+            )
+        report["seek"] = detail
+    return report
+
+
+# -- divergence bisect ------------------------------------------------------
+
+def bisect_divergence(
+    path: str,
+    engine: Any = None,
+    pipeline_depth: Optional[int] = None,
+    dump_path: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Binary-search the FIRST divergent tick of a diverging recording.
+
+    Each probe replays a fresh session from tick 1 through T and judges
+    only tick T, so the search needs no per-tick trust in intermediate
+    comparisons; O(n log n) tick replays total, all sharing the jitted
+    executables.  On divergence, both sides' tensors at the first
+    divergent tick are dumped for diffing."""
+    rec = load_recording(path)
+    if rec.mode != "stream":
+        raise ValueError(f"{path}: {rec.mode!r} recording; use replay_serve")
+    tick_ids = sorted(rec.ticks)
+    if not tick_ids:
+        raise ValueError(f"{path}: recording holds no ticks")
+
+    def divergent_at(t: int) -> bool:
+        run = _run_stream(rec, engine=engine, pipeline_depth=pipeline_depth,
+                          upto=t)
+        return t in set(run.mismatched)
+
+    probes = 0
+    last = tick_ids[-1]
+    probes += 1
+    if not divergent_at(last):
+        return {
+            "mode": "stream", "recording": rec.path, "divergent": False,
+            "ticks": len(tick_ids), "probes": probes,
+            "first_divergent_tick": None,
+        }
+    lo, hi = 0, len(tick_ids) - 1  # invariant: tick_ids[hi] divergent
+    while lo < hi:
+        mid = (lo + hi) // 2
+        probes += 1
+        if divergent_at(tick_ids[mid]):
+            hi = mid
+        else:
+            lo = mid + 1
+    first = tick_ids[lo]
+
+    # dump both sides' tensors at the divergence for offline diffing
+    run = _run_stream(rec, engine=engine, pipeline_depth=pipeline_depth,
+                      upto=first)
+    recd = rec.ticks[first]
+    dump: Dict[str, Any] = {
+        "tick": first,
+        "recorded_ranked": recd["ranked"],
+        "replayed_ranked": run.delivered.get(first),
+        "recorded_features_digest": recd.get("features_digest"),
+        "recorded_features": recd.get("features"),
+    }
+    feats = getattr(run.session, "_features", None)
+    if feats is not None:
+        f = np.asarray(feats, np.float32)
+        dump["replayed_features_digest"] = digest_array(f)
+        dump["replayed_features_shape"] = list(f.shape)
+        if recd.get("features") is not None:
+            rf = decode_array(recd["features"])
+            if rf.shape == f.shape:
+                diff = np.abs(rf - f)
+                rows = np.flatnonzero(np.any(rf != f, axis=1))
+                dump["feature_diff"] = {
+                    "max_abs": float(diff.max()),
+                    "rows_differing": [int(r) for r in rows[:32]],
+                    "n_rows_differing": int(len(rows)),
+                }
+    out_path = dump_path or _default_dump_path(rec.path)
+    import json as _json
+
+    with open(out_path, "w", encoding="utf-8") as f:
+        _json.dump(dump, f, default=str)
+    return {
+        "mode": "stream", "recording": rec.path, "divergent": True,
+        "first_divergent_tick": first, "probes": probes,
+        "ticks": len(tick_ids), "dump": out_path,
+        "recorded_ranked": recd["ranked"],
+        "replayed_ranked": run.delivered.get(first),
+    }
+
+
+def _default_dump_path(path: str) -> str:
+    base = path.rstrip("/\\")
+    return base + ".divergence.json"
+
+
+# -- serve replay -----------------------------------------------------------
+
+def replay_serve(path: str, engine: Any = None) -> Dict[str, Any]:
+    """Re-run every recorded served request solo and assert bit-identity
+    with the ranking its (arbitrarily coalesced) batch produced."""
+    from rca_tpu.serve.dispatcher import BatchDispatcher
+    from rca_tpu.serve.request import ServeRequest
+
+    rec = load_recording(path)
+    if rec.mode != "serve":
+        raise ValueError(f"{path}: {rec.mode!r} recording; use replay_stream")
+    disp = BatchDispatcher(_engine_for(rec, engine))
+    mismatched: List[Dict[str, Any]] = []
+    for fr in rec.serve:
+        req = ServeRequest(
+            tenant=fr["tenant"],
+            features=decode_array(fr["features"]),
+            dep_src=decode_array(fr["dep_src"]),
+            dep_dst=decode_array(fr["dep_dst"]),
+            names=fr.get("names"), k=int(fr.get("k", 5)),
+        )
+        result = disp.fetch(disp.dispatch([req]))[0]
+        ranked = [dict(r) for r in result.ranked]
+        if digest_obj(ranked) != fr["ranked_digest"]:
+            mismatched.append({
+                "index": fr.get("index"),
+                "request_id": fr.get("request_id"),
+                "recorded": fr["ranked"], "replayed": ranked,
+            })
+    return {
+        "mode": "serve",
+        "recording": rec.path,
+        "requests_recorded": len(rec.serve),
+        "clean_close": rec.clean_close,
+        "read_status": rec.status.to_dict(),
+        "parity_ok": not mismatched,
+        "mismatched": mismatched[:_MISMATCH_DETAIL_CAP],
+        "first_divergent_index": (
+            mismatched[0]["index"] if mismatched else None
+        ),
+        "engine_replayed": disp.engine_tag,
+        "env_recorded": rec.header.get("env", {}),
+        "env_replay": env_fingerprint(),
+    }
+
+
+def replay(path: str, **kwargs: Any) -> Dict[str, Any]:
+    """Mode-dispatching convenience: stream recordings replay through the
+    live session, serve recordings through the solo dispatcher."""
+    rec = load_recording(path)
+    if rec.mode == "serve":
+        return replay_serve(path, engine=kwargs.get("engine"))
+    return replay_stream(path, **kwargs)
+
+
+# -- minting (corpus fixtures) ----------------------------------------------
+
+def mint_recording(src: str, out: str,
+                   require_clean: bool = True) -> Dict[str, Any]:
+    """Compact a recording into ONE frame-compressed file — the committed
+    corpus form.  Refuses (by default) to mint a truncated/corrupt or
+    unclosed capture: a fixture must be complete evidence."""
+    rec = load_recording(src)
+    if require_clean and not rec.clean_close:
+        raise ValueError(
+            f"{src}: not cleanly closed ({rec.status.to_dict()}) — "
+            "refusing to mint a fixture from partial evidence"
+        )
+    frames, _status = read_frames(src)
+    writer = RecordingWriter(out, single_file=True)
+    for fr in frames:
+        writer.append(fr, compress=True)
+    writer.close()
+    src_bytes = _tree_bytes(src)
+    return {
+        "src": str(src), "out": str(out),
+        "frames": len(frames),
+        "ticks": len(rec.ticks), "serve": len(rec.serve),
+        "bytes_in": src_bytes,
+        "bytes_out": os.path.getsize(out),
+    }
+
+
+def _tree_bytes(path: str) -> int:
+    if os.path.isdir(path):
+        return sum(
+            os.path.getsize(os.path.join(path, n))
+            for n in os.listdir(path)
+            if os.path.isfile(os.path.join(path, n))
+        )
+    return os.path.getsize(path)

@@ -207,6 +207,9 @@ def run_chaos_soak(
     engine_factory: Optional[Callable[[], Any]] = None,
     config: Optional[ChaosConfig] = None,
     topology_check_every: int = 5,
+    record_path: Optional[str] = None,
+    pipeline_depth: Optional[int] = None,
+    replay_check: bool = True,
 ) -> Dict[str, Any]:
     """Run ``ticks`` polls of a :class:`LiveStreamingSession` over a
     chaos-wrapped mock world and score the resilience contract:
@@ -219,6 +222,13 @@ def run_chaos_soak(
 
     ``make_world`` is called twice (baseline + chaos) so the two sessions
     never share mutable state.
+
+    ``record_path`` attaches a flight recorder (ISSUE 5) to the CHAOS
+    session: every client call (faults included) and every tick's ranking
+    land in the log, and — with ``replay_check`` — the soak finishes by
+    replaying its own recording through a fresh engine and asserting
+    tick-for-tick bit-identity (``summary["replay"]``): a chaos run is
+    thereby a durable regression artifact, not a one-shot.
     """
     from rca_tpu.cluster.mock_client import MockClusterClient
     from rca_tpu.engine.live import LiveStreamingSession
@@ -228,8 +238,19 @@ def run_chaos_soak(
     base = LiveStreamingSession(
         MockClusterClient(make_world()), namespace, k=k,
         engine=make_engine(), topology_check_every=topology_check_every,
+        pipeline_depth=pipeline_depth,
     )
     baseline_ranked = json.dumps(base.poll()["ranked"], sort_keys=True)
+
+    recorder = None
+    if record_path is not None:
+        from rca_tpu.replay import Recorder
+
+        recorder = Recorder(
+            record_path, mode="stream",
+            seeds={"chaos_seed": seed},
+            meta={"harness": "chaos_soak", "ticks": ticks},
+        )
 
     cfg = config or ChaosConfig(seed=seed)
     was_enabled = cfg.enabled
@@ -238,6 +259,7 @@ def run_chaos_soak(
     live = LiveStreamingSession(
         chaos, namespace, k=k, engine=make_engine(),
         topology_check_every=topology_check_every,
+        pipeline_depth=pipeline_depth, recorder=recorder,
     )
     cfg.enabled = was_enabled
 
@@ -277,9 +299,30 @@ def run_chaos_soak(
             ranked = json.dumps(out["ranked"], sort_keys=True)
             if ranked != baseline_ranked:
                 parity_ok = False
+    replay_summary = None
+    if recorder is not None:
+        recorder.close()
+        replay_summary = {
+            "path": recorder.path,
+            "ticks_recorded": recorder.ticks_recorded,
+            "bytes": recorder.bytes_written,
+        }
+        if replay_check:
+            # the record→replay parity leg: re-drive the REAL engine from
+            # the log just written and demand bit-identical rankings
+            from rca_tpu.replay import replay_stream
+
+            report = replay_stream(record_path, engine=make_engine())
+            replay_summary.update({
+                "parity_ok": report["parity_ok"],
+                "first_divergent_tick": report.get("first_divergent_tick"),
+                "ticks_replayed": report["ticks_replayed"],
+                "unconsumed_calls": report["unconsumed_calls"],
+            })
     return {
         "ticks": ticks,
         "seed": seed,
+        **({"replay": replay_summary} if replay_summary else {}),
         "uncaught_exceptions": uncaught,
         "faults_injected": counts,
         "fault_classes_observed": sorted(

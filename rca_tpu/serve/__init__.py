@@ -19,6 +19,12 @@ layer (SERVING.md):
 - :mod:`rca_tpu.serve.client` — in-process client, the coordinator's
   EngineAPI facade, and the ``rca serve --selftest`` harness;
 - :mod:`rca_tpu.serve.metrics` — per-tenant queue/occupancy metrics.
+
+The loop optionally writes through a flight recorder
+(:class:`rca_tpu.replay.Recorder`, ``ServeLoop(recorder=...)`` /
+``rca serve --record``): every OK response logs its full request inputs
+and ranking as a self-contained frame, replayable solo via
+``rca replay`` under the coalesced-vs-solo parity contract (REPLAY.md).
 """
 
 from rca_tpu.serve.batcher import ShapeBucketBatcher

@@ -85,10 +85,14 @@ class BatchDispatcher:
         engine=None,
         fault_hook: Optional[Callable[[str], None]] = None,
         cache_cap: int = GRAPH_CACHE_CAP,
+        clock: Callable[[], float] = time.perf_counter,
     ):
         from rca_tpu.engine.runner import GraphEngine
 
         self.engine = engine if engine is not None else GraphEngine()
+        # injectable monotonic timer (nondet-discipline: the serve path's
+        # latency stamps never read the clock module directly)
+        self._clock = clock
         # chaos surface (tests / `rca serve --selftest --chaos`): called
         # with "dispatch"/"fetch" before the device work; a raise here
         # exercises the serve loop's breaker + degraded-response path
@@ -171,7 +175,7 @@ class BatchDispatcher:
             raise ValueError("batch members must share a graph_key")
         if self.fault_hook is not None:
             self.fault_hook("dispatch")
-        t0 = time.perf_counter()
+        t0 = self._clock()
         gs = self._prepared(batch[0])
         b = len(batch)
         b_pad = self._b_pad(b)
@@ -207,8 +211,10 @@ class BatchDispatcher:
         return BatchHandle(
             requests=list(batch), stacked=stacked, vals=vals, idx=idx,
             n_bad=n_bad, n=gs.n, engine_tag=self.engine_tag,
-            dispatch_ms=(time.perf_counter() - t0) * 1e3,
-            dispatched_at=now if now is not None else time.monotonic(),
+            dispatch_ms=(self._clock() - t0) * 1e3,
+            # direct (loop-less) callers get a self-consistent stamp; the
+            # serve loop always passes its scheduler clock's ``now``
+            dispatched_at=now if now is not None else self._clock(),
         )
 
     def fetch(self, handle: BatchHandle) -> List[object]:
@@ -225,11 +231,11 @@ class BatchDispatcher:
 
         if self.fault_hook is not None:
             self.fault_hook("fetch")
-        t1 = time.perf_counter()
+        t1 = self._clock()
         stacked, vals, idx, n_bad = jax.device_get(
             (handle.stacked, handle.vals, handle.idx, handle.n_bad)
         )
-        fetch_ms = (time.perf_counter() - t1) * 1e3
+        fetch_ms = (self._clock() - t1) * 1e3
         per_req_ms = (handle.dispatch_ms + fetch_ms) / len(handle.requests)
         results = []
         for b, req in enumerate(handle.requests):

@@ -66,6 +66,7 @@ class ServeLoop:
         fault_hook: Optional[Callable[[str], None]] = None,
         breaker: Optional[CircuitBreaker] = None,
         dispatcher: Optional[BatchDispatcher] = None,
+        recorder=None,
     ):
         self.config = config or ServeConfig.from_env()
         self.clock = clock
@@ -93,6 +94,17 @@ class ServeLoop:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.device_batches = 0   # batches actually dispatched to device
+        # flight recorder (ISSUE 5): every OK response logs its full
+        # request inputs + ranking as a self-contained serve frame,
+        # written only from the worker thread (one writer, no lock)
+        self.recorder = recorder
+        if recorder is not None:
+            recorder.begin_session({
+                "engine": type(self.dispatcher.engine).__name__,
+                "max_batch": self.config.max_batch,
+                "max_wait_us": self.config.max_wait_us,
+                "queue_cap": self.config.queue_cap,
+            })
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServeLoop":
@@ -267,6 +279,10 @@ class ServeLoop:
         for req, result in zip(handle.requests, results):
             ranked = [dict(r) for r in result.ranked]
             self._remember(req.graph_key, ranked)
+            if self.recorder is not None:
+                # a recording failure must not fail the response
+                with suppressed("serve.record"):
+                    self.recorder.record_serve(req, ranked)
             queue_ms = max(
                 0.0, (handle.dispatched_at - req.enqueued_at) * 1e3
             )
@@ -329,3 +345,10 @@ class ServeLoop:
                     "engine": result.engine,
                 },
             )
+            if self.recorder is not None:
+                # a recorded serve run stamps its investigations with the
+                # recording's path, so `rca replay --investigation <id>`
+                # can re-drive the analysis from the id alone
+                self.store.set_recording_ref(
+                    req.investigation_id, str(self.recorder.path)
+                )

@@ -74,6 +74,7 @@ class InvestigationStore:
         namespace: str = "default",
         context: str = "",
         investigation_id: Optional[str] = None,
+        recording_ref: Optional[str] = None,
     ) -> Dict[str, Any]:
         inv = {
             "id": investigation_id or str(uuid.uuid4()),
@@ -89,6 +90,10 @@ class InvestigationStore:
             "agent_findings": {},
             "next_actions": [],
             "accumulated_findings": [],
+            # optional flight-recording path (rca_tpu/replay, REPLAY.md):
+            # when set, this analysis can be re-driven deterministically
+            # via `rca replay --investigation <id>`
+            "recording_ref": recording_ref,
         }
         with self._locked(inv["id"]):
             self._write(inv)
@@ -116,6 +121,7 @@ class InvestigationStore:
                         "created_at": inv.get("created_at", ""),
                         "updated_at": inv.get("updated_at", ""),
                         "messages": len(inv.get("conversation", [])),
+                        "replayable": bool(inv.get("recording_ref")),
                     }
                 )
         out.sort(key=lambda r: r.get("updated_at", ""), reverse=True)
@@ -209,6 +215,21 @@ class InvestigationStore:
         return self._update(
             investigation_id, lambda inv: inv.__setitem__("status", status)
         )
+
+    def set_recording_ref(
+        self, investigation_id: str, recording_ref: str
+    ) -> Optional[Dict[str, Any]]:
+        """Attach the flight recording that captured this investigation's
+        served analyses — `rca replay --investigation <id>` resolves the
+        log through this field."""
+        return self._update(
+            investigation_id,
+            lambda inv: inv.__setitem__("recording_ref", recording_ref),
+        )
+
+    def get_recording_ref(self, investigation_id: str) -> Optional[str]:
+        inv = self._read(investigation_id)
+        return (inv or {}).get("recording_ref")
 
     def save_hypothesis(
         self, investigation_id: str, hypothesis: Dict[str, Any]

@@ -113,6 +113,29 @@ def f():
     except Exception:
         pass
 """, 1),
+    "nondet-discipline": ("rca_tpu/serve/bad_nondet.py", """\
+import datetime
+import random
+import time
+
+import numpy as np
+
+
+def stamp():
+    return time.time()              # wall read outside the clock seam
+
+
+def jitter():
+    return random.random()          # module-level (global-state) draw
+
+
+def when():
+    return datetime.datetime.now()  # wall read
+
+
+def rng():
+    return np.random.default_rng()  # unseeded constructor
+""", 4),
 }
 
 
@@ -307,13 +330,61 @@ def test_baseline_is_empty():
     assert load_baseline(default_baseline_path(ROOT)) == []
 
 
-def test_all_seven_rules_registered():
+def test_all_eight_rules_registered():
     assert set(all_rules()) == {
         "tick-sync", "swallowed-faults", "tracer-leak", "retrace-hazard",
         "rng-key-reuse", "lock-discipline", "env-discipline",
+        "nondet-discipline",
     }
     for rule in all_rules().values():
         assert rule.summary and rule.why
+
+
+def test_nondet_seams_stay_legal(tmp_path):
+    """The injectable seams the rule documents must NOT fire: a clock
+    function passed as a default parameter (reference, not call), seeded
+    random.Random / default_rng construction, and self._clock() timing."""
+    root = _fake_repo(tmp_path, ("rca_tpu/serve/good_nondet.py", """\
+import random
+import time
+
+import numpy as np
+
+
+class Worker:
+    def __init__(self, clock=time.monotonic, seed=0):
+        self._clock = clock
+        self._rng = random.Random(seed)
+        self._np = np.random.default_rng(seed)
+
+    def stamp(self):
+        return self._clock()
+"""))
+    result = run_lint(root=root, rules=["nondet-discipline"],
+                      use_baseline=False)
+    assert result.clean, result.findings
+
+
+def test_nondet_allowlist_covers_documented_seams():
+    """The shipped allowlist entries are the two documented wall seams —
+    running the rule over those exact files stays clean, and removing the
+    allowlist in-memory makes them fire (the allowlist is load-bearing,
+    not decorative)."""
+    paths = ["rca_tpu/cluster/mock_client.py",
+             "rca_tpu/replay/recorder.py"]
+    result = run_lint(root=ROOT, rules=["nondet-discipline"],
+                      use_baseline=False, paths=paths)
+    assert result.clean, result.findings
+
+    rule = all_rules()["nondet-discipline"]
+    saved = rule.allow
+    try:
+        rule.allow = {}
+        bare = run_lint(root=ROOT, rules=["nondet-discipline"],
+                        use_baseline=False, paths=paths)
+        assert len(bare.findings) >= 2  # the seams exist and are fenced
+    finally:
+        rule.allow = saved
 
 
 # ---------------------------------------------------------------------------

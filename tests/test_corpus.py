@@ -1,0 +1,58 @@
+"""Corpus gate (REPLAY.md): every committed flight-recording fixture
+under tests/corpus/ replays bit-identically through the real engine.
+
+A fixture here is a minted chaos/stream/serve recording — a PERMANENT
+regression test: any change that shifts one ranked bit on any recorded
+tick fails this gate with the exact tick (or request) named.  Fixtures
+are platform evidence: they were recorded on the CPU backend this suite
+runs on (the header's env fingerprint says so), which is what makes the
+bitwise assertion legitimate.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import pytest
+
+from rca_tpu.replay import load_recording, replay
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus")
+FIXTURES = sorted(glob.glob(os.path.join(CORPUS_DIR, "*.rcz")))
+
+
+def _label(path):
+    return os.path.basename(path)
+
+
+def test_corpus_is_not_empty():
+    """The corpus gate must be guarding something — PR 5 commits the
+    first minted chaos run."""
+    assert FIXTURES, f"no *.rcz fixtures under {CORPUS_DIR}"
+
+
+@pytest.mark.parametrize("path", FIXTURES, ids=_label)
+def test_fixture_is_complete_evidence(path):
+    """Minting refuses partial captures, and committed fixtures must
+    stay that way: clean frames, clean close, matching backend."""
+    rec = load_recording(path)
+    assert rec.status.clean, rec.status.to_dict()
+    assert rec.clean_close
+    assert rec.header["env"]["jax_backend"] == "cpu", (
+        "corpus fixtures must be recorded on the backend the suite "
+        "replays on — bitwise parity is a per-platform claim"
+    )
+
+
+@pytest.mark.parametrize("path", FIXTURES, ids=_label)
+def test_fixture_replays_bit_identical(path):
+    report = replay(path)
+    assert report["parity_ok"], {
+        k: report.get(k)
+        for k in ("first_divergent_tick", "first_divergent_index",
+                  "mismatched_ticks", "unconsumed_calls")
+    }
+    if report["mode"] == "stream":
+        assert report["ticks_replayed"] == report["ticks_recorded"]
+        assert report["unconsumed_calls"] == 0

@@ -1,0 +1,494 @@
+"""Flight recorder (rca_tpu/replay, REPLAY.md): record -> replay bit
+parity at every pipeline depth and engine kind, clean rejection of
+truncated/corrupt/foreign logs, seek/bisect divergence tooling, minting,
+the serve recording path, and the store's recording_ref plumbing."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.generator import (
+    synthetic_cascade_arrays,
+    synthetic_cascade_world,
+)
+from rca_tpu.replay import (
+    ReplayFormatError,
+    bisect_divergence,
+    digest_obj,
+    load_recording,
+    mint_recording,
+    read_frames,
+    replay_serve,
+    replay_stream,
+)
+from rca_tpu.replay.format import MAGIC, RecordingWriter, _MAGIC_PREFIX
+from rca_tpu.resilience.chaos import ChaosConfig, run_chaos_soak
+
+SOAK_TICKS = 60
+SOAK_SVC = 30
+
+
+def _soak(record_path, ticks=SOAK_TICKS, seed=7, pipeline_depth=None,
+          replay_check=False):
+    return run_chaos_soak(
+        lambda: synthetic_cascade_world(SOAK_SVC, n_roots=1, seed=0),
+        "synthetic", seed=seed, ticks=ticks,
+        config=ChaosConfig(seed=seed),
+        record_path=str(record_path), pipeline_depth=pipeline_depth,
+        replay_check=replay_check,
+    )
+
+
+@pytest.fixture(scope="module")
+def recorded_soak(tmp_path_factory):
+    """One 60-tick chaos soak, flight-recorded — shared by every test
+    that only READS the recording."""
+    path = str(tmp_path_factory.mktemp("replay") / "soak")
+    summary = _soak(path)
+    return path, summary
+
+
+# ---------------------------------------------------------------------------
+# round-trip parity (the tentpole property)
+# ---------------------------------------------------------------------------
+
+def test_chaos_soak_records_and_replays_bit_identical(recorded_soak):
+    """60-tick chaos run recorded then replayed: every delivered ranking
+    is bit-identical, every recorded cluster call was consumed, and the
+    recording closed cleanly."""
+    path, summary = recorded_soak
+    assert summary["uncaught_exceptions"] == 0
+    assert summary["replay"]["ticks_recorded"] == SOAK_TICKS
+    report = replay_stream(path)
+    assert report["parity_ok"], report
+    assert report["ticks_replayed"] == SOAK_TICKS
+    assert report["first_divergent_tick"] is None
+    assert report["unconsumed_calls"] == 0
+    assert report["clean_close"]
+    assert report["read_status"]["clean"]
+
+
+def test_depth2_record_replay_parity(tmp_path):
+    """60-tick chaos run recorded at pipeline depth 2, replayed at depth
+    2: the delivered (lagged) sequences match tick for tick."""
+    path = str(tmp_path / "d2")
+    summary = _soak(path, seed=3, pipeline_depth=2, replay_check=True)
+    assert summary["replay"]["parity_ok"], summary["replay"]
+    assert summary["replay"]["ticks_replayed"] == SOAK_TICKS
+    rec = load_recording(path)
+    assert rec.session_info["pipeline_depth"] == 2
+
+
+def test_sharded_recorded_soak_replays(tmp_path):
+    """60-tick chaos run recorded WITH the sharded engine replays bit
+    identically — and `auto` replay picks the recorded (sharded) kind."""
+    from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+    path = str(tmp_path / "sh")
+    summary = run_chaos_soak(
+        lambda: synthetic_cascade_world(SOAK_SVC, n_roots=1, seed=0),
+        "synthetic", seed=4, ticks=SOAK_TICKS, config=ChaosConfig(seed=4),
+        engine_factory=lambda: ShardedGraphEngine(spec="sp=4"),
+        record_path=path, replay_check=True,
+    )
+    assert summary["uncaught_exceptions"] == 0
+    assert summary["replay"]["parity_ok"], summary["replay"]
+    rec = load_recording(path)
+    assert rec.session_info["engine"] == "ShardedGraphEngine"
+    report = replay_stream(path, ticks=8)
+    assert report["engine_replayed"] == "ShardedGraphEngine"
+    assert report["parity_ok"], report
+
+
+def test_sharded_replay_of_recording(recorded_soak):
+    """A recording replays bit-identically on the SHARDED engine — the
+    capture path asks the cluster the same questions regardless of
+    engine, and the engines are parity-locked."""
+    from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+    path, _ = recorded_soak
+    report = replay_stream(path, engine=ShardedGraphEngine(spec="sp=4"),
+                           ticks=20)
+    assert report["parity_ok"], report
+    assert report["engine_replayed"] == "ShardedGraphEngine"
+
+
+def test_cross_depth_replay_compares_serial_sequences(tmp_path):
+    """Replaying a depth-1 recording at depth 2 shifts delivery by one
+    tick; the report compares the lag-stripped serial sequences.  Uses a
+    FAULT-FREE recording: degradation flushes re-fill the pipeline and
+    legitimately shift chaotic logs' delivery alignment."""
+    path = str(tmp_path / "clean")
+    run_chaos_soak(
+        lambda: synthetic_cascade_world(SOAK_SVC, n_roots=1, seed=0),
+        "synthetic", seed=1, ticks=20,
+        config=ChaosConfig(seed=1, enabled=False),
+        record_path=path, replay_check=False,
+    )
+    report = replay_stream(path, pipeline_depth=2)
+    assert report["pipeline_depth_recorded"] == 1
+    assert report["pipeline_depth_replayed"] == 2
+    assert report["parity_ok"], report
+    assert report["serial_ticks_compared"] >= 18
+
+
+def test_replay_reports_env_fingerprints(recorded_soak):
+    path, _ = recorded_soak
+    rec = load_recording(path)
+    env = rec.header["env"]
+    assert env["jax"] and env["numpy"] and env["jax_backend"]
+    report = replay_stream(path, ticks=3)
+    assert report["env_recorded"]["jax"] == report["env_replay"]["jax"]
+
+
+# ---------------------------------------------------------------------------
+# broken-log handling (truncated tail, corrupt CRC, foreign schema)
+# ---------------------------------------------------------------------------
+
+def _copy_recording(src, dst):
+    shutil.copytree(src, dst)
+    return sorted(
+        os.path.join(dst, n) for n in os.listdir(dst)
+        if n.endswith(".rcr")
+    )
+
+
+def test_truncated_tail_stops_cleanly(recorded_soak, tmp_path):
+    """A crash mid-append leaves a partial frame: the reader stops at the
+    last good frame and replay covers exactly the complete ticks."""
+    src, _ = recorded_soak
+    dst = str(tmp_path / "truncated")
+    chunks = _copy_recording(src, dst)
+    last = chunks[-1]
+    size = os.path.getsize(last)
+    with open(last, "r+b") as f:
+        f.truncate(size - 7)  # mid-frame: kills the end frame at least
+    frames, status = read_frames(dst)
+    assert status.truncated and not status.corrupt
+    assert frames  # the good prefix survives
+    report = replay_stream(dst)
+    assert not report["clean_close"]
+    assert report["read_status"]["truncated"]
+    assert 0 < report["ticks_replayed"] <= SOAK_TICKS
+    assert report["parity_ok"], report  # complete ticks still bit-match
+
+
+def test_corrupt_crc_stops_cleanly(recorded_soak, tmp_path):
+    src, _ = recorded_soak
+    dst = str(tmp_path / "corrupt")
+    chunks = _copy_recording(src, dst)
+    target = chunks[0]
+    # flip one payload byte well past the magic + first frames
+    with open(target, "r+b") as f:
+        f.seek(os.path.getsize(target) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    frames, status = read_frames(dst)
+    assert status.corrupt
+    assert "CRC" in status.detail or "undecodable" in status.detail
+    report = replay_stream(dst)
+    assert not report["clean_close"]
+    assert report["ticks_replayed"] < SOAK_TICKS
+    assert report["parity_ok"], report
+
+
+def test_schema_version_mismatch_is_an_error(recorded_soak, tmp_path):
+    src, _ = recorded_soak
+    dst = str(tmp_path / "future")
+    chunks = _copy_recording(src, dst)
+    with open(chunks[0], "r+b") as f:
+        f.seek(len(_MAGIC_PREFIX))
+        f.write(bytes([99]))  # a schema version this build does not read
+    with pytest.raises(ReplayFormatError, match="version 99"):
+        read_frames(dst)
+    with open(chunks[0], "r+b") as f:
+        f.write(b"NOTAREC!")
+    with pytest.raises(ReplayFormatError, match="not a flight recording"):
+        read_frames(dst)
+
+
+def test_empty_directory_is_not_a_recording(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        read_frames(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# seek / bisect
+# ---------------------------------------------------------------------------
+
+def test_seek_time_travel(recorded_soak):
+    path, _ = recorded_soak
+    report = replay_stream(path, seek=11)
+    detail = report["seek"]
+    assert detail["tick"] == 11
+    assert detail["replayed_ranked"] == detail["recorded_ranked"]
+    assert detail["replayed_features_digest"]
+    # seek stops the replay at the target tick
+    assert report["ticks_replayed"] == 11
+
+
+def _perturb(src, out, from_tick):
+    """Rewrite a recording with every tick >= from_tick's recorded
+    ranking bumped — a synthetic persistent divergence."""
+    frames, status = read_frames(src)
+    assert status.clean
+    w = RecordingWriter(out, single_file=True)
+    for fr in frames:
+        if fr.get("kind") == "tick" and fr["tick"] >= from_tick:
+            fr = dict(fr)
+            fr["ranked"] = [
+                {**r, "score": r["score"] + 1.0} for r in fr["ranked"]
+            ]
+            fr["ranked_digest"] = digest_obj(fr["ranked"])
+        w.append(fr)
+    w.close()
+
+
+def test_bisect_names_the_exact_first_divergent_tick(tmp_path):
+    path = str(tmp_path / "short")
+    _soak(path, ticks=16, seed=5)
+    perturbed = str(tmp_path / "perturbed.rcz")
+    _perturb(path, perturbed, from_tick=9)
+    report = bisect_divergence(perturbed)
+    assert report["divergent"]
+    assert report["first_divergent_tick"] == 9
+    # log-bounded probing, not one replay per tick
+    assert report["probes"] <= 6
+    dump = json.load(open(report["dump"]))
+    assert dump["tick"] == 9
+    assert dump["recorded_ranked"] != dump["replayed_ranked"]
+    assert dump["replayed_features_digest"]
+    # the soaked graph is small, so full recorded rows rode along and the
+    # dump carries an explicit tensor diff
+    assert dump["recorded_features"] is not None
+    assert dump["feature_diff"]["max_abs"] == 0.0  # rankings perturbed,
+    # features untouched: the diff localizes divergence to the engine side
+
+    clean = bisect_divergence(path)
+    assert not clean["divergent"]
+    assert clean["first_divergent_tick"] is None
+
+
+def test_replay_exit_contract_on_divergence(tmp_path):
+    """`rca replay` exits 1 on divergence and names the first tick."""
+    from rca_tpu.cli import main
+
+    path = str(tmp_path / "short")
+    _soak(path, ticks=12, seed=9)
+    perturbed = str(tmp_path / "p.rcz")
+    _perturb(path, perturbed, from_tick=6)
+    assert main(["replay", path, "--compact"]) == 0
+    assert main(["replay", perturbed, "--compact"]) == 1
+    assert main(["replay", perturbed, "--bisect", "--compact"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# minting (corpus fixtures)
+# ---------------------------------------------------------------------------
+
+def test_mint_round_trip(recorded_soak, tmp_path):
+    path, _ = recorded_soak
+    out = str(tmp_path / "fixture.rcz")
+    stats = mint_recording(path, out)
+    assert stats["ticks"] == SOAK_TICKS
+    assert os.path.getsize(out) == stats["bytes_out"]
+    report = replay_stream(out)
+    assert report["parity_ok"], report
+    assert report["ticks_replayed"] == SOAK_TICKS
+
+
+def test_mint_refuses_partial_evidence(recorded_soak, tmp_path):
+    src, _ = recorded_soak
+    dst = str(tmp_path / "broken")
+    chunks = _copy_recording(src, dst)
+    with open(chunks[-1], "r+b") as f:
+        f.truncate(os.path.getsize(chunks[-1]) - 3)
+    with pytest.raises(ValueError, match="refusing to mint"):
+        mint_recording(dst, str(tmp_path / "nope.rcz"))
+
+
+def test_chunk_rotation_and_fsync_boundaries(tmp_path):
+    """A tiny chunk budget forces rotation; the reader stitches chunks
+    back into one frame stream."""
+    from rca_tpu.replay import Recorder
+
+    path = str(tmp_path / "chunks")
+    rec = Recorder(path, chunk_bytes=4096)
+    rec.begin_session({"namespace": "x"})
+    for t in range(1, 40):
+        rec.begin_tick(t)
+        rec.record_call("get_pods", "[\"x\"]", ok=True,
+                        result=[{"metadata": {"name": f"p{t}"}}] * 20)
+        rec.end_tick({"ranked": [{"component": "p", "score": 1.0}]},
+                     features=np.zeros((4, 3), np.float32))
+    rec.close()
+    n_chunks = len([n for n in os.listdir(path) if n.endswith(".rcr")])
+    assert n_chunks > 1
+    frames, status = read_frames(path)
+    assert status.clean and status.chunks == n_chunks
+    loaded = load_recording(path)
+    assert len(loaded.ticks) == 39
+    assert loaded.clean_close
+
+
+# ---------------------------------------------------------------------------
+# serve recordings
+# ---------------------------------------------------------------------------
+
+def _serve_some(tmp_path, store=None, investigation_id=None, n=6):
+    from rca_tpu.engine.runner import GraphEngine
+    from rca_tpu.config import ServeConfig
+    from rca_tpu.replay import Recorder
+    from rca_tpu.serve import ServeClient, ServeLoop
+
+    case = synthetic_cascade_arrays(40, n_roots=1, seed=0)
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "serve-rec")
+    recorder = Recorder(path, mode="serve")
+    loop = ServeLoop(engine=GraphEngine(),
+                     config=ServeConfig(max_batch=4, max_wait_us=500),
+                     recorder=recorder, store=store)
+    with loop:
+        client = ServeClient(loop)
+        reqs = [
+            client.submit(
+                np.clip(case.features + rng.uniform(
+                    0, 0.05, case.features.shape).astype(np.float32), 0, 1),
+                case.dep_src, case.dep_dst, names=case.names,
+                tenant=f"t{i % 2}", k=5,
+                investigation_id=investigation_id,
+            )
+            for i in range(n)
+        ]
+        responses = [r.result(timeout=120.0) for r in reqs]
+    recorder.close()
+    return path, responses
+
+
+def test_serve_record_then_replay_bit_identical(tmp_path):
+    """Requests served from arbitrary coalesced batches replay SOLO with
+    bit-identical rankings (the serving parity contract made durable)."""
+    path, responses = _serve_some(tmp_path)
+    assert all(r.ok for r in responses)
+    report = replay_serve(path)
+    assert report["requests_recorded"] == len(responses)
+    assert report["parity_ok"], report
+    assert report["clean_close"]
+
+
+def test_replay_dispatches_on_mode(tmp_path):
+    from rca_tpu.replay import replay
+
+    path, _ = _serve_some(tmp_path, n=2)
+    report = replay(path)
+    assert report["mode"] == "serve" and report["parity_ok"]
+
+
+def test_serve_replay_divergence_names_request(tmp_path):
+    path, _ = _serve_some(tmp_path, n=3)
+    frames, _ = read_frames(path)
+    out = str(tmp_path / "p.rcz")
+    w = RecordingWriter(out, single_file=True)
+    for fr in frames:
+        if fr.get("kind") == "serve" and fr["index"] == 1:
+            fr = dict(fr)
+            fr["ranked_digest"] = "0" * 16
+        w.append(fr)
+    w.close()
+    report = replay_serve(out)
+    assert not report["parity_ok"]
+    assert report["first_divergent_index"] == 1
+
+
+# ---------------------------------------------------------------------------
+# store integration (recording_ref)
+# ---------------------------------------------------------------------------
+
+def test_store_recording_ref_round_trip(tmp_path):
+    from rca_tpu.store import InvestigationStore
+
+    store = InvestigationStore(root=str(tmp_path / "logs"))
+    inv = store.create_investigation("incident", recording_ref="/rec/a")
+    assert store.get_recording_ref(inv["id"]) == "/rec/a"
+    store.set_recording_ref(inv["id"], "/rec/b")
+    assert store.get_investigation(inv["id"])["recording_ref"] == "/rec/b"
+    rows = store.list_investigations()
+    assert rows and rows[0]["replayable"] is True
+
+
+def test_served_investigation_is_replayable_by_id(tmp_path):
+    """The full satellite path: a served analysis with an investigation
+    id stamps recording_ref, and `rca replay --investigation <id>`
+    re-drives it from the id alone."""
+    from rca_tpu.cli import main
+    from rca_tpu.store import InvestigationStore
+
+    log_dir = str(tmp_path / "logs")
+    store = InvestigationStore(root=log_dir)
+    inv = store.create_investigation("served incident")
+    path, responses = _serve_some(tmp_path, store=store,
+                                  investigation_id=inv["id"], n=3)
+    assert all(r.ok for r in responses)
+    assert store.get_recording_ref(inv["id"]) == path
+    assert main(["replay", "--investigation", inv["id"],
+                 "--log-dir", log_dir, "--compact"]) == 0
+    # unknown ref -> error, exit 1
+    other = store.create_investigation("no recording")
+    assert main(["replay", "--investigation", other["id"],
+                 "--log-dir", log_dir, "--compact"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# recorder mechanics
+# ---------------------------------------------------------------------------
+
+def test_recording_proxy_preserves_optional_surfaces(recorded_soak):
+    """hasattr parity: a chaos recording replays WITH drain_injected
+    (the session's health path used it), and the replay source refuses
+    methods the recording never saw."""
+    path, _ = recorded_soak
+    from rca_tpu.replay.source import ReplaySource
+
+    rec = load_recording(path)
+    src = ReplaySource(rec.calls)
+    assert hasattr(src, "drain_injected")
+    assert hasattr(src, "watch_changes")
+    assert not hasattr(src, "watch_close")  # mock never had it
+    with pytest.raises(AttributeError):
+        src.get_nonexistent_surface
+
+
+def test_replay_mismatch_is_loud(recorded_soak):
+    from rca_tpu.replay.source import ReplayMismatch, ReplaySource
+
+    path, _ = recorded_soak
+    rec = load_recording(path)
+    src = ReplaySource(rec.calls)
+    src.advance(1)
+    with pytest.raises(ReplayMismatch, match="tick 1"):
+        src.get_pods("a-namespace-never-recorded")
+
+
+def test_recorded_faults_replay_as_faults(recorded_soak):
+    """Chaos-injected exceptions are part of the tape: at least one
+    recorded call failed, and the replayed soak still hit degraded
+    paths without diverging (covered by the parity test) — here we
+    check the error frames round-trip with their types."""
+    path, summary = recorded_soak
+    rec = load_recording(path)
+    errors = [c for c in rec.calls if not c["ok"]]
+    if summary["faults_injected"].get("api_timeout", 0) == 0:
+        pytest.skip("seed injected no api_timeout this run")
+    assert any(c["error_type"] == "InjectedTimeout" for c in errors)
+
+
+def test_magic_layout_is_stable():
+    """The on-disk magic is a compatibility contract; changing it must
+    be a deliberate schema bump, not an accident."""
+    assert MAGIC == b"RCAREC\x01\n"
