@@ -5,11 +5,12 @@ helpers).  A new invariant is a new module here with a ``@register``
 class — see ANALYSIS.md for the authoring contract.
 """
 
-from rca_tpu.analysis.rules import env       # noqa: F401
-from rca_tpu.analysis.rules import faults    # noqa: F401
-from rca_tpu.analysis.rules import locks     # noqa: F401
-from rca_tpu.analysis.rules import nondet    # noqa: F401
-from rca_tpu.analysis.rules import retrace   # noqa: F401
-from rca_tpu.analysis.rules import rng       # noqa: F401
-from rca_tpu.analysis.rules import ticksync  # noqa: F401
-from rca_tpu.analysis.rules import tracer    # noqa: F401
+from rca_tpu.analysis.rules import env            # noqa: F401
+from rca_tpu.analysis.rules import faults         # noqa: F401
+from rca_tpu.analysis.rules import locks          # noqa: F401
+from rca_tpu.analysis.rules import nondet         # noqa: F401
+from rca_tpu.analysis.rules import residentfetch  # noqa: F401
+from rca_tpu.analysis.rules import retrace        # noqa: F401
+from rca_tpu.analysis.rules import rng            # noqa: F401
+from rca_tpu.analysis.rules import ticksync       # noqa: F401
+from rca_tpu.analysis.rules import tracer         # noqa: F401

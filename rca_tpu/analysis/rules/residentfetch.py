@@ -1,0 +1,94 @@
+"""Rule ``resident-fetch``: the analyze/tick/serve hot paths may fetch
+only top-k-sized results (ISSUE 6 — the tick-sync rule family extended to
+the resident-session era).
+
+The device-resident refactor's whole win is that a request moves O(changed
+rows) up and O(top-k) down: every designated fetch surface moves the
+[4, k] diagnostic gather + the top-k pair + a scalar, and the full
+[4, n_pad] stack stays parked on device behind ``EngineResult.
+full_diagnostics``'s deferred bulk fetch.  One stray ``jax.device_get``
+of a full-width array on an analyze/tick/serve path silently restores the
+~100× host sync floor (BENCH_r02–r05) with no test failing — the latency
+budget just evaporates.
+
+Enforcement: in the hot-path modules below, a sync spelling
+(``device_get`` / ``block_until_ready``) is legal ONLY inside the listed
+functions — the audited top-k fetch surfaces plus the explicitly
+documented bulk seams (the lazy diagnostics fetch; bulk staging paths
+like ``set_all``/resync upload, which SEND rather than fetch, never sync
+and so never appear here).  Everything else in those files fails the
+rule.  The baseline ships empty: new fetch surfaces must be audited into
+the allowlist, not baselined.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+
+SYNC_ATTRS = ("device_get", "block_until_ready")
+
+# hot-path modules -> functions allowed to synchronize there.  Two kinds,
+# both audited: top-k fetch surfaces (move O(k) bytes by construction)
+# and the one deferred bulk seam (EngineResult.full_diagnostics — lazy,
+# consumer-triggered, off the latency path by definition).
+FETCH_SURFACES = {
+    # one-shot + resident analyze path
+    "rca_tpu/engine/runner.py": {
+        "timed_fetch",        # top-k: fetches diag/vals/idx/n_bad only
+        "analyze_batch",      # top-k: per-lane diag/vals/idx/n_bad
+        "full_diagnostics",   # BULK, deferred: the documented lazy seam
+    },
+    "rca_tpu/engine/resident.py": {"_fetch_topk"},
+    "rca_tpu/engine/sharded_runner.py": {"analyze_batch"},
+    # streaming tick + serve paths (tick-sync's fetch-only contract,
+    # restated here with the top-k-size obligation)
+    "rca_tpu/engine/streaming.py": {"fetch"},
+    "rca_tpu/parallel/streaming.py": {"fetch"},
+    "rca_tpu/parallel/sharded.py": set(),
+    "rca_tpu/engine/live.py": set(),
+    "rca_tpu/serve/dispatcher.py": {"fetch"},
+    "rca_tpu/serve/loop.py": set(),
+    "rca_tpu/serve/client.py": set(),
+}
+
+MESSAGE = (
+    "`{attr}` outside an audited fetch surface on the analyze/tick/serve "
+    "hot path — fetches there may move only top-k-sized results; park "
+    "full arrays on device behind EngineResult.full_diagnostics (a stray "
+    "bulk fetch restores the ~100x host sync floor; see PERF.md round-7)"
+)
+
+
+@register
+class ResidentFetchRule(Rule):
+    name = "resident-fetch"
+    summary = ("hot-path device fetches are top-k-sized and live only in "
+               "audited fetch surfaces")
+    why = ("the resident-session refactor moves O(changed rows) up and "
+           "O(top-k) down per request; one stray full-array device_get "
+           "silently re-pays the ~100x host/staging/fetch floor the "
+           "refactor erased")
+    allow = FETCH_SURFACES
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath in FETCH_SURFACES
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        hits: List[Finding] = []
+
+        def walk(node: ast.AST, func: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = node.name
+            if isinstance(node, ast.Attribute) and node.attr in SYNC_ATTRS:
+                hits.append(ctx.finding(
+                    self, node.lineno, MESSAGE.format(attr=node.attr),
+                    func=func,
+                ))
+            for child in ast.iter_child_nodes(node):
+                walk(child, func)
+
+        walk(ctx.tree, "<module>")
+        return hits

@@ -144,6 +144,41 @@ def pipeline_depth_from_env(default: int = 1) -> int:
     return depth
 
 
+# -- device-resident sessions (ISSUE 6) -------------------------------------
+# env knobs for the resident analyze path (engine/resident.py), each
+# validated here so a typo'd value fails loudly instead of silently
+# disabling (or mis-sizing) the cache:
+#
+#   RCA_RESIDENT        1 (default) | 0 — keep per-graph analysis state
+#                       device-resident across one-shot analyze calls, so
+#                       a repeat request over a known graph uploads only
+#                       its changed feature rows (bit-identical results;
+#                       0 restores the restage-everything behavior)
+#   RCA_RESIDENT_CACHE  [1, 1024]  resident sessions kept per engine
+#                       (LRU beyond the cap; default 8 — each session
+#                       pins one [n_pad, C] device buffer)
+#   RCA_SERVE_GRAPH_CACHE [1, 4096]  prepared graphs (edges + layouts +
+#                       resident base features) the serving dispatcher
+#                       keeps hot (default 32)
+
+
+def resident_enabled() -> bool:
+    """``RCA_RESIDENT``: device-resident one-shot analyze sessions."""
+    return env_str(
+        "RCA_RESIDENT", "1", choices=("0", "1", "on", "off"), lower=True,
+    ) in ("1", "on")
+
+
+def resident_cache_cap() -> int:
+    """``RCA_RESIDENT_CACHE``: resident sessions kept per engine (LRU)."""
+    return env_int("RCA_RESIDENT_CACHE", 8, 1, 1024)
+
+
+def serve_graph_cache_cap() -> int:
+    """``RCA_SERVE_GRAPH_CACHE``: prepared graphs the dispatcher pins."""
+    return env_int("RCA_SERVE_GRAPH_CACHE", 32, 1, 4096)
+
+
 # -- serving scheduler (ISSUE 3) --------------------------------------------
 # env knobs, each a validated int with the documented range:
 #

@@ -1,0 +1,300 @@
+"""Device-resident analyze sessions: erase the per-request staging floor.
+
+BENCH_r02–r05 put 2k-service device compute at 0.7–1.8 ms while one
+end-to-end analysis pays ~90–125 ms — a ~100× host/staging/fetch floor
+(ROADMAP item 1; the GNN-acceleration survey in PAPERS.md [5] names
+host↔device data movement, not compute, as the dominant cost once kernels
+are tuned).  The streaming session solved this for TICKS in round 2 by
+pinning state on device and scattering deltas; this module generalizes
+that pattern to the ONE-SHOT analyze path (``GraphEngine.analyze_arrays``
+and everything behind it — the coordinator, the CLI, the serve solo
+re-runs):
+
+- a :class:`ResidentSession` per graph digest pins the padded edge
+  buffers, the segscan/up-table layouts, AND the feature matrix on
+  device for as long as the graph stays hot;
+- a repeat request over the same graph uploads only its CHANGED rows
+  (host diff against the raw mirror), applied with a donated-argument
+  in-place scatter fused into the propagation dispatch — per-request
+  host→device bytes are O(changed rows), not O(n_pad × C);
+- every fetch moves only top-k-sized results (the ``[4, k]`` diagnostic
+  gather + the top-k pair + the sanitized-row scalar); the full stack
+  stays on device behind :meth:`rca_tpu.engine.runner.EngineResult.
+  full_diagnostics`'s deferred bulk fetch;
+- a :class:`ResidentCache` LRU (``RCA_RESIDENT_CACHE``) bounds the pinned
+  device memory; ``RCA_RESIDENT=0`` restores the restage-everything path.
+
+Bit-parity contract: the resident buffer always holds exactly the padded
+RAW request features (the scatter writes raw rows; the finite-mask
+sanitize runs fused inside each dispatch without persisting, unlike the
+streaming session's persist-on-device variant), so every analyze computes
+from the same values full staging would upload — scores, rankings, and
+sanitized-row counts are bit-identical over arbitrary update/delete/NaN
+sequences (property-tested in tests/test_resident.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import hashlib
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rca_tpu.config import bucket_for, resident_cache_cap
+
+GraphDigest = Tuple[int, int, int, str]
+
+
+def graph_digest(
+    n: int, num_features: int, dep_src: np.ndarray, dep_dst: np.ndarray,
+) -> GraphDigest:
+    """Identity of the computation graph an analyze call runs over:
+    ``(n_services, n_channels, n_edges, edge-digest)`` — the same notion
+    of identity the serving layer's ``graph_key`` uses, so "requests that
+    coalesce" and "requests that share a resident session" agree."""
+    digest = hashlib.sha1(
+        np.asarray(dep_src, np.int32).tobytes() + b"|"
+        + np.asarray(dep_dst, np.int32).tobytes()
+    ).hexdigest()[:16]
+    return (int(n), int(num_features), int(len(dep_src)), digest)
+
+
+@functools.partial(
+    jax.jit,
+    donate_argnums=(0,),
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus", "k",
+        "error_contrast", "use_pallas",
+    ),
+)
+def _resident_delta_ranked(
+    features, idx, rows, edges, anomaly_w, hard_w,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    k: int, n_live, up_ell=None, down_seg=None, up_seg=None,
+    error_contrast: float = 0.0, use_pallas: bool = False,
+):
+    """One request in ONE dispatch: scatter the delta rows into the
+    donated resident buffer, sanitize, propagate, top-k, and gather the
+    top-k diagnostic rows.  Returns the RAW post-scatter buffer (the next
+    request's diff base) — the finite-mask pass feeds only the
+    propagation, so the resident state is exactly what full staging would
+    have uploaded and parity holds row-for-row, NaN rows included."""
+    from rca_tpu.engine.propagate import finite_mask_rows
+    from rca_tpu.engine.runner import propagate_auto, topk_diag
+
+    features = features.at[idx].set(rows)
+    clean, n_bad = finite_mask_rows(features)
+    a, h, u, m, score = propagate_auto(
+        clean, edges, anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus, n_live=n_live,
+        up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        error_contrast=error_contrast, use_pallas=use_pallas,
+    )
+    vals, topi = jax.lax.top_k(score, k)
+    stacked = jnp.stack([a, u, m, score])
+    return features, stacked, topk_diag(stacked, topi), vals, topi, n_bad
+
+
+class ResidentSession:
+    """One graph's device-resident analyze state.  Not thread-safe on its
+    own — :class:`ResidentCache` serializes access (the donated buffer
+    swap must not race)."""
+
+    def __init__(
+        self,
+        engine,                      # GraphEngine (weights + config)
+        key: GraphDigest,
+        dep_src: np.ndarray,
+        dep_dst: np.ndarray,
+    ):
+        from rca_tpu.engine.pallas_kernels import BLOCK_S, noisyor_autotune
+        from rca_tpu.engine.runner import coo_layouts_for
+
+        self.engine = engine
+        self.key = key
+        n, num_features, n_edges, _ = key
+        cfg = engine.config
+        self._n = n
+        self._num_features = num_features
+        self._n_edges = n_edges
+        self._n_pad = bucket_for(n + 1, cfg.shape_buckets)
+        e_pad = bucket_for(max(n_edges, 1), cfg.shape_buckets)
+        dummy = self._n_pad - 1
+        s = np.full(e_pad, dummy, np.int32)
+        d = np.full(e_pad, dummy, np.int32)
+        s[:n_edges] = dep_src
+        d[:n_edges] = dep_dst
+        # edges + layouts + (lazily) the feature matrix live on device for
+        # the session lifetime — same pinning the streaming session does
+        self._edges = jnp.asarray(np.stack([s, d]))
+        self._down_seg, self._up_seg, self._up_ell = coo_layouts_for(
+            self._n_pad, e_pad, dep_src, dep_dst
+        )
+        self._n_live = jnp.asarray(n, jnp.int32)
+        self._use_pallas = (
+            noisyor_autotune() == "pallas"
+            and self._n_pad % min(self._n_pad, BLOCK_S) == 0
+        )
+        # raw host mirror of the resident buffer's live rows (the diff
+        # base); None until the first request stages the buffer
+        self._mirror: Optional[np.ndarray] = None
+        self._features = None        # device [n_pad, C]
+        # accounting (bench sync_floor section + serve metrics read these)
+        self.requests = 0
+        self.delta_requests = 0      # served via the delta-scatter path
+        self.last_upload_rows = 0    # padded rows the last request staged
+        self.upload_bytes = 0        # cumulative host->device request bytes
+        self.fetch_bytes = 0         # cumulative device->host result bytes
+
+    # -- fetch surface -------------------------------------------------------
+    def _fetch_topk(self, diag, vals, idx, n_bad):
+        """THE session's device-sync point: moves only the [4, kk] gather,
+        the top-k pair, and the sanitized-row scalar (resident-fetch lint:
+        no full-[n_pad] fetch on this path)."""
+        diag, vals, idx, n_bad = jax.device_get((diag, vals, idx, n_bad))
+        self.fetch_bytes += (
+            diag.nbytes + vals.nbytes + idx.nbytes + 4
+        )
+        return diag, vals, idx, int(n_bad)
+
+    # -- one request ---------------------------------------------------------
+    def analyze(self, features: np.ndarray, names, k: int):
+        from rca_tpu.engine.runner import _propagate_ranked, render_result
+
+        t0 = time.perf_counter()
+        eng = self.engine
+        p = eng.params
+        kk = min(k + 8, self._n_pad)
+        features = np.asarray(features, np.float32)
+        changed = (
+            None if self._mirror is None
+            else np.flatnonzero(np.any(features != self._mirror, axis=1))
+        )
+        # NaN rows always diff as changed (NaN != NaN), so a poisoned row
+        # re-uploads raw every request — the fused sanitize re-zeroes it
+        # on device and parity with full staging holds
+        if changed is None or 2 * len(changed) >= self._n_pad:
+            # first request for this graph — or the delta is no cheaper
+            # than the matrix: stage the full padded buffer once, pin it
+            f = np.zeros((self._n_pad, self._num_features), np.float32)
+            f[: self._n] = features
+            self._features = jnp.asarray(f)
+            self._mirror = features.copy()
+            self.last_upload_rows = self._n_pad
+            self.upload_bytes += f.nbytes
+            stacked, diag, vals, idx, n_bad = _propagate_ranked(
+                self._features, self._edges, eng._aw, eng._hw,
+                p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
+                self._use_pallas, self._n_live, self._up_ell,
+                self._down_seg, self._up_seg,
+                error_contrast=p.error_contrast,
+            )
+        elif len(changed) == 0:
+            # identical request (retry, hypothesis re-rank): zero upload
+            self.delta_requests += 1
+            self.last_upload_rows = 0
+            stacked, diag, vals, idx, n_bad = _propagate_ranked(
+                self._features, self._edges, eng._aw, eng._hw,
+                p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
+                self._use_pallas, self._n_live, self._up_ell,
+                self._down_seg, self._up_seg,
+                error_contrast=p.error_contrast,
+            )
+        else:
+            # delta request: O(changed rows) up, fused donated scatter.
+            # Pad slots aim at the dummy row with zero rows — it is zero
+            # already, so the write is a no-op at any pad width
+            u = len(changed)
+            u_pad = 1 << max(0, (u - 1).bit_length())
+            idx_h = np.full(u_pad, self._n_pad - 1, np.int32)
+            rows_h = np.zeros((u_pad, self._num_features), np.float32)
+            idx_h[:u] = changed
+            rows_h[:u] = features[changed]
+            (self._features, stacked, diag, vals, idx,
+             n_bad) = _resident_delta_ranked(
+                self._features, jnp.asarray(idx_h), jnp.asarray(rows_h),
+                self._edges, eng._aw, eng._hw,
+                p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
+                self._n_live, self._up_ell, self._down_seg, self._up_seg,
+                error_contrast=p.error_contrast,
+                use_pallas=self._use_pallas,
+            )
+            # mirror updates only once the dispatch is accepted — a raise
+            # above (fresh-tier compile failure) leaves the old mirror, so
+            # the next request re-diffs and recovers
+            self._mirror[changed] = features[changed]
+            self.delta_requests += 1
+            self.last_upload_rows = u_pad
+            self.upload_bytes += idx_h.nbytes + rows_h.nbytes
+        self.requests += 1
+        diag, vals, idx, n_bad = self._fetch_topk(diag, vals, idx, n_bad)
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        return render_result(
+            diag, vals, idx, names, self._n, k, latency_ms,
+            self._n_edges, engine="single", sanitized_rows=n_bad,
+            stacked_dev=stacked,
+        )
+
+
+class ResidentCache:
+    """LRU of :class:`ResidentSession` per graph digest (the engine-side
+    analog of the serving dispatcher's prepared-graph cache).  The lock
+    serializes whole analyze calls: the donated-buffer swap inside a
+    session must not interleave with another thread's dispatch over the
+    same session."""
+
+    def __init__(self, engine, cap: Optional[int] = None):
+        self._engine = engine
+        self._cap = int(cap) if cap is not None else resident_cache_cap()
+        self._sessions: "collections.OrderedDict[GraphDigest, ResidentSession]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def analyze(
+        self,
+        features: np.ndarray,
+        dep_src: np.ndarray,
+        dep_dst: np.ndarray,
+        names: Optional[Sequence[str]],
+        k: int,
+    ):
+        key = graph_digest(
+            features.shape[0], features.shape[1], dep_src, dep_dst
+        )
+        with self._lock:
+            sess = self._sessions.get(key)
+            if sess is not None:
+                self._sessions.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+                sess = ResidentSession(self._engine, key, dep_src, dep_dst)
+                self._sessions[key] = sess
+                while len(self._sessions) > self._cap:
+                    self._sessions.popitem(last=False)
+                    self.evictions += 1
+            return sess.analyze(features, names, k)
+
+    def stats(self) -> dict:
+        with self._lock:
+            sessions = list(self._sessions.values())
+            return {
+                "sessions": len(sessions),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "requests": sum(s.requests for s in sessions),
+                "delta_requests": sum(s.delta_requests for s in sessions),
+                "upload_bytes": sum(s.upload_bytes for s in sessions),
+                "fetch_bytes": sum(s.fetch_bytes for s in sessions),
+            }

@@ -139,6 +139,54 @@ def edge_layout() -> str:
     )
 
 
+def propagate_auto(
+    features, edges, anomaly_w, hard_w,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    n_live=None, up_ell=None, down_seg=None, up_seg=None,
+    error_contrast: float = 0.0, use_pallas: bool = False,
+):
+    """The shared traced propagation body behind every fused COO-family
+    executable (one-shot, streaming flush, resident delta): the
+    pallas-vs-XLA evidence branch lives HERE once, so the autotuned
+    combine path cannot drift between the call surfaces.  Returns
+    ``(a, h, u, m, score)``."""
+    from rca_tpu.engine.propagate import propagate
+
+    if use_pallas:
+        from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
+        from rca_tpu.engine.propagate import (
+            error_source_excess,
+            fold_error_contrast,
+            propagate_core,
+        )
+
+        a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
+        if error_contrast:
+            a = fold_error_contrast(
+                a, error_source_excess(features, edges[0], edges[1]),
+                error_contrast,
+            )
+        return propagate_core(
+            a, h, edges[0], edges[1],
+            steps, decay, explain_strength, impact_bonus, n_live=n_live,
+            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        )
+    return propagate(
+        features, edges[0], edges[1], anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus, n_live=n_live,
+        up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        error_contrast=error_contrast,
+    )
+
+
+def topk_diag(stacked, idx):
+    """On-device gather of the top-k rows of the [4, S] diagnostic stack:
+    the ``[4, k]`` slice is everything the ranked rendering needs, so the
+    fetch surfaces move THIS instead of the full stack (ISSUE 6: per-
+    request fetch bytes are O(k), not O(n_pad))."""
+    return stacked[:, idx]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -153,8 +201,10 @@ def _propagate_ranked(
     down_seg=None, up_seg=None, error_contrast: float = 0.0,
 ):
     """One dispatch, minimal transfers: edges arrive as one [2, E] buffer;
-    diagnostics leave as one stacked [4, S] buffer plus the top-k pair.
-    Matters on tunneled TPUs where every host<->device hop pays an RTT.
+    the top-k pair leaves with a [4, k] gather of their diagnostic rows —
+    the full stacked [4, S] buffer STAYS on device (fetched lazily only if
+    a diagnostics consumer asks).  Matters on tunneled TPUs where every
+    host<->device hop pays an RTT and transfer scales with bytes.
 
     With ``use_pallas`` the two noisy-OR evidence passes run as the fused
     Pallas kernel over the channel-major transpose (one feature read feeds
@@ -164,38 +214,44 @@ def _propagate_ranked(
     NaN/Inf rows (poisoned telemetry) zero out on device and the count
     rides back with the top-k fetch — no extra host sync, bit-identical
     pass-through on clean input."""
-    from rca_tpu.engine.propagate import finite_mask_rows, propagate_core
+    from rca_tpu.engine.propagate import finite_mask_rows
 
     features, n_bad = finite_mask_rows(features)
+    a, h, u, m, score = propagate_auto(
+        features, edges, anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus, n_live=n_live,
+        up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        error_contrast=error_contrast, use_pallas=use_pallas,
+    )
+    vals, idx = jax.lax.top_k(score, k)
+    stacked = jnp.stack([a, u, m, score])
+    return stacked, topk_diag(stacked, idx), vals, idx, n_bad
 
-    if use_pallas:
-        from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
-        from rca_tpu.engine.propagate import (
-            error_source_excess,
-            fold_error_contrast,
-        )
 
-        a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
-        if error_contrast:
-            a = fold_error_contrast(
-                a, error_source_excess(features, edges[0], edges[1]),
-                error_contrast,
-            )
-        out = propagate_core(
-            a, h, edges[0], edges[1],
-            steps, decay, explain_strength, impact_bonus, n_live=n_live,
-            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-        )
-        a, h, u, m, score = out
-    else:
+def _ranked_lanes(
+    features_b, edges, anomaly_w, hard_w,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    k: int, n_live, up_ell, down_seg, up_seg, error_contrast: float,
+):
+    """The traced per-lane body shared by the full and delta batched
+    executables: vmap of the propagation + per-hypothesis top-k + the
+    [4, k] diagnostic gather.  One definition so the serving dispatcher's
+    delta path cannot drift from the full-staging executable it must stay
+    bit-identical to."""
+    from rca_tpu.engine.propagate import propagate
+
+    def one(f):
         a, h, u, m, score = propagate(
-            features, edges[0], edges[1], anomaly_w, hard_w,
+            f, edges[0], edges[1], anomaly_w, hard_w,
             steps, decay, explain_strength, impact_bonus, n_live=n_live,
             up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
             error_contrast=error_contrast,
         )
-    vals, idx = jax.lax.top_k(score, k)
-    return jnp.stack([a, u, m, score]), vals, idx, n_bad
+        vals, idx = jax.lax.top_k(score, k)
+        stacked = jnp.stack([a, u, m, score])
+        return stacked, topk_diag(stacked, idx), vals, idx
+
+    return jax.vmap(one)(features_b)
 
 
 @functools.partial(
@@ -215,22 +271,48 @@ def _propagate_ranked_batch(
     propagation + per-hypothesis top-k (BASELINE.json "pmap over fault
     candidates" — on a single device the batch rides vmap lanes; the
     sharded engine's dp axis covers multi-device batches)."""
-    from rca_tpu.engine.propagate import finite_mask_rows, propagate
+    from rca_tpu.engine.propagate import finite_mask_rows
 
     features_b, n_bad = finite_mask_rows(features_b)
+    stacked, diag, vals, idx = _ranked_lanes(
+        features_b, edges, anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus, k,
+        n_live, up_ell, down_seg, up_seg, error_contrast,
+    )
+    return stacked, diag, vals, idx, n_bad
 
-    def one(f):
-        a, h, u, m, score = propagate(
-            f, edges[0], edges[1], anomaly_w, hard_w,
-            steps, decay, explain_strength, impact_bonus, n_live=n_live,
-            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-            error_contrast=error_contrast,
-        )
-        vals, idx = jax.lax.top_k(score, k)
-        return jnp.stack([a, u, m, score]), vals, idx
 
-    stacked, vals, idx = jax.vmap(one)(features_b)
-    return stacked, vals, idx, n_bad
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus", "k",
+        "error_contrast",
+    ),
+)
+def _propagate_ranked_batch_delta(
+    base, idx_b, rows_b, edges, anomaly_w, hard_w,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    k: int, n_live=None, up_ell=None, down_seg=None, up_seg=None,
+    error_contrast: float = 0.0,
+):
+    """Delta-staged hypothesis batch (ISSUE 6): each lane is the resident
+    base feature buffer plus that request's changed rows, scattered on
+    device — host→device traffic is the [B, U] index block and the
+    [B, U, C] row block instead of the full [B, n_pad, C] stack.  ``base``
+    is NOT donated (it serves every lane and the next dispatch).  Pad
+    slots aim at the dummy row with zero rows, matching the zeros already
+    there; the propagation body is the same `_ranked_lanes` as the full
+    executable, so lane results are bit-identical to full staging."""
+    from rca_tpu.engine.propagate import finite_mask_rows
+
+    features_b = jax.vmap(lambda i, r: base.at[i].set(r))(idx_b, rows_b)
+    features_b, n_bad = finite_mask_rows(features_b)
+    stacked, diag, vals, idx = _ranked_lanes(
+        features_b, edges, anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus, k,
+        n_live, up_ell, down_seg, up_seg, error_contrast,
+    )
+    return stacked, diag, vals, idx, n_bad
 
 
 @functools.partial(
@@ -256,26 +338,73 @@ def _propagate_ranked_ell(
         n_live=n_live, error_contrast=error_contrast,
     )
     vals, idx = jax.lax.top_k(score, k)
-    return jnp.stack([a, u, m, score]), vals, idx, n_bad
+    stacked = jnp.stack([a, u, m, score])
+    return stacked, topk_diag(stacked, idx), vals, idx, n_bad
 from rca_tpu.features.extract import FeatureSet, extract_features
 from rca_tpu.graph.build import service_dependency_edges
 
 
-@dataclasses.dataclass
 class EngineResult:
-    service_names: List[str]
-    ranked: List[dict]            # [{component, score, anomaly, ...}] desc
-    anomaly: np.ndarray           # [S]
-    upstream: np.ndarray          # [S]
-    impact: np.ndarray            # [S]
-    score: np.ndarray             # [S]
-    latency_ms: float             # device compute wall time (post-compile)
-    n_services: int
-    n_edges: int
-    engine: str = "single"        # which engine ran: single | sharded(...)
-    # feature rows zeroed by the finite-mask guard (NaN/Inf telemetry);
-    # 0 on clean input — nonzero means the analysis ran DEGRADED
-    sanitized_rows: int = 0
+    """One analysis result.  The ranked findings (top-k components with
+    their diagnostic channels) are rendered eagerly from the [4, k] fetch;
+    the FULL per-service vectors (``anomaly``/``upstream``/``impact``/
+    ``score``) are LAZY — the analyze/serve hot path never moves the
+    [4, n_pad] stack off device (ISSUE 6: fetch bytes are O(k)), and a
+    diagnostics consumer's first attribute access triggers one deferred
+    bulk fetch (``tools``, accuracy sweeps, tests — all off the latency
+    path; the resident-fetch lint allowlists exactly this seam)."""
+
+    def __init__(
+        self,
+        service_names: List[str],
+        ranked: List[dict],           # [{component, score, anomaly, ...}]
+        latency_ms: float,            # device compute wall (post-compile)
+        n_services: int,
+        n_edges: int,
+        engine: str = "single",       # which engine ran: single|sharded(...)
+        sanitized_rows: int = 0,      # finite-mask-zeroed rows (0 = clean)
+        stacked: Optional[np.ndarray] = None,   # host [4, >=n], eager form
+        stacked_dev: object = None,   # device [4, n_pad], deferred form
+    ):
+        self.service_names = service_names
+        self.ranked = ranked
+        self.latency_ms = latency_ms
+        self.n_services = n_services
+        self.n_edges = n_edges
+        self.engine = engine
+        self.sanitized_rows = int(sanitized_rows)
+        self._stacked = stacked
+        self._stacked_dev = stacked_dev
+
+    def full_diagnostics(self) -> np.ndarray:
+        """The [4, n] host diagnostic stack (a, u, m, score), fetching the
+        device-parked stack on first use — THE deferred bulk fetch, off
+        the hot path by construction."""
+        if self._stacked is None:
+            if self._stacked_dev is None:
+                raise ValueError(
+                    "EngineResult carries no diagnostic stack (degraded "
+                    "render?)"
+                )
+            self._stacked = np.asarray(jax.device_get(self._stacked_dev))
+            self._stacked_dev = None
+        return self._stacked
+
+    @property
+    def anomaly(self) -> np.ndarray:       # [S]
+        return np.asarray(self.full_diagnostics()[0][: self.n_services])
+
+    @property
+    def upstream(self) -> np.ndarray:      # [S]
+        return np.asarray(self.full_diagnostics()[1][: self.n_services])
+
+    @property
+    def impact(self) -> np.ndarray:        # [S]
+        return np.asarray(self.full_diagnostics()[2][: self.n_services])
+
+    @property
+    def score(self) -> np.ndarray:         # [S]
+        return np.asarray(self.full_diagnostics()[3][: self.n_services])
 
     def top_components(self, k: Optional[int] = None) -> List[str]:
         items = self.ranked if k is None else self.ranked[:k]
@@ -283,7 +412,7 @@ class EngineResult:
 
 
 def render_result(
-    stacked: np.ndarray,          # [4, >=n] host arrays: a, u, m, score
+    diag: np.ndarray,             # [4, kk] host: a, u, m, score AT idx
     vals: np.ndarray,             # [kk] top-k values (may include pad slots)
     idx: np.ndarray,              # [kk] top-k indices
     names: Optional[Sequence[str]],
@@ -293,10 +422,14 @@ def render_result(
     n_edges: int,
     engine: str,
     sanitized_rows: int = 0,
+    stacked_dev: object = None,   # device [4, n_pad] for lazy diagnostics
 ) -> EngineResult:
     """Shared host-side rendering: identical findings regardless of which
-    engine (single-device or sharded) produced the device arrays."""
-    a, u, m, score = (np.asarray(stacked[i][:n]) for i in range(4))
+    engine (single-device or sharded) produced the device arrays.  Takes
+    the [4, kk] top-k diagnostic gather, NOT the full stack — the full
+    stack stays on device behind ``stacked_dev`` and only a diagnostics
+    consumer's lazy access moves it."""
+    diag = np.asarray(diag)
     names = list(names) if names is not None else [f"svc-{i}" for i in range(n)]
     ranked = []
     for j, i in enumerate(np.asarray(idx).tolist()):
@@ -306,23 +439,20 @@ def render_result(
             {
                 "component": names[i],
                 "score": float(vals[j]),
-                "anomaly": float(a[i]),
-                "explained_by_upstream": float(u[i]),
-                "downstream_impact": float(m[i]),
+                "anomaly": float(diag[0, j]),
+                "explained_by_upstream": float(diag[1, j]),
+                "downstream_impact": float(diag[2, j]),
             }
         )
     return EngineResult(
         service_names=names,
         ranked=ranked,
-        anomaly=a,
-        upstream=u,
-        impact=m,
-        score=score,
         latency_ms=latency_ms,
         n_services=n,
         n_edges=n_edges,
         engine=engine,
         sanitized_rows=int(sanitized_rows),
+        stacked_dev=stacked_dev,
     )
 
 
@@ -360,35 +490,46 @@ def resolve_params(
     return params or default_params(config.propagation_steps)
 
 
-def timed_fetch(run, timed: bool):
+def timed_fetch(run, timed: bool, warm=None):
     """Shared fetch-synced execution for BOTH engines: ``run`` returns
-    (stacked_diagnostics, topk_vals, topk_idx, sanitized_rows) device
-    values (``sanitized_rows`` may be a host int for engines that
-    sanitize host-side).
+    (stacked_diagnostics, topk_diag, topk_vals, topk_idx, sanitized_rows)
+    device values (``sanitized_rows`` may be a host int for engines that
+    sanitize host-side).  Only the TOP-K-SIZED values ever cross to host
+    here — the full stack is returned as a device value for the result's
+    lazy diagnostics (ISSUE 6: per-request fetch bytes are O(k)).
+
+    ``warm`` (ISSUE 6 satellite): an AOT compile hook — when provided,
+    the timed path warms the executable via ``jit(...).lower().compile()``
+    instead of a throwaway dispatch+fetch, so compile warming moves ZERO
+    result bytes through the host<->device tunnel.  Engines without an
+    AOT form (the sharded shard_map closures) fall back to one untimed
+    dispatch fetching only the top-k pair.
 
     Timing syncs through device_get of the top-k pair, NOT
     block_until_ready: on tunneled backends (axon) block_until_ready
     returns once the dispatch is enqueued, so dispatch-only timing
     under-measures by the whole device execution + fetch RTT.  The fetched
     top-k is tiny — the fetch cost is the tunnel round trip, which a real
-    deployment pays per inference anyway.  In the untimed path ONE bulk
-    fetch brings everything back (a second device_get pays a second RTT).
-    """
+    deployment pays per inference anyway."""
     if timed:
-        jax.device_get(run()[1:])  # warm the compile cache
+        if warm is not None:
+            warm()  # AOT lower+compile: no result arrays move
+        else:
+            jax.device_get(run()[2:])  # warm via one top-k-sized fetch
         reps = []
         for _ in range(10):
             t0 = time.perf_counter()
-            stacked, vals, idx, n_bad = run()
+            stacked, diag, vals, idx, n_bad = run()
             vals, idx = jax.device_get((vals, idx))
             reps.append((time.perf_counter() - t0) * 1e3)
         latency_ms = float(np.median(reps))
-        stacked, n_bad = jax.device_get((stacked, n_bad))
+        diag, n_bad = jax.device_get((diag, n_bad))
     else:
         t0 = time.perf_counter()
-        stacked, vals, idx, n_bad = jax.device_get(run())
+        stacked, diag, vals, idx, n_bad = run()
+        diag, vals, idx, n_bad = jax.device_get((diag, vals, idx, n_bad))
         latency_ms = (time.perf_counter() - t0) * 1e3
-    return stacked, vals, idx, int(n_bad), latency_ms
+    return stacked, diag, vals, idx, int(n_bad), latency_ms
 
 
 class EngineAPI:
@@ -440,16 +581,27 @@ class GraphEngine(EngineAPI):
         self,
         config: Optional[RCAConfig] = None,
         params: Optional[PropagationParams] = None,
+        resident: Optional[bool] = None,
     ):
         # persistent XLA compile cache (RCA_COMPILE_CACHE, idempotent):
         # enabled before the first jit of the session so repeated engine
         # starts skip recompiling the tick executables
-        from rca_tpu.config import enable_compile_cache
+        from rca_tpu.config import enable_compile_cache, resident_enabled
 
         enable_compile_cache()
         self.config = config or RCAConfig()
         self.params = resolve_params(self.config, params)
         self._aw, self._hw = self.params.weight_arrays()
+        # device-resident sessions (ISSUE 6): repeat analyze calls over a
+        # known graph upload only their changed feature rows into a pinned
+        # buffer (donated in-place scatter) instead of restaging the full
+        # padded matrix.  ``resident=None`` follows RCA_RESIDENT (default
+        # on — results are bit-identical either way, property-tested).
+        self._resident_cache = None
+        if resident if resident is not None else resident_enabled():
+            from rca_tpu.engine.resident import ResidentCache
+
+            self._resident_cache = ResidentCache(self)
 
     # -- shaping -----------------------------------------------------------
     def _pad(self, features: np.ndarray, src: np.ndarray, dst: np.ndarray):
@@ -478,6 +630,19 @@ class GraphEngine(EngineAPI):
     ) -> EngineResult:
         n = features.shape[0]
         k = k or min(self.config.top_k_root_causes, n)
+        layout = edge_layout()
+        # resident fast path (ISSUE 6 tentpole): a repeat request over a
+        # known graph digest applies its dirty rows to the device-pinned
+        # buffer (donated scatter) and fetches only top-k-sized results —
+        # bit-identical to full staging (property-tested).  The timed path
+        # keeps the restaged methodology so the headline e2e metric stays
+        # comparable across bench rounds; the pure-ELL layout has no fused
+        # scatter twin and stays on the staging path.
+        if (self._resident_cache is not None and not timed
+                and layout != "ell"):
+            return self._resident_cache.analyze(
+                features, dep_src, dep_dst, names, k,
+            )
         f, s, d = self._pad(features, dep_src, dep_dst)
         fj = jnp.asarray(f)
         p = self.params
@@ -486,7 +651,6 @@ class GraphEngine(EngineAPI):
         # size within a shape bucket
         n_live = jnp.asarray(n, jnp.int32)
 
-        layout = edge_layout()
         if layout == "ell":
             # scatter-free layout for large graphs
             ell = EllGraph.build(f.shape[0], dep_src, dep_dst)
@@ -498,6 +662,8 @@ class GraphEngine(EngineAPI):
             dn_ovf = jnp.asarray(
                 np.stack([ell.down.ovf_seg, ell.down.ovf_other])
             )
+
+            warm = None
 
             def run():
                 return _propagate_ranked_ell(
@@ -526,7 +692,28 @@ class GraphEngine(EngineAPI):
                 and noisyor_autotune() == "pallas"
             )
 
+            # AOT compile warming (ISSUE 6 satellite): the timed path's
+            # old warmup dispatched the executable and fetched its results
+            # — dragging full arrays through the ~90 ms tunnel just to
+            # populate a cache.  lower().compile() builds the executable
+            # without dispatching; the timed reps then invoke the compiled
+            # object directly (its dynamic-args-only call convention).
+            aot: list = []
+
+            def warm():
+                aot.append(_propagate_ranked.lower(
+                    fj, ej, self._aw, self._hw,
+                    p.steps, p.decay, p.explain_strength, p.impact_bonus,
+                    kk, use_pallas, n_live, up_ell, down_seg, up_seg,
+                    error_contrast=p.error_contrast,
+                ).compile())
+
             def run():
+                if aot:
+                    return aot[0](
+                        fj, ej, self._aw, self._hw, n_live, up_ell,
+                        down_seg, up_seg,
+                    )
                 return _propagate_ranked(
                     fj, ej, self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
@@ -534,10 +721,13 @@ class GraphEngine(EngineAPI):
                     error_contrast=p.error_contrast,
                 )
 
-        stacked, vals, idx, n_bad, latency_ms = timed_fetch(run, timed)
+        stacked, diag, vals, idx, n_bad, latency_ms = timed_fetch(
+            run, timed, warm=warm,
+        )
         return render_result(
-            stacked, vals, idx, names, n, k, latency_ms,
+            diag, vals, idx, names, n, k, latency_ms,
             int(len(dep_src)), engine="single", sanitized_rows=n_bad,
+            stacked_dev=stacked,
         )
 
     def analyze_batch(
@@ -570,21 +760,24 @@ class GraphEngine(EngineAPI):
         p = self.params
         kk = min(k + 8, f0.shape[0])
         t0 = _time.perf_counter()
-        stacked, vals, idx, n_bad = jax.device_get(_propagate_ranked_batch(
+        stacked, diag, vals, idx, n_bad = _propagate_ranked_batch(
             jnp.asarray(fb), ej, self._aw, self._hw,
             p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
             jnp.asarray(n, jnp.int32), up_ell, down_seg, up_seg,
             error_contrast=p.error_contrast,
-        ))
+        )
+        # top-k-sized fetch only: the [B, 4, n_pad] stack stays on device
+        # behind each lane's lazy diagnostics (ISSUE 6)
+        diag, vals, idx, n_bad = jax.device_get((diag, vals, idx, n_bad))
         latency_ms = (_time.perf_counter() - t0) * 1e3
         # n_bad counts zeroed rows across the WHOLE batch (per-hypothesis
         # attribution is not worth a [B] fetch — a poisoned row poisons
         # every hypothesis built from the same snapshot)
         return [
             render_result(
-                stacked[b], vals[b], idx[b], names, n, k,
+                diag[b], vals[b], idx[b], names, n, k,
                 latency_ms / B, int(len(dep_src)), engine="single-batch",
-                sanitized_rows=int(n_bad),
+                sanitized_rows=int(n_bad), stacked_dev=stacked[b],
             )
             for b in range(B)
         ]

@@ -180,17 +180,21 @@ class ShardedGraphEngine(EngineAPI):
         mesh = self._exec_mesh
         invoke = stage_sharded(mesh, batch, graph, self.params)
 
+        from rca_tpu.parallel.sharded import batch_topk_diag
+
         def run():
             stack = invoke()
             vals, idx = sharded_topk(mesh, stack[:, 3], kk)
+            diag = batch_topk_diag(stack, idx)
             # squeeze the B=1 axis on DEVICE so the fetch carries one copy
-            return stack[0], vals[0], idx[0], n_bad
+            return stack[0], diag[0], vals[0], idx[0], n_bad
 
-        stack, vals, idx, n_bad, latency_ms = timed_fetch(run, timed)
+        stack, diag, vals, idx, n_bad, latency_ms = timed_fetch(run, timed)
         return render_result(
-            stack, np.asarray(vals), np.asarray(idx),
+            diag, np.asarray(vals), np.asarray(idx),
             names, n, k, latency_ms, int(len(dep_src)),
             engine=self.engine_tag, sanitized_rows=n_bad,
+            stacked_dev=stack,
         )
 
     def analyze_batch(
@@ -219,16 +223,19 @@ class ShardedGraphEngine(EngineAPI):
         fb[:B, :n] = features_batch
         kk = min(k + 8, graph.n_pad)
         t0 = _time.perf_counter()
-        stack, vals, idx = stage_batch_ranked(
+        stack, diag, vals, idx = stage_batch_ranked(
             self.mesh, fb, graph, self.params, kk
         )
-        stack, vals, idx = jax.device_get((stack, vals, idx))
+        # top-k-sized fetch only: the [B, 4, n_pad] stack stays sharded
+        # on device behind each lane's lazy diagnostics (ISSUE 6)
+        diag, vals, idx = jax.device_get((diag, vals, idx))
         latency_ms = (_time.perf_counter() - t0) * 1e3
         return [
             render_result(
-                stack[b], vals[b], idx[b], names, n, k,
+                diag[b], vals[b], idx[b], names, n, k,
                 latency_ms / B, int(len(dep_src)),
                 engine=self.engine_tag + "-batch", sanitized_rows=n_bad,
+                stacked_dev=stack[b],
             )
             for b in range(B)
         ]

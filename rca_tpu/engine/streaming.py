@@ -69,39 +69,20 @@ def _flush_propagate_ranked(
     resident buffer — "no signal" until a clean row arrives) and the
     zeroed-row count rides back with the same top-k fetch, so the guard
     costs no extra host sync.  Clean rows pass through bit-identically."""
-    from rca_tpu.engine.propagate import finite_mask_rows, propagate
+    from rca_tpu.engine.propagate import finite_mask_rows
+    from rca_tpu.engine.runner import propagate_auto
 
     features = features.at[idx].set(rows)
     features, n_bad = finite_mask_rows(features)
-    if use_pallas:
-        # autotuned evidence path (pallas_kernels.noisyor_autotune picked
-        # the fused kernel for this backend): same math as propagate()'s
-        # XLA expression, over the channel-major transpose
-        from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
-        from rca_tpu.engine.propagate import (
-            error_source_excess,
-            fold_error_contrast,
-            propagate_core,
-        )
-
-        a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
-        if error_contrast:
-            a = fold_error_contrast(
-                a, error_source_excess(features, edges[0], edges[1]),
-                error_contrast,
-            )
-        a, h, u, m, score = propagate_core(
-            a, h, edges[0], edges[1],
-            steps, decay, explain_strength, impact_bonus, n_live=n_live,
-            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-        )
-    else:
-        a, h, u, m, score = propagate(
-            features, edges[0], edges[1], anomaly_w, hard_w,
-            steps, decay, explain_strength, impact_bonus, n_live=n_live,
-            up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-            error_contrast=error_contrast,
-        )
+    # propagate_auto is the ONE traced propagation body (pallas-vs-XLA
+    # branch included) shared with the one-shot and resident executables,
+    # so the combine path cannot drift between the call surfaces
+    a, h, u, m, score = propagate_auto(
+        features, edges, anomaly_w, hard_w,
+        steps, decay, explain_strength, impact_bonus, n_live=n_live,
+        up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        error_contrast=error_contrast, use_pallas=use_pallas,
+    )
     vals, topi = jax.lax.top_k(score, k)
     return features, vals, topi, n_bad
 
@@ -352,7 +333,9 @@ class StreamingSession(StreamingHostState):
             upload = self._account_upload(u_pad)
         else:
             upload = self._account_upload(0)
-            stacked, vals, idx, n_bad = _propagate_ranked(
+            # quiet tick: same one-shot executable, top-k values only —
+            # the stacked/diag device values stay unfetched
+            stacked, _diag, vals, idx, n_bad = _propagate_ranked(
                 self._features, self._edges,
                 self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,

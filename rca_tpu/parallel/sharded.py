@@ -479,6 +479,20 @@ def sharded_propagate(
     )[:, 3]
 
 
+def batch_topk_diag(stack: jax.Array, idx: jax.Array) -> jax.Array:
+    """On-device per-lane gather of the top-k diagnostic rows:
+    ``out[b, :, j] = stack[b, :, idx[b, j]]`` — the [B, 4, kk] slice is
+    everything the ranked rendering needs, so fetch surfaces move THIS
+    instead of the full [B, 4, n_pad] stack (ISSUE 6).  Works on sharded
+    stacks too: GSPMD inserts the cross-shard gather, which is exactly
+    the transfer the fetch used to pay anyway."""
+    B, four, _ = stack.shape
+    kk = idx.shape[-1]
+    return jnp.take_along_axis(
+        stack, jnp.broadcast_to(idx[:, None, :], (B, four, kk)), axis=2
+    )
+
+
 def stage_batch_ranked(
     mesh: Mesh,
     features_batch: np.ndarray,  # [B, n_pad, C] hypothesis batch, same graph
@@ -487,13 +501,16 @@ def stage_batch_ranked(
     kk: int,
     batch_axes: Tuple[str, ...] = ("dp",),
 ):
-    """Enqueue the sharded hypothesis batch AND its cross-shard top-k
-    merge, returning ``(stack, vals, idx)`` as in-flight DEVICE values —
-    this function never synchronizes (JAX dispatch is async), so a caller
-    can overlap host work with the mesh execution and fetch later.  The
-    engine's ``analyze_batch`` fetches immediately; the serving
-    dispatcher (rca_tpu/serve) parks the values in a batch handle and
-    fetches one batch behind."""
+    """Enqueue the sharded hypothesis batch, its cross-shard top-k merge,
+    AND the [B, 4, kk] top-k diagnostic gather, returning
+    ``(stack, diag, vals, idx)`` as in-flight DEVICE values — this
+    function never synchronizes (JAX dispatch is async), so a caller can
+    overlap host work with the mesh execution and fetch later.  Callers
+    fetch only the top-k-sized values (``diag``/``vals``/``idx``); the
+    full ``stack`` stays on device for lazy diagnostics.  The engine's
+    ``analyze_batch`` fetches immediately; the serving dispatcher
+    (rca_tpu/serve) parks the values in a batch handle and fetches one
+    batch behind."""
     stack = stage_sharded(mesh, features_batch, graph, params, batch_axes)()
     vals, idx = sharded_topk(mesh, stack[:, 3], kk, batch_axes)
-    return stack, vals, idx
+    return stack, batch_topk_diag(stack, idx), vals, idx

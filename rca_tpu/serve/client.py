@@ -192,11 +192,26 @@ def serve_selftest(
     specs = []
     for i in range(n_requests):
         case = cases[i % len(cases)]
-        feats = np.clip(
-            case.features
-            + rng.uniform(0, 0.05, case.features.shape).astype(np.float32),
-            0, 1,
-        )
+        if i % 3 == 2:
+            # sparse perturbation: a handful of dirty rows, so repeat
+            # dispatches over this graph ride the dispatcher's resident
+            # delta path — its bit-parity is under THIS selftest's
+            # coalesced-vs-solo gate, not just unit tests (ISSUE 6)
+            feats = case.features.copy()
+            rows = rng.integers(0, case.features.shape[0], 4)
+            feats[rows] = np.clip(
+                feats[rows] + rng.uniform(
+                    0, 0.2, (4, case.features.shape[1])
+                ).astype(np.float32),
+                0, 1,
+            )
+        else:
+            feats = np.clip(
+                case.features
+                + rng.uniform(0, 0.05, case.features.shape).astype(
+                    np.float32),
+                0, 1,
+            )
         specs.append({
             "case": case,
             "features": feats,
@@ -229,13 +244,45 @@ def serve_selftest(
             t.join()
         responses = [r.result(timeout_s) for r in requests]  # type: ignore
 
+        # second wave (ISSUE 6): CLOSED-LOOP sparse repeats over the
+        # largest graph.  The first wave established that graph's
+        # resident base in the dispatcher, and each of these arrives
+        # after the previous one resolved — so they dispatch separately
+        # and must ride the delta-scatter path, putting its
+        # coalesced-vs-solo bit parity under THIS selftest's gate.
+        delta_specs: List[dict] = []
+        delta_responses: List[ServeResponse] = []
+        for j in range(4):
+            case = cases[-1]
+            feats = case.features.copy()
+            rows = rng.integers(0, feats.shape[0], 3)
+            feats[rows] = np.clip(
+                feats[rows] + rng.uniform(
+                    0, 0.2, (3, feats.shape[1])
+                ).astype(np.float32),
+                0, 1,
+            )
+            req = client.submit(
+                feats, case.dep_src, case.dep_dst, names=case.names,
+                tenant=tenants[j % len(tenants)], k=3,
+            )
+            delta_specs.append({"case": case, "features": feats})
+            delta_responses.append(req.result(timeout_s))
+
     by_status: Dict[str, int] = {}
     for resp in responses:
         by_status[resp.status] = by_status.get(resp.status, 0) + 1
+    # without chaos the delta wave must be served ok (under chaos a
+    # degraded answer is a legitimate outcome); parity below covers it
+    delta_wave_ok = all(r.ok for r in delta_responses)
     # parity: every ok ranking must equal the solo analysis bit-for-bit
+    # (delta-wave responses included — the resident delta path holds the
+    # same contract as full staging)
     parity_checked = 0
     parity_ok = True
-    for spec, resp in zip(specs, responses):
+    for spec, resp in zip(
+        list(specs) + delta_specs, list(responses) + delta_responses
+    ):
         if not resp.ok:
             continue
         solo = engine.analyze_arrays(
@@ -250,18 +297,25 @@ def serve_selftest(
     expected_shed = sum(1 for s in specs if s["deadline_ms"] < 0)
     all_resolved = all(r.done() for r in requests)  # type: ignore
     summary = loop.metrics.summary()
+    resident_delta_requests = sum(
+        t["resident_delta_requests"] for t in summary["tenants"].values()
+    )
     ok = (
         all_resolved
         and parity_ok
         and by_status.get("shed", 0) >= expected_shed
         # without chaos the device path must be clean: no errors, every
-        # non-shed request served ok.  Under chaos, degraded/error are
-        # legitimate contract outcomes (RESILIENCE.md) — the assertions
-        # that matter are resolution + parity of the ok responses.
+        # non-shed request served ok, and the closed-loop delta wave both
+        # resolved ok AND actually rode the resident delta path.  Under
+        # chaos, degraded/error are legitimate contract outcomes
+        # (RESILIENCE.md) — the assertions that matter are resolution +
+        # parity of the ok responses.
         and (chaos or (
             by_status.get("error", 0) == 0
             and by_status.get("ok", 0)
             == n_requests - by_status.get("shed", 0)
+            and delta_wave_ok
+            and resident_delta_requests >= 1
         ))
     )
     return {
@@ -273,6 +327,8 @@ def serve_selftest(
         "all_resolved": bool(all_resolved),
         "parity_checked": parity_checked,
         "parity_ok": bool(parity_ok),
+        "resident_delta_requests": resident_delta_requests,
+        "delta_wave_ok": bool(delta_wave_ok),
         "device_batches": loop.device_batches,
         "breaker_state": loop.breaker.state,
         "metrics": summary,

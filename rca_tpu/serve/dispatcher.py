@@ -14,19 +14,26 @@ dispatches batch N, assembles batch N+1 from the queue, and only then
 fetches batch N, hiding the device round trip behind host scheduling
 work.
 
+Round 7 (ISSUE 6) makes the staging DEVICE-RESIDENT per graph: the
+prepared-graph cache additionally pins a resident base feature buffer,
+and a hot graph's dispatch uploads each lane as O(changed rows) scatter
+deltas against that base (``_propagate_ranked_batch_delta``) instead of
+restaging the full [b_pad, n_pad, C] stack; the fetch moves only the
+[B, 4, k] top-k diagnostic gather + the top-k pair — the full stack
+stays on device behind each result's lazy diagnostics.  Cache hits /
+misses / evictions and per-tenant delta reuse flow into
+:class:`rca_tpu.serve.metrics.ServeMetrics`.
+
 Parity contract: a request served at any batch width is bit-identical to
 the same request served alone, because every width runs the SAME
-batched executable (``_propagate_ranked_batch`` — a vmap of the same
-``propagate`` the one-shot path runs) over the same padded graph; batch
-width is padded to a power of two so the executable count stays bounded
-per shape bucket (pad lanes are zero hypotheses dropped at render).
-Sharded engines ride :func:`rca_tpu.parallel.sharded.stage_batch_ranked`
-with the batch padded to the mesh's dp multiple instead.
-
-Per-graph staging state (padded edges on device, segscan/up-table
-layouts, live-count scalar) is prepared once and LRU-cached, so a hot
-tenant's steady-state dispatch cost is the feature stack upload plus the
-enqueue.
+propagation body (``_ranked_lanes`` — a vmap of the same ``propagate``
+the one-shot path runs) over the same padded graph, whether the lanes
+were staged full or as deltas (base + changed rows reconstructs the
+exact request features); batch width is padded to a power of two so the
+executable count stays bounded per shape bucket (pad lanes are dropped
+at render).  Sharded engines ride
+:func:`rca_tpu.parallel.sharded.stage_batch_ranked` with the batch
+padded to the mesh's dp multiple instead.
 """
 
 from __future__ import annotations
@@ -38,12 +45,8 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-from rca_tpu.config import bucket_for
+from rca_tpu.config import bucket_for, serve_graph_cache_cap
 from rca_tpu.serve.request import GraphKey, K_CAP, ServeRequest
-
-#: prepared graphs kept hot (beyond this, least-recently-served evicts)
-GRAPH_CACHE_CAP = 32
-
 
 @dataclasses.dataclass
 class _PreparedGraph:
@@ -59,15 +62,26 @@ class _PreparedGraph:
     n_live: object = None
     sharded_graph: object = None  # ShardedGraph (sharded engine)
     kk: int = 0
+    # resident base feature buffer (ISSUE 6): the last full staging's
+    # lane-0 features, pinned on device as the delta-scatter base.  Only
+    # a FINITE base engages the delta path — a NaN row in the base would
+    # leak into pad lanes' sanitize count
+    base_host: object = None      # np [n_pad, C] raw mirror (diff base)
+    base_dev: object = None       # device [n_pad, C]
+    base_clean: bool = False
 
 
 @dataclasses.dataclass
 class BatchHandle:
     """One in-flight coalesced batch: the device values the async
-    dispatch left behind plus what fetch needs to render each lane."""
+    dispatch left behind plus what fetch needs to render each lane.
+    ``stacked`` is never fetched here — it backs the per-result lazy
+    diagnostics; ``diag`` is the [b_pad, 4, kk] top-k gather the fetch
+    actually moves."""
 
     requests: List[ServeRequest]
     stacked: object               # [b_pad, 4, n_pad] device values
+    diag: object                  # [b_pad, 4, kk] device values
     vals: object                  # [b_pad, kk]
     idx: object                   # [b_pad, kk]
     n_bad: object                 # sanitized-row count (device or host int)
@@ -84,8 +98,9 @@ class BatchDispatcher:
         self,
         engine=None,
         fault_hook: Optional[Callable[[str], None]] = None,
-        cache_cap: int = GRAPH_CACHE_CAP,
+        cache_cap: Optional[int] = None,
         clock: Callable[[], float] = time.perf_counter,
+        metrics=None,
     ):
         from rca_tpu.engine.runner import GraphEngine
 
@@ -97,7 +112,14 @@ class BatchDispatcher:
         # with "dispatch"/"fetch" before the device work; a raise here
         # exercises the serve loop's breaker + degraded-response path
         self.fault_hook = fault_hook
-        self._cache_cap = max(1, int(cache_cap))
+        self._cache_cap = max(
+            1,
+            int(cache_cap) if cache_cap is not None
+            else serve_graph_cache_cap(),
+        )
+        # cache + resident-reuse observability (ISSUE 6 satellite); the
+        # serve loop points this at its ServeMetrics
+        self.metrics = metrics
         self._graphs: "collections.OrderedDict[GraphKey, _PreparedGraph]" = (
             collections.OrderedDict()
         )
@@ -115,7 +137,11 @@ class BatchDispatcher:
         gs = self._graphs.get(key)
         if gs is not None:
             self._graphs.move_to_end(key)
+            if self.metrics is not None:
+                self.metrics.graph_cache("hit")
             return gs
+        if self.metrics is not None:
+            self.metrics.graph_cache("miss")
         n = req.features.shape[0]
         if self._sharded:
             graph = self.engine._shard(n, req.dep_src, req.dep_dst)
@@ -150,6 +176,8 @@ class BatchDispatcher:
         self._graphs[key] = gs
         while len(self._graphs) > self._cache_cap:
             self._graphs.popitem(last=False)
+            if self.metrics is not None:
+                self.metrics.graph_cache("eviction")
         return gs
 
     def _b_pad(self, b: int) -> int:
@@ -161,6 +189,30 @@ class BatchDispatcher:
             dp = self.engine.dp
             b_pad = -(-b_pad // dp) * dp
         return b_pad
+
+    # -- delta staging (ISSUE 6) ---------------------------------------------
+    def _lane_deltas(
+        self, gs: _PreparedGraph, batch: List[ServeRequest],
+    ) -> Optional[List[np.ndarray]]:
+        """Per-lane changed-row sets against the resident base, or None
+        when delta staging does not pay: no (finite) base yet, or the
+        batch has drifted so far from it that scattering moves no fewer
+        bytes than restaging.  NaN rows always diff as changed (NaN !=
+        NaN), so poisoned requests re-upload raw and sanitize on device —
+        bit-parity with full staging holds."""
+        if gs.base_host is None or not gs.base_clean:
+            return None
+        base = gs.base_host[: gs.n]
+        deltas = [
+            np.flatnonzero(np.any(req.features != base, axis=1))
+            for req in batch
+        ]
+        # the scatter ships a common padded width per lane: worth it only
+        # while the widest lane stays well under the full matrix
+        u_max = max((len(d) for d in deltas), default=0)
+        if 2 * u_max >= gs.n_pad:
+            return None
+        return deltas
 
     # -- the split -----------------------------------------------------------
     def dispatch(
@@ -179,42 +231,103 @@ class BatchDispatcher:
         gs = self._prepared(batch[0])
         b = len(batch)
         b_pad = self._b_pad(b)
+        if self._sharded:
+            from rca_tpu.engine.runner import finite_mask_rows_np
+            from rca_tpu.parallel.sharded import stage_batch_ranked
+
+            fb = np.zeros(
+                (b_pad, gs.n_pad, batch[0].features.shape[1]), np.float32
+            )
+            for i, req in enumerate(batch):
+                fb[i, : gs.n] = req.features
+            # host-side guard, same semantics as the sharded engine's
+            # analyze_batch (features are being staged from host anyway)
+            fb, n_bad = finite_mask_rows_np(fb)
+            stacked, diag, vals, idx = stage_batch_ranked(
+                self.engine.mesh, fb, gs.sharded_graph, self.engine.params,
+                gs.kk,
+            )
+        else:
+            deltas = self._lane_deltas(gs, batch)
+            if deltas is not None:
+                stacked, diag, vals, idx, n_bad = self._dispatch_delta(
+                    gs, batch, b_pad, deltas,
+                )
+            else:
+                stacked, diag, vals, idx, n_bad = self._dispatch_full(
+                    gs, batch, b_pad,
+                )
+        return BatchHandle(
+            requests=list(batch), stacked=stacked, diag=diag, vals=vals,
+            idx=idx, n_bad=n_bad, n=gs.n, engine_tag=self.engine_tag,
+            dispatch_ms=(self._clock() - t0) * 1e3,
+            # direct (loop-less) callers get a self-consistent stamp; the
+            # serve loop always passes its scheduler clock's ``now``
+            dispatched_at=now if now is not None else self._clock(),
+        )
+
+    def _dispatch_full(
+        self, gs: _PreparedGraph, batch: List[ServeRequest], b_pad: int,
+    ):
+        """Full staging: upload the whole [b_pad, n_pad, C] stack, and
+        refresh the resident base from lane 0 so the NEXT dispatch over
+        this graph can go delta."""
+        import jax.numpy as jnp
+
+        from rca_tpu.engine.runner import _propagate_ranked_batch
+
         fb = np.zeros(
             (b_pad, gs.n_pad, batch[0].features.shape[1]), np.float32
         )
         for i, req in enumerate(batch):
             fb[i, : gs.n] = req.features
-        if self._sharded:
-            from rca_tpu.engine.runner import finite_mask_rows_np
-            from rca_tpu.parallel.sharded import stage_batch_ranked
+        p = self.engine.params
+        out = _propagate_ranked_batch(
+            jnp.asarray(fb), gs.edges_j,
+            self.engine._aw, self.engine._hw,
+            p.steps, p.decay, p.explain_strength, p.impact_bonus,
+            gs.kk, gs.n_live, gs.up_ell, gs.down_seg, gs.up_seg,
+            error_contrast=p.error_contrast,
+        )
+        gs.base_host = fb[0].copy()
+        gs.base_dev = jnp.asarray(gs.base_host)
+        gs.base_clean = bool(np.isfinite(gs.base_host).all())
+        return out
 
-            # host-side guard, same semantics as the sharded engine's
-            # analyze_batch (features are being staged from host anyway)
-            fb, n_bad = finite_mask_rows_np(fb)
-            stacked, vals, idx = stage_batch_ranked(
-                self.engine.mesh, fb, gs.sharded_graph, self.engine.params,
-                gs.kk,
-            )
-        else:
-            import jax.numpy as jnp
+    def _dispatch_delta(
+        self,
+        gs: _PreparedGraph,
+        batch: List[ServeRequest],
+        b_pad: int,
+        deltas: List[np.ndarray],
+    ):
+        """Delta staging against the resident base: per lane one [U]
+        index block + one [U, C] row block, scattered on device — the
+        full feature stack never crosses the host boundary.  Pad slots
+        (and whole pad lanes) aim zero rows at the dummy row."""
+        import jax.numpy as jnp
 
-            from rca_tpu.engine.runner import _propagate_ranked_batch
+        from rca_tpu.engine.runner import _propagate_ranked_batch_delta
 
-            p = self.engine.params
-            stacked, vals, idx, n_bad = _propagate_ranked_batch(
-                jnp.asarray(fb), gs.edges_j,
-                self.engine._aw, self.engine._hw,
-                p.steps, p.decay, p.explain_strength, p.impact_bonus,
-                gs.kk, gs.n_live, gs.up_ell, gs.down_seg, gs.up_seg,
-                error_contrast=p.error_contrast,
-            )
-        return BatchHandle(
-            requests=list(batch), stacked=stacked, vals=vals, idx=idx,
-            n_bad=n_bad, n=gs.n, engine_tag=self.engine_tag,
-            dispatch_ms=(self._clock() - t0) * 1e3,
-            # direct (loop-less) callers get a self-consistent stamp; the
-            # serve loop always passes its scheduler clock's ``now``
-            dispatched_at=now if now is not None else self._clock(),
+        C = batch[0].features.shape[1]
+        u_max = max((len(d) for d in deltas), default=0)
+        u_pad = 1 << max(0, (max(u_max, 1) - 1).bit_length())
+        dummy = gs.n_pad - 1
+        idx_b = np.full((b_pad, u_pad), dummy, np.int32)
+        rows_b = np.zeros((b_pad, u_pad, C), np.float32)
+        for i, (req, changed) in enumerate(zip(batch, deltas)):
+            u = len(changed)
+            idx_b[i, :u] = changed
+            rows_b[i, :u] = req.features[changed]
+            if self.metrics is not None:
+                self.metrics.resident_reuse(req.tenant, gs.n - u)
+        p = self.engine.params
+        return _propagate_ranked_batch_delta(
+            gs.base_dev, jnp.asarray(idx_b), jnp.asarray(rows_b),
+            gs.edges_j, self.engine._aw, self.engine._hw,
+            p.steps, p.decay, p.explain_strength, p.impact_bonus,
+            gs.kk, gs.n_live, gs.up_ell, gs.down_seg, gs.up_seg,
+            error_contrast=p.error_contrast,
         )
 
     def fetch(self, handle: BatchHandle) -> List[object]:
@@ -224,7 +337,10 @@ class BatchDispatcher:
         THE designated device-sync point of the serve path
         (tools/lint_tick_sync.py forbids device_get/block_until_ready
         anywhere else in it) — async dispatch errors also surface here,
-        which is why the serve loop's breaker wraps the fetch."""
+        which is why the serve loop's breaker wraps the fetch.  Moves
+        only top-k-sized values: the [b_pad, 4, kk] diagnostic gather,
+        the top-k pair, and the sanitized-row scalar — the full stack
+        stays on device behind each result's lazy diagnostics."""
         import jax
 
         from rca_tpu.engine.runner import render_result
@@ -232,19 +348,20 @@ class BatchDispatcher:
         if self.fault_hook is not None:
             self.fault_hook("fetch")
         t1 = self._clock()
-        stacked, vals, idx, n_bad = jax.device_get(
-            (handle.stacked, handle.vals, handle.idx, handle.n_bad)
+        diag, vals, idx, n_bad = jax.device_get(
+            (handle.diag, handle.vals, handle.idx, handle.n_bad)
         )
         fetch_ms = (self._clock() - t1) * 1e3
         per_req_ms = (handle.dispatch_ms + fetch_ms) / len(handle.requests)
         results = []
         for b, req in enumerate(handle.requests):
             results.append(render_result(
-                stacked[b], vals[b], idx[b], req.names, handle.n, req.k,
+                diag[b], vals[b], idx[b], req.names, handle.n, req.k,
                 per_req_ms, int(len(req.dep_src)),
                 engine=handle.engine_tag,
                 # batch-wide count, as in analyze_batch: a poisoned row
                 # poisons every hypothesis built from the same snapshot
                 sanitized_rows=int(n_bad),
+                stacked_dev=handle.stacked[b],
             ))
         return results

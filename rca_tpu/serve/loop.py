@@ -74,14 +74,19 @@ class ServeLoop:
         self.batcher = ShapeBucketBatcher(
             self.config.max_batch, self.config.max_wait_us, clock=clock
         )
+        self.metrics = ServeMetrics()
         self.dispatcher = dispatcher or BatchDispatcher(
-            engine, fault_hook=fault_hook
+            engine, fault_hook=fault_hook, metrics=self.metrics,
         )
+        # an externally-built BatchDispatcher joins the loop's metrics
+        # (cache + resident-reuse observability) unless it already has its
+        # own; stub dispatchers without the attribute are left alone
+        if getattr(self.dispatcher, "metrics", "absent") is None:
+            self.dispatcher.metrics = self.metrics
         self.breaker = breaker or CircuitBreaker(
             failure_threshold=3, reset_after=1.0, clock=clock,
             name="serve.dispatch",
         )
-        self.metrics = ServeMetrics()
         # optional investigation store: an ok response with an
         # investigation_id appends a serve note there (the store's fcntl
         # locking is what makes this safe from the worker thread while

@@ -29,6 +29,12 @@ class ServeMetrics:
         self._occupancy: List[int] = []
         self._depth_peak = 0
         self.dispatched_requests = 0
+        # dispatcher cache observability (ISSUE 6): prepared-graph cache
+        # hits/misses/evictions, plus per-tenant resident-buffer reuse —
+        # how many requests rode the delta-scatter path and how many rows
+        # the resident base saved them from uploading
+        self._graph_cache = {"hit": 0, "miss": 0, "eviction": 0}
+        self._resident: Dict[str, Dict[str, int]] = {}
 
     def _tenant(self, tenant: str) -> Dict[str, int]:
         return self._counts.setdefault(
@@ -67,15 +73,42 @@ class ServeMetrics:
             self._occupancy.append(int(size))
             self.dispatched_requests += int(size)
 
+    def graph_cache(self, event: str) -> None:
+        """One prepared-graph cache event: ``hit``/``miss``/``eviction``
+        (the dispatcher calls this from its staging lookup)."""
+        with self._lock:
+            self._graph_cache[event] += 1
+
+    def resident_reuse(self, tenant: str, rows_saved: int) -> None:
+        """One request served via the resident delta path: ``rows_saved``
+        feature rows came from the device-pinned base instead of the
+        host upload."""
+        with self._lock:
+            rec = self._resident.setdefault(
+                tenant, {"delta_requests": 0, "rows_saved": 0}
+            )
+            rec["delta_requests"] += 1
+            rec["rows_saved"] += int(rows_saved)
+
     # -- reporting -----------------------------------------------------------
     def summary(self) -> Dict[str, object]:
         with self._lock:
             per_tenant = {}
-            for tenant, counts in sorted(self._counts.items()):
+            # union: a tenant that only ever rode the delta path (direct
+            # dispatcher callers) still shows its reuse counters
+            for tenant in sorted(set(self._counts) | set(self._resident)):
+                counts = self._counts.get(
+                    tenant, {k: 0 for k in _COUNTER_KEYS}
+                )
+                resident = self._resident.get(
+                    tenant, {"delta_requests": 0, "rows_saved": 0}
+                )
                 per_tenant[tenant] = {
                     **counts,
                     "queue_ms_p50": self._queue_ms.quantile(tenant, 0.50),
                     "queue_ms_p99": self._queue_ms.quantile(tenant, 0.99),
+                    "resident_delta_requests": resident["delta_requests"],
+                    "resident_rows_saved": resident["rows_saved"],
                 }
             occ = list(self._occupancy)
             occ_sorted = sorted(occ)
@@ -91,6 +124,7 @@ class ServeMetrics:
                 ),
                 "batch_occupancy_max": max(occ) if occ else None,
                 "queue_depth_peak": self._depth_peak,
+                "graph_cache": dict(self._graph_cache),
                 "shed_total": sum(
                     c["shed"] for c in self._counts.values()
                 ),

@@ -41,6 +41,17 @@ def f(x):
         return y
     return float(y)                # host cast on a traced value
 """, 2),
+    "resident-fetch": ("rca_tpu/engine/runner.py", """\
+import jax
+
+def analyze_arrays(run):
+    stacked, diag, vals, idx, n_bad = run()
+    return jax.device_get(stacked)     # bulk fetch outside a surface
+
+def render(handle):
+    handle.stacked.block_until_ready() # stray sync in a render helper
+    return handle
+""", 2),
     "retrace-hazard": ("rca_tpu/engine/streaming.py", """\
 import functools
 import jax
@@ -212,6 +223,16 @@ class Q:
         finally:
             self._lock.release()
 """),
+        ("rca_tpu/engine/runner.py", """\
+import jax
+
+def timed_fetch(run, timed):
+    stacked, diag, vals, idx, n_bad = run()
+    return jax.device_get((diag, vals, idx, n_bad))  # audited surface
+
+def full_diagnostics(self):
+    return jax.device_get(self._stacked_dev)  # the deferred bulk seam
+"""),
     )
     result = run_lint(root=root, use_baseline=False)
     assert result.clean, result.findings
@@ -330,11 +351,11 @@ def test_baseline_is_empty():
     assert load_baseline(default_baseline_path(ROOT)) == []
 
 
-def test_all_eight_rules_registered():
+def test_all_nine_rules_registered():
     assert set(all_rules()) == {
         "tick-sync", "swallowed-faults", "tracer-leak", "retrace-hazard",
         "rng-key-reuse", "lock-discipline", "env-discipline",
-        "nondet-discipline",
+        "nondet-discipline", "resident-fetch",
     }
     for rule in all_rules().values():
         assert rule.summary and rule.why
