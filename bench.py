@@ -120,21 +120,71 @@ def replay_metrics(n_services: int = 50, ticks: int = 40) -> dict:
 
 
 def lint_metrics() -> dict:
-    """graftlint wall time (ISSUE 4 satellite): the analyzer gates every
-    PR, so its cost is tracked like any other latency — if a new rule
-    makes ``rca lint`` crawl, this row catches it before the gate starts
-    getting skipped.  ``findings`` must stay 0 (the repo ships clean with
-    an empty baseline; ANALYSIS.md)."""
+    """graftlint wall time (ISSUE 4 satellite; ISSUE 7 extensions): the
+    analyzer gates every PR, so its cost is tracked like any other
+    latency — if a new rule makes ``rca lint`` crawl, this row catches it
+    before the gate starts getting skipped.  ``findings`` must stay 0
+    (the repo ships clean with an empty baseline; ANALYSIS.md).
+
+    ISSUE 7 adds the top-3 slowest rules, a ``concurrency`` sub-row
+    (the gravelock model's size: functions traversed, lock-order graph
+    shape) and the rsan shim's per-acquire overhead vs a bare lock —
+    the number that justifies "zero-cost when off, cheap enough for
+    every stress run when on"."""
+    import time
+
     from rca_tpu.analysis import run_lint
+    from rca_tpu.analysis.concurrency import model_for, rsan
+    from rca_tpu.analysis.core import repo_root
 
     result = run_lint()
-    slowest = max(result.per_rule_ms.items(), key=lambda kv: kv[1])
+    top3 = sorted(result.per_rule_ms.items(), key=lambda kv: -kv[1])[:3]
+
+    model = model_for(repo_root())
+    stats = model.stats()
+
+    # rsan overhead: uncontended acquire/release, bare vs sanitized
+    def time_lock(lock, n=20_000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with lock:
+                pass
+        return (time.perf_counter() - t0) / n * 1e9  # ns/acquire
+
+    import threading
+
+    bare_ns = time_lock(threading.Lock())
+    was = rsan.enabled()
+    rsan.enable()
+    try:
+        sanitized_ns = time_lock(rsan.SanitizedLock("bench._lock"))
+    finally:
+        rsan.RSAN.reset()
+        if not was:
+            rsan.disable()
+
     return {
         "wall_ms": round(result.wall_ms, 1),
         "files": result.files_scanned,
         "findings": len(result.findings),
-        "slowest_rule": slowest[0],
-        "slowest_rule_ms": round(slowest[1], 1),
+        "slowest_rules": [
+            {"rule": name, "ms": round(ms, 1)} for name, ms in top3
+        ],
+        "slowest_rule": top3[0][0],
+        "slowest_rule_ms": round(top3[0][1], 1),
+        "concurrency": {
+            "functions": stats["functions"],
+            "functions_traversed": stats["functions_traversed"],
+            "thread_roots": len(stats["thread_roots"]),
+            "locks": stats["locks"],
+            "lock_graph_nodes": stats["lock_graph_nodes"],
+            "lock_graph_edges": stats["lock_graph_edges"],
+            "rsan_overhead_pct": round(
+                100.0 * (sanitized_ns - bare_ns) / max(bare_ns, 1e-9), 1
+            ),
+            "rsan_acquire_ns": round(sanitized_ns, 1),
+            "bare_acquire_ns": round(bare_ns, 1),
+        },
     }
 
 

@@ -3,9 +3,17 @@
 Exit-code contract (stable for CI):
 
 - **0** — no findings (suppressed/baselined hits do not count); with
-  ``--tracecheck``, additionally no second-call recompilation;
-- **1** — findings (or a tracecheck recompile);
-- **2** — usage or internal error (unknown rule, malformed baseline).
+  ``--tracecheck``, additionally no second-call recompilation; with
+  ``--rsan``, additionally a clean runtime cross-check (no order
+  contradictions, no observed races, stress totals exact);
+- **1** — findings (or a tracecheck recompile, or an rsan failure);
+- **2** — usage or internal error (unknown rule, malformed baseline,
+  ``--changed`` mixed with explicit paths).
+
+``--changed`` lints only git-dirty files plus files whose content
+differs from the cached fingerprint index under ``.graftlint/``
+(refreshed by every default-scan run); interprocedural rules still see
+the whole package, so per-file findings match a full run.
 
 ``--json`` emits one machine-readable JSON object on stdout and nothing
 else — the same stdout hygiene contract as bench.py.
@@ -20,9 +28,12 @@ from typing import List, Optional
 
 from rca_tpu.analysis.core import (
     all_rules,
+    changed_files,
     default_baseline_path,
+    discover_files,
     repo_root,
     run_lint,
+    update_index,
     write_baseline,
 )
 
@@ -67,6 +78,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tracecheck", action="store_true",
                    help="also jit the public engine entry points twice "
                    "and fail on second-call recompilation")
+    p.add_argument("--changed", action="store_true",
+                   help="incremental: lint only git-dirty files and "
+                   "files whose content differs from the cached "
+                   ".graftlint/ fingerprint index (interprocedural "
+                   "rules still see the whole package, so findings "
+                   "match a full run on the same files)")
+    p.add_argument("--rsan", action="store_true",
+                   help="also run the gravelock runtime cross-check: a "
+                   "sanitized multi-thread stress whose observed lock "
+                   "orders and access pairs must agree with the static "
+                   "concurrency model (ANALYSIS.md)")
     p.add_argument("--root", default=None, help=argparse.SUPPRESS)
     return p
 
@@ -89,16 +111,40 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
+    paths = args.paths or None
+    changed: Optional[List[str]] = None
+    if args.changed:
+        if paths:
+            print("graftlint: error: --changed takes no explicit paths",
+                  file=sys.stderr)
+            return 2
+        changed = changed_files(root)
+        paths = changed
     try:
-        result = run_lint(
-            root=root, rules=rules,
-            baseline_path=args.baseline,
-            paths=args.paths or None,
-            use_baseline=not args.no_baseline,
-        )
+        if changed is not None and not changed:
+            # nothing changed: vacuously clean, no scan at all
+            from rca_tpu.analysis.core import LintResult
+
+            result = LintResult(
+                findings=[], suppressed=0, baselined=0,
+                stale_baseline=[], files_scanned=0, wall_ms=0.0,
+                per_rule_ms={},
+            )
+        else:
+            result = run_lint(
+                root=root, rules=rules,
+                baseline_path=args.baseline,
+                paths=paths,
+                use_baseline=not args.no_baseline,
+            )
     except (KeyError, FileNotFoundError, ValueError) as exc:
         print(f"graftlint: error: {exc}", file=sys.stderr)
         return 2
+    # refresh the fingerprint index for whatever this run scanned (the
+    # default set on full runs, the changed subset on --changed)
+    if not args.paths:
+        update_index(root, changed if changed is not None
+                     else discover_files(root))
 
     if args.write_baseline:
         bpath = args.baseline or default_baseline_path(root)
@@ -115,11 +161,24 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         trace = run_tracecheck()
 
+    rsan_report = None
+    if args.rsan:
+        from rca_tpu.analysis.concurrency.crosscheck import (
+            run_rsan_crosscheck,
+        )
+
+        rsan_report = run_rsan_crosscheck(root=root)
+
     if args.as_json:
         out = result.to_dict()
+        if changed is not None:
+            out["changed_files"] = changed
         if trace is not None:
             out["tracecheck"] = trace
             out["clean"] = out["clean"] and trace["ok"]
+        if rsan_report is not None:
+            out["rsan"] = rsan_report
+            out["clean"] = out["clean"] and rsan_report["ok"]
         print(json.dumps(out))
         return 0 if out["clean"] else 1
 
@@ -136,6 +195,8 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{result.baselined} baselined, "
               f"{result.files_scanned} files in "
               f"{result.wall_ms:.0f} ms")
+    if changed is not None:
+        print(f"graftlint: --changed scanned {len(changed)} file(s)")
     if trace is not None:
         for e in trace["entries"]:
             status = "ok" if e["ok"] else (
@@ -144,7 +205,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"tracecheck: {e['entry']}: {status} "
                   f"[warmup {e['warmup_compiles']} compiles, "
                   f"{e['wall_ms']:.0f} ms]")
-    clean = result.clean and (trace is None or trace["ok"])
+    if rsan_report is not None:
+        r = rsan_report
+        print(f"rsan: {'ok' if r['ok'] else 'FAILED'} "
+              f"[{r['acquires']} acquires over "
+              f"{len(r['locks_observed'])} locks "
+              f"({len(r['multi_thread_locks'])} multi-thread), "
+              f"{len(r['observed_edges'])} order edges, "
+              f"{len(r['contradictions'])} contradiction(s), "
+              f"{len(r['races_observed'])} race(s) observed, "
+              f"{r['wall_ms']:.0f} ms]")
+        for c in r["contradictions"]:
+            print(f"rsan: ORDER CONTRADICTION {c['edge'][0]} -> "
+                  f"{c['edge'][1]} (threads {', '.join(c['threads'])}; "
+                  f"chain {' -> '.join(c['chain'])})")
+        for race in r["races_observed"]:
+            predicted = ("statically predicted" if
+                         race["statically_predicted"]
+                         else "NOT statically predicted — model gap")
+            print(f"rsan: OBSERVED RACE {race['owner']}.{race['attr']} "
+                  f"between {', '.join(race['threads'])} ({predicted})")
+    clean = (result.clean and (trace is None or trace["ok"])
+             and (rsan_report is None or rsan_report["ok"]))
     print(f"graftlint: {'clean' if clean else 'FAILED'} ({counts})")
     return 0 if clean else 1
 

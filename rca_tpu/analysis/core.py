@@ -71,11 +71,16 @@ class Finding:
 class FileContext:
     """One parsed file, shared by every rule that scans it."""
 
-    def __init__(self, relpath: str, source: str, tree: ast.AST):
+    def __init__(self, relpath: str, source: str, tree: ast.AST,
+                 root: Optional[str] = None):
         self.relpath = relpath
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
+        # repo root of this lint run: interprocedural rules (gravelock)
+        # build their whole-package model from it, then report only the
+        # findings that live in THIS file
+        self.root = root or repo_root()
         self.cache: Dict[str, object] = {}  # cross-rule analysis memos
 
     def line(self, lineno: int) -> str:
@@ -181,6 +186,105 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"version": 1, "entries": entries}, f, indent=2)
         f.write("\n")
+
+
+# -- incremental lint (`rca lint --changed`) ---------------------------------
+#
+# A fingerprint index (content sha1 per scanned file) lives under
+# .graftlint/ in the repo root; every lint run that scans the default set
+# refreshes it.  `--changed` lints only the files that are git-dirty OR
+# whose content no longer matches the index — against the SAME
+# whole-package concurrency model a full run builds, so the findings for
+# a touched file are identical either way (asserted by
+# tests/test_gravelock.py::test_changed_parity).
+
+
+def index_path(root: str) -> str:
+    return os.path.join(root, ".graftlint", "index.json")
+
+
+def _sha1_file(path: str) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def load_index(root: str) -> Dict[str, str]:
+    path = index_path(root)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        files = data.get("files", {})
+        return {k: v for k, v in files.items() if isinstance(v, str)}
+    except (json.JSONDecodeError, OSError):
+        return {}
+
+
+def update_index(root: str, files: Sequence[str]) -> None:
+    """Refresh index entries for ``files`` (repo-relative).  Best-effort:
+    an unwritable tree must not fail the lint."""
+    idx = load_index(root)
+    for rel in files:
+        full = os.path.join(root, rel)
+        try:
+            idx[rel] = _sha1_file(full)
+        except OSError:
+            idx.pop(rel, None)
+    try:
+        os.makedirs(os.path.dirname(index_path(root)), exist_ok=True)
+        with open(index_path(root), "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "files": idx}, f, indent=0,
+                      sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+
+
+def _git_dirty(root: str) -> Set[str]:
+    """Repo-relative paths git considers modified/untracked (empty set
+    when git is unavailable — the fingerprint index still works)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain", "-z"],
+            capture_output=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return set()
+    if proc.returncode != 0:
+        return set()
+    out: Set[str] = set()
+    for entry in proc.stdout.decode("utf-8", "replace").split("\0"):
+        if len(entry) < 4:
+            continue
+        path = entry[3:]
+        out.add(path.replace(os.sep, "/"))
+    return out
+
+
+def changed_files(root: str) -> List[str]:
+    """The subset of the default scan set that is git-dirty or whose
+    content differs from the cached fingerprint index."""
+    scan = discover_files(root)
+    dirty = _git_dirty(root)
+    idx = load_index(root)
+    out = []
+    for rel in scan:
+        if rel in dirty:
+            out.append(rel)
+            continue
+        try:
+            digest = _sha1_file(os.path.join(root, rel))
+        except OSError:
+            out.append(rel)
+            continue
+        if idx.get(rel) != digest:
+            out.append(rel)
+    return out
 
 
 # -- runner -----------------------------------------------------------------
@@ -294,7 +398,7 @@ def run_lint(
                 message=f"{type(exc).__name__}: {exc}",
             ))
             continue
-        ctx = FileContext(rel, source, tree)
+        ctx = FileContext(rel, source, tree, root=root)
         file_off = ctx.file_suppressed()
         for rule in applicable:
             if rule.name in file_off or "all" in file_off:

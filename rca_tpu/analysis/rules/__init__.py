@@ -7,10 +7,12 @@ class — see ANALYSIS.md for the authoring contract.
 
 from rca_tpu.analysis.rules import env            # noqa: F401
 from rca_tpu.analysis.rules import faults         # noqa: F401
+from rca_tpu.analysis.rules import gravelock      # noqa: F401
 from rca_tpu.analysis.rules import locks          # noqa: F401
 from rca_tpu.analysis.rules import nondet         # noqa: F401
 from rca_tpu.analysis.rules import residentfetch  # noqa: F401
 from rca_tpu.analysis.rules import retrace        # noqa: F401
 from rca_tpu.analysis.rules import rng            # noqa: F401
+from rca_tpu.analysis.rules import threads        # noqa: F401
 from rca_tpu.analysis.rules import ticksync       # noqa: F401
 from rca_tpu.analysis.rules import tracer         # noqa: F401

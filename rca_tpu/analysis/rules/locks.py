@@ -1,137 +1,54 @@
-"""Rule ``lock-discipline``: shared mutable state mutates under its lock.
+"""Rule ``lock-discipline``: every ``acquire()`` has a guaranteed release.
 
-Two checks, both scoped to where they are load-bearing:
+``lock.acquire()`` whose release is not guaranteed by an
+immediately-following ``try/finally: lock.release()`` deadlocks every
+other thread the first time the guarded body raises; ``with lock:`` is
+the only shape a new early return cannot break.  Scope: all of
+``rca_tpu/``.
 
-1. **bare acquire** (everywhere in ``rca_tpu/``): ``lock.acquire()``
-   whose release is not guaranteed by an immediately-following
-   ``try/finally: lock.release()`` deadlocks the serve worker the first
-   time the guarded body raises.  ``with lock:`` is the only shape that
-   cannot be broken by a new early return.
-2. **unguarded mutation** (``rca_tpu/serve/``, ``rca_tpu/store/``): for
-   each class that builds a ``threading.Lock``/``RLock``/``Condition``
-   in ``__init__``, every ``self._x`` attribute that is mutated under
-   ``with self._lock`` anywhere is *lock-owned*; mutating it outside a
-   with-lock block (outside ``__init__``) is a finding.  This is exactly
-   the race class the serve queue's weighted-fair accounting and the
-   store's read-modify-write records cannot tolerate — a lost update
-   there is a stuck request or a vanished investigation note, not a
-   crash.
+History: through PR 6 this rule also carried an intra-function
+"lock-owned attribute mutated outside the lock" check scoped to
+``rca_tpu/serve/`` + ``rca_tpu/store/``.  That half is subsumed —
+strictly — by gravelock's interprocedural ``race-guard``
+(rules/gravelock.py): where the old check saw one method body in two
+hand-picked directories, race-guard knows which thread roots reach each
+write, which locks are held across call boundaries, and which instances
+can alias, so it covers the whole package.  The rule name and CLI
+contract are unchanged.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
 from rca_tpu.analysis.core import FileContext, Finding, Rule, register
-
-GUARDED_PREFIXES = ("rca_tpu/serve/", "rca_tpu/store/")
-
-MUTATING_METHODS = {
-    "append", "appendleft", "extend", "insert", "add", "update",
-    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
-    "clear", "sort", "reverse",
-}
 
 MESSAGE_ACQUIRE = (
     "`.acquire()` without an immediately-following try/finally release — "
     "use `with lock:` (an exception in the guarded body deadlocks every "
     "other thread)"
 )
-MESSAGE_MUTATION = (
-    "mutation of lock-owned attribute `self.{attr}` outside `with "
-    "self.{lock}` — racing the locked writers loses updates silently"
-)
-
-
-def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
-    """Attribute names assigned a threading.Lock/RLock/Condition (or a
-    lock-ish factory) in __init__."""
-    out: Set[str] = set()
-    for item in cls.body:
-        if not (isinstance(item, ast.FunctionDef)
-                and item.name == "__init__"):
-            continue
-        for node in ast.walk(item):
-            if not (isinstance(node, ast.Assign)
-                    and isinstance(node.value, ast.Call)):
-                continue
-            f = node.value.func
-            is_lock = (
-                isinstance(f, ast.Attribute)
-                and f.attr in ("Lock", "RLock", "Condition", "Semaphore",
-                               "BoundedSemaphore")
-            )
-            if not is_lock:
-                continue
-            for t in node.targets:
-                if (isinstance(t, ast.Attribute)
-                        and isinstance(t.value, ast.Name)
-                        and t.value.id == "self"):
-                    out.add(t.attr)
-    return out
-
-
-def _with_holds_lock(node: ast.With, locks: Set[str]) -> bool:
-    """Does this with-statement enter one of the class's locks?  Accepts
-    ``with self._lock:``, ``with self._cond:``, and lock-returning helper
-    methods like ``with self._locked(id):``."""
-    for item in node.items:
-        expr = item.context_expr
-        for sub in ast.walk(expr):
-            if (isinstance(sub, ast.Attribute)
-                    and isinstance(sub.value, ast.Name)
-                    and sub.value.id == "self"
-                    and (sub.attr in locks or "lock" in sub.attr.lower())):
-                return True
-    return False
-
-
-def _mutated_self_attr(node: ast.AST) -> Optional[str]:
-    """The self-attribute this statement/expression mutates, if any."""
-    if isinstance(node, (ast.Assign, ast.AugAssign)):
-        targets = (node.targets if isinstance(node, ast.Assign)
-                   else [node.target])
-        for t in targets:
-            base = t
-            while isinstance(base, ast.Subscript):
-                base = base.value
-            if (isinstance(base, ast.Attribute)
-                    and isinstance(base.value, ast.Name)
-                    and base.value.id == "self"):
-                return base.attr
-    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-        if node.func.attr in MUTATING_METHODS:
-            base = node.func.value
-            while isinstance(base, ast.Subscript):
-                base = base.value
-            if (isinstance(base, ast.Attribute)
-                    and isinstance(base.value, ast.Name)
-                    and base.value.id == "self"):
-                return base.attr
-    return None
 
 
 @register
 class LockDisciplineRule(Rule):
     name = "lock-discipline"
-    summary = ("acquire() needs try/finally (prefer `with`); serve/store "
-               "lock-owned state mutates only under its lock")
-    why = ("an unreleased lock deadlocks the serve worker; an unguarded "
-           "mutation races the locked writers and loses updates — a "
-           "stuck request or vanished record, never a crash")
+    summary = ("acquire() needs try/finally (prefer `with`); guarded-by "
+               "races are gravelock's race-guard rule")
+    why = ("an unreleased lock deadlocks the serve worker; every thread "
+           "that touches the lock afterwards parks forever — a hang, "
+           "never a crash")
+    # the rsan shim's acquire() IS the passthrough this rule polices —
+    # its release is the caller's contract, exactly like the primitive's
+    allow = {
+        "rca_tpu/analysis/concurrency/rsan.py": {"acquire", "__enter__"},
+    }
 
     def applies_to(self, relpath: str) -> bool:
         return relpath.startswith("rca_tpu/")
 
     def scan(self, ctx: FileContext) -> List[Finding]:
-        hits = self._bare_acquires(ctx)
-        if any(ctx.relpath.startswith(p) for p in GUARDED_PREFIXES):
-            hits += self._unguarded_mutations(ctx)
-        return hits
-
-    # -- 1: bare acquire ----------------------------------------------------
-    def _bare_acquires(self, ctx: FileContext) -> List[Finding]:
         # each acquire() is judged exactly once, at its immediate
         # statement: safe only as `x.acquire()` directly followed by
         # `try: ... finally: x.release()` in the same body
@@ -188,97 +105,3 @@ class LockDisciplineRule(Rule):
                     func=enclosing_func(node),
                 ))
         return hits
-
-    # -- 2: unguarded mutation of lock-owned attrs --------------------------
-    def _unguarded_mutations(self, ctx: FileContext) -> List[Finding]:
-        hits: List[Finding] = []
-        for cls in [n for n in ast.walk(ctx.tree)
-                    if isinstance(n, ast.ClassDef)]:
-            locks = _lock_attrs(cls)
-            if not locks:
-                continue
-            # the legacy `lock.acquire()` + `try/finally: release` shape
-            # holds the lock for its Try body exactly like `with lock:`
-            locked_trys = self._trys_after_acquire(cls, locks)
-            owned: Dict[str, str] = {}  # attr -> lock name (for message)
-
-            def entered_lock(node: ast.AST) -> Optional[str]:
-                if isinstance(node, ast.With) \
-                        and _with_holds_lock(node, locks):
-                    return self._with_lock_name(node, locks)
-                if node in locked_trys:
-                    return locked_trys[node]
-                return None
-
-            def collect(node: ast.AST, under: Optional[str]) -> None:
-                under = entered_lock(node) or under
-                attr = _mutated_self_attr(node)
-                if attr is not None and under is not None \
-                        and attr not in locks:
-                    owned.setdefault(attr, under)
-                for child in ast.iter_child_nodes(node):
-                    collect(child, under)
-
-            def check(node: ast.AST, under: bool, func: str) -> None:
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    func = node.name
-                    if func == "__init__":
-                        return  # construction happens-before sharing
-                under = under or entered_lock(node) is not None
-                attr = _mutated_self_attr(node)
-                if attr in owned and not under:
-                    hits.append(ctx.finding(
-                        self, node.lineno,
-                        MESSAGE_MUTATION.format(attr=attr,
-                                                lock=owned[attr]),
-                        func=func,
-                    ))
-                for child in ast.iter_child_nodes(node):
-                    check(child, under, func)
-
-            collect(cls, None)
-            for item in cls.body:
-                check(item, False, "<class>")
-        return hits
-
-    @staticmethod
-    def _trys_after_acquire(cls: ast.ClassDef,
-                            locks: Set[str]) -> Dict[ast.Try, str]:
-        """Try statements directly preceded by ``self.<lock>.acquire()``
-        in the same body — the region the acquire check blesses."""
-        out: Dict[ast.Try, str] = {}
-        for node in ast.walk(cls):
-            for field in ("body", "orelse", "finalbody"):
-                body = getattr(node, field, None)
-                if not (isinstance(body, list) and body
-                        and isinstance(body[0], ast.stmt)):
-                    continue
-                for prev, nxt in zip(body, body[1:]):
-                    if not isinstance(nxt, ast.Try):
-                        continue
-                    if not (isinstance(prev, ast.Expr)
-                            and isinstance(prev.value, ast.Call)):
-                        continue
-                    f = prev.value.func
-                    if (isinstance(f, ast.Attribute)
-                            and f.attr == "acquire"
-                            and isinstance(f.value, ast.Attribute)
-                            and isinstance(f.value.value, ast.Name)
-                            and f.value.value.id == "self"
-                            and (f.value.attr in locks
-                                 or "lock" in f.value.attr.lower())):
-                        out[nxt] = f.value.attr
-        return out
-
-    @staticmethod
-    def _with_lock_name(node: ast.With, locks: Set[str]) -> str:
-        for item in node.items:
-            for sub in ast.walk(item.context_expr):
-                if (isinstance(sub, ast.Attribute)
-                        and isinstance(sub.value, ast.Name)
-                        and sub.value.id == "self"
-                        and (sub.attr in locks
-                             or "lock" in sub.attr.lower())):
-                    return sub.attr
-        return "_lock"

@@ -1,0 +1,89 @@
+"""Rule ``thread-discipline``: threads and locks are built in ONE place.
+
+Raw ``threading.Thread(...)`` / ``threading.Lock()`` (and the rest of
+the lock family) construction anywhere in ``rca_tpu/`` outside
+``rca_tpu/util/threads.py`` is a finding.  The seam is what makes the
+gravelock analyses trustworthy: every thread is named with an explicit
+daemon flag (root discovery cannot miss one), every lock carries its
+``"Class.attr"`` identity (the static model and the rsan runtime record
+agree on names), and flipping ``RCA_RSAN=1`` shims every lock in the
+process without touching a call site.
+
+Subclassing ``threading.Thread`` stays legal (the subclass calls
+``super().__init__(name=..., daemon=...)`` — it IS a named, explicit
+thread, and the model roots its ``run``); ``threading.Event`` stays
+legal too (an event is a signal, not a mutual-exclusion region — it has
+no acquisition order to record).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+
+SEAM = "rca_tpu/util/threads.py"
+#: the rsan shim wraps the raw primitives by definition
+EXEMPT = (SEAM, "rca_tpu/analysis/concurrency/rsan.py")
+
+BANNED = {
+    "Thread", "Lock", "RLock", "Condition", "Semaphore",
+    "BoundedSemaphore",
+}
+
+MESSAGE = (
+    "raw `threading.{name}(...)` construction outside {seam} — use "
+    "make_lock/make_rlock/make_condition/make_thread/spawn so the "
+    "primitive is named, rsan-shimmable, and visible to gravelock's "
+    "thread-root discovery"
+)
+
+
+@register
+class ThreadDisciplineRule(Rule):
+    name = "thread-discipline"
+    summary = ("threading.Thread/Lock/... constructed only via "
+               "rca_tpu/util/threads.py (named, rsan-shimmable)")
+    why = ("an anonymous raw thread or lock is invisible to gravelock's "
+           "root discovery and to the rsan cross-check — the analyses "
+           "are only as sound as the constructor seam is complete")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("rca_tpu/") and relpath not in EXEMPT
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        # names imported straight from threading count as raw too
+        from_threading = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "threading":
+                for alias in node.names:
+                    if alias.name in BANNED:
+                        from_threading.add(alias.asname or alias.name)
+
+        hits: List[Finding] = []
+
+        def walk(node: ast.AST, func: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = node.name
+            if isinstance(node, ast.Call):
+                f = node.func
+                bad = None
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "threading"
+                        and f.attr in BANNED):
+                    bad = f.attr
+                elif isinstance(f, ast.Name) and f.id in from_threading:
+                    bad = f.id
+                if bad is not None:
+                    hits.append(ctx.finding(
+                        self, node.lineno,
+                        MESSAGE.format(name=bad, seam=SEAM), func=func,
+                    ))
+            for child in ast.iter_child_nodes(node):
+                walk(child, func)
+
+        walk(ctx.tree, "<module>")
+        return hits

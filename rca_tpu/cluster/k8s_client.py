@@ -319,9 +319,12 @@ class K8sApiClient:
         two openers race and orphan a started pump set whose watch threads
         nothing ever stops."""
         import contextlib
-        import threading
 
-        lock = self.__dict__.setdefault("_pumps_lock", threading.Lock())
+        from rca_tpu.util.threads import make_lock
+
+        lock = self.__dict__.setdefault(
+            "_pumps_lock", make_lock("K8sApiClient._pumps_lock")
+        )
         pumps = self.__dict__.setdefault("_pumps", {})
 
         @contextlib.contextmanager

@@ -58,10 +58,12 @@ and never depend on pump thread timing.
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 from typing import Any, Dict, List, Optional
 
 from rca_tpu.resilience.policy import Retry, record_fault, suppressed
+from rca_tpu.util.threads import make_lock
 
 QUEUE_CAP = 10_000
 # registry bound: dropping a consumer record is always safe (an unknown
@@ -198,10 +200,18 @@ class _Pump(threading.Thread):
             w.stop()
 
 
+# process-wide consumer-token sequence.  This was a CLASS attribute
+# incremented under each instance's own lock — a per-instance lock cannot
+# guard class-shared state, so two pump sets (two namespaces) registering
+# concurrently could mint the SAME token and silently cross their read
+# positions.  gravelock's race-guard flags exactly that shape; the fix is
+# a module-level atomic counter (itertools.count.__next__ is one bytecode
+# on CPython — no lock needed, no shared RMW left to race).
+_TOKEN_SEQ = itertools.count(1)
+
+
 class WatchPumpSet:
     """Shared pumps + change journal for one namespace, many consumers."""
-
-    _counter = 0
 
     def __init__(self, core_api: Any, namespace: str,
                  retry: Optional[Retry] = None):
@@ -213,7 +223,7 @@ class WatchPumpSet:
         self.retry = retry or Retry(
             attempts=2, base_delay=0.2, max_delay=5.0, seed=0,
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("WatchPumpSet._lock")
         # journal window: _journal[i] has absolute sequence _base + i
         self._journal: collections.deque = collections.deque()
         self._base = 0
@@ -241,8 +251,7 @@ class WatchPumpSet:
         """New consumer token positioned at the journal head (changes that
         predate the registration are the caller's resync's problem)."""
         with self._lock:
-            WatchPumpSet._counter += 1
-            token = f"pumps-{WatchPumpSet._counter}"
+            token = f"pumps-{next(_TOKEN_SEQ)}"
             self._consumers[token] = self._next
             if len(self._consumers) > MAX_CONSUMERS:
                 # evict the most-behind token (likely abandoned by a

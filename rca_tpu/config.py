@@ -179,6 +179,17 @@ def serve_graph_cache_cap() -> int:
     return env_int("RCA_SERVE_GRAPH_CACHE", 32, 1, 4096)
 
 
+def rsan_enabled() -> bool:
+    """``RCA_RSAN``: route the :mod:`rca_tpu.util.threads` constructors
+    through the gravelock runtime lock sanitizer (ANALYSIS.md) so lock
+    acquisition orders and shared-state access pairs are recorded for the
+    static model's cross-check.  Default off — bare primitives, zero
+    per-acquire cost."""
+    return env_str(
+        "RCA_RSAN", "0", choices=("0", "1", "on", "off"), lower=True,
+    ) in ("1", "on")
+
+
 # -- serving scheduler (ISSUE 3) --------------------------------------------
 # env knobs, each a validated int with the documented range:
 #

@@ -38,7 +38,6 @@ from __future__ import annotations
 import collections
 import functools
 import hashlib
-import threading
 import time
 from typing import Optional, Sequence, Tuple
 
@@ -47,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rca_tpu.config import bucket_for, resident_cache_cap
+from rca_tpu.util.threads import make_lock
 
 GraphDigest = Tuple[int, int, int, str]
 
@@ -255,7 +255,7 @@ class ResidentCache:
         self._sessions: "collections.OrderedDict[GraphDigest, ResidentSession]" = (
             collections.OrderedDict()
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("ResidentCache._lock")
         self.hits = 0
         self.misses = 0
         self.evictions = 0

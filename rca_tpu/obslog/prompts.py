@@ -11,9 +11,10 @@ from __future__ import annotations
 
 import datetime
 import json
-import threading
 from pathlib import Path
 from typing import Any, Dict, List, Optional
+
+from rca_tpu.util.threads import make_lock
 
 
 class PromptLogger:
@@ -22,7 +23,7 @@ class PromptLogger:
         self.root.mkdir(parents=True, exist_ok=True)
         ts = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
         self.path = self.root / f"prompt_log_{ts}.jsonl"
-        self._lock = threading.Lock()
+        self._lock = make_lock("PromptLogger._lock")
 
     def log_interaction(
         self,
@@ -83,7 +84,7 @@ class PromptLogger:
 
 
 _logger: Optional[PromptLogger] = None
-_logger_lock = threading.Lock()
+_logger_lock = make_lock("obslog.prompts._logger_lock")
 
 
 def get_logger(root: str = "logs/prompts") -> PromptLogger:

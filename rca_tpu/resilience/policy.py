@@ -19,9 +19,10 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import random
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+from rca_tpu.util.threads import make_lock
 
 FAULT_LOG_CAP = 256
 
@@ -47,7 +48,7 @@ class _FaultLog:
     """Bounded, thread-safe record of deliberately-swallowed faults."""
 
     def __init__(self, cap: int = FAULT_LOG_CAP):
-        self._lock = threading.Lock()
+        self._lock = make_lock("_FaultLog._lock")
         self._cap = cap
         self._entries: List[Dict[str, str]] = []
 
@@ -107,7 +108,7 @@ class Counter:
     """Thread-safe monotonic counter with delta snapshots."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("Counter._lock")
         self._value = 0
 
     def add(self, n: int = 1) -> None:
@@ -191,17 +192,26 @@ class Retry:
 
     def __post_init__(self) -> None:
         self._rng = random.Random(self.seed)
+        # one Retry policy is routinely SHARED across threads (the watch
+        # pump set hands one instance to both pump threads), so the
+        # read-modify-write counter and the jitter RNG draw both sit
+        # under a lock — gravelock's race-guard surfaced the unguarded
+        # `retries_spent += 1` as a lost-update race (ANALYSIS.md)
+        self._lock = make_lock("Retry._lock")
         self.retries_spent = 0  # instance-lifetime count
 
     def delay(self, attempt: int) -> float:
         """Backoff before re-try number ``attempt`` (1-based)."""
         d = min(self.base_delay * (2.0 ** (attempt - 1)), self.max_delay)
         if self.jitter:
-            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+            with self._lock:
+                jitter_draw = self._rng.random()
+            d *= 1.0 + self.jitter * (2.0 * jitter_draw - 1.0)
         return max(d, 0.0)
 
     def sleep_for(self, attempt: int) -> None:
-        self.retries_spent += 1
+        with self._lock:
+            self.retries_spent += 1
         RETRIES.add(1)
         self.sleep(self.delay(attempt))
 
@@ -265,7 +275,7 @@ class CircuitBreaker:
         self.reset_after = float(reset_after)
         self.clock = clock
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("CircuitBreaker._lock")
         self._failures = 0
         self._opened_at: Optional[float] = None
         self._half_open = False

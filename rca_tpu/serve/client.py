@@ -21,7 +21,6 @@ bit-parity check of coalesced vs. solo rankings.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -29,6 +28,7 @@ import numpy as np
 from rca_tpu.engine.runner import EngineAPI, EngineResult
 from rca_tpu.serve.loop import ServeLoop
 from rca_tpu.serve.request import PRIORITY_NORMAL, ServeRequest, ServeResponse
+from rca_tpu.util.threads import make_thread
 
 DEFAULT_TIMEOUT_S = 60.0
 
@@ -235,7 +235,8 @@ def serve_selftest(
                 )
 
         threads = [
-            threading.Thread(target=submitter, args=(w,))
+            make_thread(submitter, name=f"selftest-submit-{w}",
+                        daemon=True, args=(w,))
             for w in range(submitters)
         ]
         for t in threads:

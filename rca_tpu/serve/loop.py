@@ -47,6 +47,7 @@ from rca_tpu.serve.dispatcher import BatchDispatcher, BatchHandle
 from rca_tpu.serve.metrics import ServeMetrics
 from rca_tpu.serve.queue import RequestQueue
 from rca_tpu.serve.request import GraphKey, ServeRequest, ServeResponse
+from rca_tpu.util.threads import make_thread
 
 #: last-known rankings kept per graph for degraded responses
 _LAST_KNOWN_CAP = 128
@@ -115,8 +116,8 @@ class ServeLoop:
     def start(self) -> "ServeLoop":
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._run, name="rca-serve", daemon=True
+            self._thread = make_thread(
+                self._run, name="rca-serve", daemon=True
             )
             self._thread.start()
         return self

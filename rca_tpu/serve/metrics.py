@@ -11,10 +11,10 @@ submit path and the serve worker record concurrently.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List
 
 from rca_tpu.obslog.profiling import PhaseStats
+from rca_tpu.util.threads import make_lock
 
 _COUNTER_KEYS = (
     "submitted", "answered", "shed", "rejected", "degraded", "errors",
@@ -23,7 +23,7 @@ _COUNTER_KEYS = (
 
 class ServeMetrics:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServeMetrics._lock")
         self._counts: Dict[str, Dict[str, int]] = {}
         self._queue_ms = PhaseStats()      # one phase per tenant
         self._occupancy: List[int] = []

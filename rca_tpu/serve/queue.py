@@ -27,11 +27,11 @@ policy tests drive it with fake time.
 from __future__ import annotations
 
 import collections
-import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional
 
 from rca_tpu.serve.request import ServeRequest
+from rca_tpu.util.threads import make_condition
 
 
 class RequestQueue:
@@ -44,7 +44,7 @@ class RequestQueue:
             raise ValueError(f"queue cap must be >= 1, got {cap}")
         self.cap = int(cap)
         self.clock = clock
-        self._cond = threading.Condition()
+        self._cond = make_condition("RequestQueue._cond")
         self._lanes: Dict[str, Deque[ServeRequest]] = {}
         self._vtime: Dict[str, float] = {}    # per-tenant last finish tag
         self._weights: Dict[str, float] = {}

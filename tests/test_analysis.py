@@ -96,13 +96,63 @@ class Q:
         with self._lock:
             self._items.append(x)
 
-    def racy_put(self, x):
-        self._items.append(x)      # lock-owned attr, no lock held
-
     def leaky(self):
         self._lock.acquire()       # no try/finally release
         self._items.pop()
         self._lock.release()
+""", 1),
+    "race-guard": ("rca_tpu/serve/bad_race.py", """\
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="w", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._done += 1        # unguarded RMW from the worker root
+
+    def bump(self):
+        with self._lock:
+            self._done += 1        # the dominant guard, held by main
+""", 1),
+    "lock-order": ("rca_tpu/serve/bad_order.py", """\
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            self._inner_b()        # A -> B across a call boundary
+
+    def _inner_b(self):
+        with self._b:
+            pass
+
+    def backward(self):
+        with self._b:
+            with self._a:          # B -> A: closes the cycle
+                pass
+""", 1),
+    "thread-discipline": ("rca_tpu/serve/bad_threads.py", """\
+import threading
+
+def main(fn):
+    lock = threading.Lock()        # raw lock outside util/threads.py
+    t = threading.Thread(target=fn, args=(lock,))  # raw anonymous thread
+    t.start()
+    return t
 """, 2),
     "env-discipline": ("rca_tpu/engine/bad_env.py", """\
 import os
@@ -205,11 +255,11 @@ def sample():
     return jax.random.normal(k1, (3,)), jax.random.uniform(k2, (3,))
 """),
         ("rca_tpu/serve/good_locks.py", """\
-import threading
+from rca_tpu.util.threads import make_lock
 
 class Q:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("Q._lock")
         self._items = []
 
     def put(self, x):
@@ -222,6 +272,49 @@ class Q:
             self._items.append(x)
         finally:
             self._lock.release()
+"""),
+        ("rca_tpu/serve/good_race.py", """\
+from rca_tpu.util.threads import make_lock, make_thread
+
+class Worker:
+    def __init__(self):
+        self._lock = make_lock("Worker._lock")
+        self._done = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = make_thread(self._run, name="w", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._done += 1    # every write site holds the guard
+
+    def bump(self):
+        with self._lock:
+            self._done += 1
+"""),
+        ("rca_tpu/serve/good_order.py", """\
+from rca_tpu.util.threads import make_lock
+
+class Pair:
+    def __init__(self):
+        self._a = make_lock("Pair._a")
+        self._b = make_lock("Pair._b")
+
+    def forward(self):
+        with self._a:
+            self._inner_b()
+
+    def _inner_b(self):
+        with self._b:
+            pass
+
+    def also_forward(self):
+        with self._a:
+            with self._b:          # same order everywhere: acyclic
+                pass
 """),
         ("rca_tpu/engine/runner.py", """\
 import jax
@@ -351,11 +444,12 @@ def test_baseline_is_empty():
     assert load_baseline(default_baseline_path(ROOT)) == []
 
 
-def test_all_nine_rules_registered():
+def test_all_twelve_rules_registered():
     assert set(all_rules()) == {
         "tick-sync", "swallowed-faults", "tracer-leak", "retrace-hazard",
         "rng-key-reuse", "lock-discipline", "env-discipline",
-        "nondet-discipline", "resident-fetch",
+        "nondet-discipline", "resident-fetch", "race-guard",
+        "lock-order", "thread-discipline",
     }
     for rule in all_rules().values():
         assert rule.summary and rule.why

@@ -1,0 +1,549 @@
+"""gravelock (rca_tpu/analysis/concurrency, ANALYSIS.md): the static
+race/deadlock analyzer finds what it must and nothing else, the rsan
+runtime shim records real executions, the cross-check catches an
+inverted acquire order BOTH ways, the serve scheduler survives a seeded
+8-thread barrage with the sanitizer on, and `rca lint --changed` agrees
+with a full run on the touched files."""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from rca_tpu.analysis import run_lint
+from rca_tpu.analysis.concurrency import model_for, rsan
+from rca_tpu.analysis.concurrency.crosscheck import (
+    order_contradictions,
+    queue_metrics_stress,
+    run_rsan_crosscheck,
+)
+from rca_tpu.analysis.concurrency.lockorder import analyze_lock_order
+from rca_tpu.analysis.concurrency.races import analyze_races
+from rca_tpu.analysis.core import changed_files, repo_root
+from rca_tpu.util.threads import make_lock, make_thread, spawn
+
+ROOT = repo_root()
+
+
+@pytest.fixture
+def sanitized():
+    """rsan on for the test body, restored (and drained) afterwards."""
+    was = rsan.enabled()
+    rsan.enable()
+    rsan.RSAN.reset()
+    try:
+        yield rsan.RSAN
+    finally:
+        rsan.RSAN.reset()
+        if not was:
+            rsan.disable()
+
+
+def _fake_repo(tmp_path, *entries):
+    for rel, src in entries:
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+INVERTED = ("rca_tpu/serve/inverted.py", """\
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            self._inner_b()
+
+    def _inner_b(self):
+        with self._b:
+            pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+""")
+
+
+# ---------------------------------------------------------------------------
+# static model
+# ---------------------------------------------------------------------------
+
+def test_repo_thread_roots_discovered():
+    """Root discovery sees every way this repo starts a thread: the
+    serve worker (make_thread target), the watch pumps (Thread
+    subclass, multi-instance), and the selftest submitters (closure
+    spawned in a comprehension, multi-instance)."""
+    m = model_for(ROOT)
+    roots = {r.root_id: r for r in m.roots}
+    assert "rca-serve" in roots
+    assert "_Pump" in roots and roots["_Pump"].multi
+    assert "submitter" in roots and roots["submitter"].multi
+
+
+def test_repo_statically_clean():
+    """After this PR's fixes (Retry counter lock, watch-pump token
+    counter) the package carries no race or deadlock findings — the
+    empty-baseline acceptance criterion for the new rules."""
+    m = model_for(ROOT)
+    assert analyze_races(m) == []
+    assert analyze_lock_order(m) == []
+
+
+def test_static_catches_unguarded_multiroot_write(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/serve/w.py", """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._done = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(
+                target=self._run, name="w", daemon=True
+            )
+            self._thread.start()
+
+        def _run(self):
+            self._done += 1
+
+        def bump(self):
+            with self._lock:
+                self._done += 1
+    """))
+    result = run_lint(root=root, rules=["race-guard"], use_baseline=False)
+    assert len(result.findings) == 1
+    f = result.findings[0]
+    assert "dominant guard is `Worker._lock`" in f.message
+    assert f.func == "_run"
+
+
+def test_static_catches_shared_instance_across_spawned_copies(tmp_path):
+    """The Retry-counter shape: one object handed to N copies of the
+    same thread root, mutated with no lock anywhere."""
+    root = _fake_repo(tmp_path, ("rca_tpu/serve/b.py", """\
+    import threading
+
+    class Budget:
+        def __init__(self):
+            self.spent = 0
+
+        def charge(self):
+            self.spent += 1
+
+    class Owner:
+        def __init__(self):
+            self.budget = Budget()
+            self.threads = [
+                threading.Thread(
+                    target=self.work, name="worker", daemon=True
+                )
+                for _ in range(2)
+            ]
+
+        def work(self):
+            self.budget.charge()
+    """))
+    result = run_lint(root=root, rules=["race-guard"], use_baseline=False)
+    assert len(result.findings) == 1
+    assert "no common lock" in result.findings[0].message
+
+
+def test_static_distinct_instances_do_not_pair(tmp_path):
+    """Per-instance state consistently guarded per owner must NOT flag,
+    even when one owner's accesses ride a worker thread and the other's
+    ride main — the receiver-context approximation at work (this is the
+    PhaseStats shape that a naive per-class lockset would flag)."""
+    root = _fake_repo(tmp_path, ("rca_tpu/serve/p.py", """\
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self.samples = []
+
+        def record(self, x):
+            self.samples.append(x)
+
+    class Guarded:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.stats = Stats()
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(
+                target=self._run, name="g", daemon=True
+            )
+            self._thread.start()
+
+        def _run(self):
+            with self._lock:
+                self.stats.record(1)
+
+    class Unshared:
+        def __init__(self):
+            self.stats = Stats()
+
+        def tick(self):
+            self.stats.record(2)
+    """))
+    result = run_lint(root=root, rules=["race-guard"], use_baseline=False)
+    assert result.clean, result.findings
+
+
+def test_static_lock_order_cycle_reports_chains(tmp_path):
+    root = _fake_repo(tmp_path, INVERTED)
+    result = run_lint(root=root, rules=["lock-order"], use_baseline=False)
+    assert len(result.findings) == 1
+    msg = result.findings[0].message
+    assert "Pair._a -> Pair._b" in msg and "Pair._b -> Pair._a" in msg
+    # the cross-call chain is named: where the outer was held and where
+    # the nested acquire happened
+    assert "Pair.forward" in msg and "Pair._inner_b" in msg
+
+
+def test_thread_discipline_rule(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/x.py", """\
+    import threading
+    from threading import Lock
+
+    def bad(fn):
+        a = threading.Lock()
+        b = Lock()
+        t = threading.Thread(target=fn)
+        return a, b, t
+    """), ("rca_tpu/y.py", """\
+    from rca_tpu.util.threads import make_lock, spawn
+
+    def good(fn):
+        a = make_lock("y.a")
+        return a, spawn(fn, name="worker")
+    """))
+    result = run_lint(root=root, rules=["thread-discipline"],
+                      use_baseline=False)
+    assert len(result.findings) == 3
+    assert all(f.path == "rca_tpu/x.py" for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# rsan runtime shim
+# ---------------------------------------------------------------------------
+
+def test_constructors_zero_cost_when_off():
+    was = rsan.enabled()
+    rsan.disable()
+    try:
+        lock = make_lock("t.lock")
+        assert isinstance(lock, type(threading.Lock()))
+    finally:
+        if was:
+            rsan.enable()
+
+
+def test_env_seeds_rsan(monkeypatch):
+    monkeypatch.setenv("RCA_RSAN", "1")
+    monkeypatch.setattr(rsan, "_ENABLED", None)
+    assert rsan.enabled()
+    lock = make_lock("t.env")
+    assert isinstance(lock, rsan.SanitizedLock)
+    monkeypatch.setattr(rsan, "_ENABLED", False)
+
+
+def test_rsan_records_order_edges_and_threads(sanitized):
+    a = make_lock("T._a")
+    b = make_lock("T._b")
+
+    def nested():
+        with a:
+            with b:
+                pass
+
+    t = spawn(nested, name="edge-maker")
+    t.join(10.0)
+    nested()
+    edges = sanitized.order_edges()
+    assert ("T._a", "T._b") in edges
+    rec = edges[("T._a", "T._b")]
+    assert rec["count"] == 2
+    assert set(rec["threads"]) >= {"edge-maker"}
+    assert sanitized.lock_threads()["T._a"]
+
+
+def test_rsan_observes_unguarded_write_pair(sanitized):
+    lock = make_lock("T._lock")
+
+    def guarded():
+        with lock:
+            rsan.note_access("Obj", "guarded")
+
+    def unguarded():
+        rsan.note_access("Obj", "naked")
+
+    ts = [spawn(guarded, name=f"g{i}") for i in range(2)]
+    ts += [spawn(unguarded, name=f"u{i}") for i in range(2)]
+    for t in ts:
+        t.join(10.0)
+    races = sanitized.races_observed()
+    keys = {(r["owner"], r["attr"]) for r in races}
+    assert ("Obj", "naked") in keys       # disjoint (empty) locksets
+    assert ("Obj", "guarded") not in keys  # common lock -> no pair
+
+
+def test_sanitized_condition_wait_rebalances_held_stack(sanitized):
+    from rca_tpu.util.threads import make_condition
+
+    cond = make_condition("T._cond")
+    outcome = {}
+
+    def waiter():
+        with cond:
+            cond.wait(0.05)
+            outcome["held_after_wait"] = rsan.held_locks()
+        outcome["held_after_exit"] = rsan.held_locks()
+
+    t = spawn(waiter, name="waiter")
+    t.join(10.0)
+    assert outcome["held_after_wait"] == ("T._cond",)
+    assert outcome["held_after_exit"] == ()
+
+
+# ---------------------------------------------------------------------------
+# the cross-check: static <-> runtime
+# ---------------------------------------------------------------------------
+
+def test_inverted_order_caught_statically_and_dynamically(
+        tmp_path, sanitized):
+    """THE acceptance scenario: the same inversion is a static lock-order
+    finding AND an rsan order contradiction when executed."""
+    # static leg: the fixture repo carries the cycle
+    root = _fake_repo(tmp_path, INVERTED)
+    static = run_lint(root=root, rules=["lock-order"], use_baseline=False)
+    assert len(static.findings) == 1
+
+    # dynamic leg: actually run both orders (sequentially — the point is
+    # the record, not a live deadlock) and diff against the static graph
+    a = make_lock("Pair._a")
+    b = make_lock("Pair._b")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    t1 = spawn(forward, name="fwd")
+    t1.join(10.0)
+    t2 = spawn(backward, name="bwd")
+    t2.join(10.0)
+
+    model = model_for(root)
+    contradictions = order_contradictions(
+        model.static_order_edges(), sanitized.order_edges()
+    )
+    edges = {tuple(c["edge"]) for c in contradictions}
+    # both observed directions close a cycle in the combined graph
+    assert ("Pair._a", "Pair._b") in edges
+    assert ("Pair._b", "Pair._a") in edges
+
+
+def test_order_contradiction_against_static_graph_only():
+    """An inversion of an edge only the STATIC graph knows is still a
+    contradiction — the runtime saw half a deadlock."""
+    observed = {
+        ("B", "A"): {"count": 1, "threads": ["t"], "chain": ["B", "A"]},
+    }
+    out = order_contradictions({("A", "B")}, observed)
+    assert [c["edge"] for c in out] == [["B", "A"]]
+    assert order_contradictions({("A", "B")}, {
+        ("A", "B"): {"count": 1, "threads": ["t"], "chain": ["A", "B"]},
+    }) == []
+
+
+# ---------------------------------------------------------------------------
+# tier-1 concurrency stress (RCA_RSAN=1)
+# ---------------------------------------------------------------------------
+
+def test_queue_metrics_stress_under_rsan(sanitized):
+    """Satellite: seeded 8-thread barrage over RequestQueue
+    submit/pop/shed/shutdown-drain + ServeMetrics counters, with every
+    lock sanitized.  Exact totals — a lost update fails loudly."""
+    out = queue_metrics_stress(seed=11, threads=8)
+    assert out["ok"], out
+    assert out["submitted_counted"] == out["requests"]
+    assert out["completed_counted"] == out["requests"]
+    assert out["queue_leftover"] == 0
+    # coverage: the queue's condition and the metrics lock were really
+    # contended across threads
+    lt = sanitized.lock_threads()
+    assert len(lt["RequestQueue._cond"]) >= 2
+    assert len(lt["ServeMetrics._lock"]) >= 2
+    assert sanitized.races_observed() == []
+
+
+def test_rsan_crosscheck_with_chaos_soak():
+    """Acceptance: the full cross-check — stress + a 40-tick seeded
+    chaos soak — runs clean against the repo's static model."""
+    out = run_rsan_crosscheck(seed=7, soak_ticks=40)
+    assert out["ok"], json.dumps(
+        {k: out[k] for k in ("contradictions", "races_observed",
+                             "stress", "soak")}, default=str)
+    assert out["soak"]["ticks"] == 40
+    assert out["soak"]["uncaught_exceptions"] == 0
+    assert out["contradictions"] == []
+    assert out["races_observed"] == []
+    assert len(out["multi_thread_locks"]) >= 2
+    assert not rsan.enabled()  # the check restores the off state
+
+
+# ---------------------------------------------------------------------------
+# regression: the races this analyzer surfaced (and this PR fixed)
+# ---------------------------------------------------------------------------
+
+def test_retry_counter_is_thread_safe():
+    """Pre-fix, `Retry.retries_spent += 1` was an unguarded RMW on an
+    object the watch-pump set shares across both pump threads; under a
+    barrage the counter lost updates."""
+    from rca_tpu.resilience.policy import Retry
+
+    retry = Retry(attempts=2, sleep=lambda s: None, seed=0)
+    n_threads, per_thread = 8, 400
+
+    def worker():
+        for _ in range(per_thread):
+            retry.sleep_for(1)
+
+    threads = [
+        make_thread(worker, name=f"retry-{i}", daemon=True)
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert retry.retries_spent == n_threads * per_thread
+
+
+def test_watch_pump_tokens_unique_across_sets():
+    """Pre-fix, the consumer-token counter was a CLASS attribute guarded
+    by each instance's own lock — two namespaces' pump sets could mint
+    the same token.  Tokens must be process-unique."""
+    from rca_tpu.cluster.watch_pump import WatchPumpSet
+
+    sets = [WatchPumpSet(core_api=None, namespace=f"ns{i}")
+            for i in range(4)]
+    tokens: list = []
+    lock = threading.Lock()
+
+    def register_many(ps):
+        got = [ps.register() for _ in range(50)]
+        with lock:
+            tokens.extend(got)
+
+    threads = [
+        make_thread(register_many, name=f"reg-{i}", daemon=True,
+                    args=(ps,))
+        for i, ps in enumerate(sets)
+        for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert len(tokens) == len(set(tokens)) == 4 * 2 * 50
+
+
+# ---------------------------------------------------------------------------
+# incremental lint (`rca lint --changed`)
+# ---------------------------------------------------------------------------
+
+def test_changed_parity(tmp_path):
+    """--changed on the touched files reports exactly what a full run
+    reports for those files (the interprocedural model is whole-package
+    either way)."""
+    from rca_tpu.analysis.__main__ import main
+
+    root = _fake_repo(
+        tmp_path,
+        ("rca_tpu/clean.py", "X = 1\n"),
+        ("rca_tpu/serve/w.py", """\
+        import os
+
+        def f():
+            return os.environ.get("RCA_X")
+        """),
+    )
+    # first full run seeds the fingerprint index (findings exist -> 1)
+    assert main(["--root", root, "--no-baseline"]) == 1
+    assert changed_files(root) == []
+
+    # touch one file: only it is re-linted, findings parity holds
+    (tmp_path / "rca_tpu/clean.py").write_text(
+        "import threading\nL = threading.Lock()\n"
+    )
+    assert changed_files(root) == ["rca_tpu/clean.py"]
+    full = run_lint(root=root, use_baseline=False)
+    full_for_file = [
+        f.to_dict() for f in full.findings
+        if f.path == "rca_tpu/clean.py"
+    ]
+    subset = run_lint(root=root, paths=["rca_tpu/clean.py"],
+                      use_baseline=False)
+    assert [f.to_dict() for f in subset.findings] == full_for_file
+    assert len(full_for_file) == 1  # the raw-lock thread-discipline hit
+
+    # the CLI --changed path consumes the index and exits on findings
+    assert main(["--root", root, "--changed", "--no-baseline"]) == 1
+    assert changed_files(root) == []
+    assert main(["--root", root, "--changed", "--no-baseline"]) == 0
+
+
+def test_changed_rejects_explicit_paths(tmp_path):
+    from rca_tpu.analysis.__main__ import main
+
+    root = _fake_repo(tmp_path, ("rca_tpu/clean.py", "X = 1\n"))
+    assert main(["--root", root, "--changed", "rca_tpu/clean.py"]) == 2
+
+
+def test_index_survives_missing_git(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/a.py", "A = 1\n"))
+    # no git repo at tmp_path: the fingerprint index alone drives it
+    assert changed_files(root) == ["rca_tpu/a.py"]
+    from rca_tpu.analysis.core import update_index
+
+    update_index(root, ["rca_tpu/a.py"])
+    assert changed_files(root) == []
+    (tmp_path / "rca_tpu/a.py").write_text("A = 2\n")
+    assert changed_files(root) == ["rca_tpu/a.py"]
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_rsan_json_shape(tmp_path, capsys):
+    from rca_tpu.analysis.__main__ import main
+
+    root = _fake_repo(tmp_path, ("rca_tpu/clean.py", "X = 1\n"))
+    rc = main(["--root", root, "--no-baseline", "--json", "--rsan"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["clean"] is True
+    assert out["rsan"]["ok"] is True
+    assert out["rsan"]["stress"]["ok"] is True
+    assert out["rsan"]["contradictions"] == []
+    assert not rsan.enabled()
