@@ -47,11 +47,17 @@ FETCH_SURFACES = {
     # restated here with the top-k-size obligation)
     "rca_tpu/engine/streaming.py": {"fetch"},
     "rca_tpu/parallel/streaming.py": {"fetch"},
-    "rca_tpu/parallel/sharded.py": set(),
+    # sharded resident session (ISSUE 8): same audited top-k fetch
+    # surface as the dense session's _fetch_topk
+    "rca_tpu/parallel/sharded.py": {"_fetch_topk"},
     "rca_tpu/engine/live.py": set(),
     "rca_tpu/serve/dispatcher.py": {"fetch"},
     "rca_tpu/serve/loop.py": set(),
     "rca_tpu/serve/client.py": set(),
+    # serve pool (ISSUE 8): replicas/router never sync directly — the
+    # steal path completes an orphan via BatchDispatcher.fetch
+    "rca_tpu/serve/replica.py": set(),
+    "rca_tpu/serve/pool.py": set(),
 }
 
 MESSAGE = (

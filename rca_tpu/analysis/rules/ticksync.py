@@ -36,6 +36,10 @@ TICK_MODULES = {
     "rca_tpu/serve/batcher.py": set(),
     "rca_tpu/serve/client.py": set(),
     "rca_tpu/serve/metrics.py": set(),
+    # serve pool (ISSUE 8): replicas and the router sync ONLY through
+    # BatchDispatcher.fetch — including the steal path's orphan fetch
+    "rca_tpu/serve/replica.py": set(),
+    "rca_tpu/serve/pool.py": set(),
 }
 
 MESSAGE = (

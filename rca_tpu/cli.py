@@ -441,8 +441,12 @@ def cmd_serve(args) -> int:
             ("max_batch", args.max_batch),
             ("max_wait_us", args.max_wait_us),
             ("queue_cap", args.queue_cap),
+            ("replicas", args.replicas),
+            ("replica_mix", args.replica_mix),
         ) if v is not None
     }
+    if args.no_steal:
+        overrides["steal"] = False
     config = ServeConfig.from_env(**overrides)
     if args.selftest:
         from rca_tpu.serve import serve_selftest
@@ -450,6 +454,8 @@ def cmd_serve(args) -> int:
         summary = serve_selftest(
             n_requests=args.requests, seed=args.seed, chaos=args.chaos,
             config=config, submitters=args.submitters,
+            replicas=config.replicas, replica_mix=config.replica_mix,
+            kill_replica=args.kill_replica,
         )
         print(json.dumps(summary, indent=None if args.compact else 2,
                          default=str))
@@ -457,7 +463,7 @@ def cmd_serve(args) -> int:
 
     from rca_tpu.cluster.generator import synthetic_cascade_arrays
     from rca_tpu.engine import make_engine
-    from rca_tpu.serve import ServeClient, ServeLoop
+    from rca_tpu.serve import ServeClient, ServeLoop, ServePool
 
     m = re.fullmatch(r"(\d+)svc", args.fixture or "500svc")
     if not m:
@@ -473,8 +479,14 @@ def cmd_serve(args) -> int:
         from rca_tpu.replay import Recorder
 
         recorder = Recorder(args.record, mode="serve")
-    loop = ServeLoop(engine=make_engine(), config=config,
-                     recorder=recorder)
+    pooled = len(config.replica_specs()) > 1
+    if pooled:
+        # the multi-replica serving plane: engines + device groups come
+        # from the replica mix (RCA_SERVE_REPLICAS / --replica-mix)
+        loop = ServePool(config=config, recorder=recorder)
+    else:
+        loop = ServeLoop(engine=make_engine(), config=config,
+                         recorder=recorder)
     tenants = [f"tenant-{i}" for i in range(args.tenants)]
     t0 = _time.perf_counter()
     with loop:
@@ -800,6 +812,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="override RCA_SERVE_MAX_WAIT_US")
     sp.add_argument("--queue-cap", type=int, default=None, dest="queue_cap",
                     help="override RCA_SERVE_QUEUE_CAP")
+    sp.add_argument("--replicas", type=int, default=None,
+                    help="serve-pool width: N engine replicas behind the "
+                    "shared queue (override RCA_SERVE_REPLICAS; >1 "
+                    "selects the pool scheduler)")
+    sp.add_argument("--replica-mix", default=None, dest="replica_mix",
+                    metavar="SPEC",
+                    help="replica kinds + device groups, e.g. "
+                    "'dense:2,sharded@4:2' (override "
+                    "RCA_SERVE_REPLICA_MIX; defines the replica count "
+                    "when given)")
+    sp.add_argument("--no-steal", action="store_true",
+                    help="disable work-stealing rebalance (RCA_SERVE_"
+                    "STEAL=0): a dead replica's staged work rides the "
+                    "degradation ladder instead)")
+    sp.add_argument("--kill-replica", action="store_true",
+                    dest="kill_replica",
+                    help="selftest chaos: kill replica 0 mid-wave and "
+                    "assert the steal protocol drops nothing "
+                    "(implies a pool of >= 2 replicas)")
     sp.add_argument("--record", default=None, metavar="PATH",
                     help="flight-record every served request to PATH "
                     "(load-demo mode); re-check with `rca replay PATH`")

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional
+import re
+from typing import Optional, Tuple
 
 VALID_BACKENDS = ("jax", "deterministic", "llm")
 
@@ -207,11 +208,90 @@ def rsan_enabled() -> bool:
 #                                            queue never grows unboundedly
 #                                            (default 256)
 
+#   RCA_SERVE_REPLICAS    [1, 64]            engine replicas behind the
+#                                            shared queue (serve pool;
+#                                            default 1 = the single
+#                                            ServeLoop scheduler)
+#   RCA_SERVE_STEAL       0|1|on|off         work-stealing rebalance on
+#                                            replica death / open breaker
+#                                            (default on; off = the
+#                                            victim's staged work rides
+#                                            the degradation ladder)
+#   RCA_SERVE_REPLICA_MIX e.g. "dense:2,sharded@4:2"   replica kinds +
+#                                            device-group sizes (see
+#                                            parse_replica_mix; empty =
+#                                            RCA_SERVE_REPLICAS dense
+#                                            replicas)
+
 _SERVE_ENV_RANGES = {
     "RCA_SERVE_MAX_BATCH": (1, 4096),
     "RCA_SERVE_MAX_WAIT_US": (0, 60_000_000),
     "RCA_SERVE_QUEUE_CAP": (1, 1_000_000),
+    "RCA_SERVE_REPLICAS": (1, 64),
 }
+
+#: replica kinds a serve-pool mix may name
+REPLICA_KINDS = ("dense", "sharded")
+
+_MIX_ENTRY = re.compile(r"(dense|sharded)(?:@(\d+))?(?::(\d+))?")
+
+
+def parse_replica_mix(
+    spec: str, default_replicas: int = 1,
+) -> Tuple[Tuple[str, Optional[int]], ...]:
+    """``RCA_SERVE_REPLICA_MIX`` → ``((kind, group_size|None), ...)``.
+
+    Syntax: comma-separated ``kind[@group_size][:count]`` entries, e.g.
+    ``"dense:2,sharded@4:2"`` = two dense replicas (one device each) plus
+    two sharded replicas spanning four devices each.  ``group_size``
+    defaults per kind at pool-construction time (dense → 1, sharded →
+    an equal share of the visible devices).  Empty/unset spec means
+    ``default_replicas`` dense replicas.  Malformed specs fail loudly —
+    a typo'd mix silently running one dense replica would fake away the
+    scaling the operator asked for."""
+    spec = (spec or "").strip().lower()
+    if not spec:
+        return tuple(("dense", None) for _ in range(default_replicas))
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = _MIX_ENTRY.fullmatch(part)
+        if m is None:
+            raise ValueError(
+                f"RCA_SERVE_REPLICA_MIX entry {part!r}: expected "
+                "'kind[@group_size][:count]' with kind in "
+                f"{REPLICA_KINDS}"
+            )
+        kind, group, count = m.group(1), m.group(2), m.group(3)
+        count = int(count) if count else 1
+        group_size = int(group) if group else None
+        if not 1 <= count <= 64:
+            raise ValueError(
+                f"RCA_SERVE_REPLICA_MIX entry {part!r}: count {count} "
+                "out of range [1, 64]"
+            )
+        if group_size is not None and not 1 <= group_size <= 4096:
+            raise ValueError(
+                f"RCA_SERVE_REPLICA_MIX entry {part!r}: group size "
+                f"{group_size} out of range [1, 4096]"
+            )
+        out.extend((kind, group_size) for _ in range(count))
+    if not 1 <= len(out) <= 64:
+        raise ValueError(
+            f"RCA_SERVE_REPLICA_MIX={spec!r}: {len(out)} replicas out "
+            "of range [1, 64]"
+        )
+    return tuple(out)
+
+
+def serve_steal_enabled() -> bool:
+    """``RCA_SERVE_STEAL``: work-stealing rebalance in the serve pool."""
+    return env_str(
+        "RCA_SERVE_STEAL", "1", choices=("0", "1", "on", "off"),
+        lower=True,
+    ) in ("1", "on")
 
 
 def _serve_env_int(name: str, default: int) -> int:
@@ -239,6 +319,9 @@ class ServeConfig:
     max_batch: int = 16      # RCA_SERVE_MAX_BATCH
     max_wait_us: int = 2000  # RCA_SERVE_MAX_WAIT_US
     queue_cap: int = 256     # RCA_SERVE_QUEUE_CAP
+    replicas: int = 1        # RCA_SERVE_REPLICAS (serve pool width)
+    steal: bool = True       # RCA_SERVE_STEAL (rebalance on death/open)
+    replica_mix: str = ""    # RCA_SERVE_REPLICA_MIX ("" = all dense)
 
     def __post_init__(self):
         # same ranges as the env parse, so a directly-constructed config
@@ -247,6 +330,7 @@ class ServeConfig:
             ("RCA_SERVE_MAX_BATCH", self.max_batch),
             ("RCA_SERVE_MAX_WAIT_US", self.max_wait_us),
             ("RCA_SERVE_QUEUE_CAP", self.queue_cap),
+            ("RCA_SERVE_REPLICAS", self.replicas),
         ):
             lo, hi = _SERVE_ENV_RANGES[name]
             if not lo <= int(value) <= hi:
@@ -254,6 +338,14 @@ class ServeConfig:
                     f"{name.lower().removeprefix('rca_serve_')}={value}: "
                     f"out of range [{lo}, {hi}]"
                 )
+        # a malformed mix fails at construction, not at pool start
+        parse_replica_mix(self.replica_mix, self.replicas)
+
+    def replica_specs(self) -> Tuple[Tuple[str, Optional[int]], ...]:
+        """The resolved replica set: the parsed mix when one is given
+        (its length then DEFINES the replica count), else ``replicas``
+        dense entries."""
+        return parse_replica_mix(self.replica_mix, self.replicas)
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -261,6 +353,9 @@ class ServeConfig:
             "max_batch": _serve_env_int("RCA_SERVE_MAX_BATCH", 16),
             "max_wait_us": _serve_env_int("RCA_SERVE_MAX_WAIT_US", 2000),
             "queue_cap": _serve_env_int("RCA_SERVE_QUEUE_CAP", 256),
+            "replicas": _serve_env_int("RCA_SERVE_REPLICAS", 1),
+            "steal": serve_steal_enabled(),
+            "replica_mix": env_str("RCA_SERVE_REPLICA_MIX", ""),
         }
         env.update(overrides)
         return cls(**env)

@@ -247,10 +247,19 @@ class ResidentCache:
     analog of the serving dispatcher's prepared-graph cache).  The lock
     serializes whole analyze calls: the donated-buffer swap inside a
     session must not interleave with another thread's dispatch over the
-    same session."""
+    same session.
 
-    def __init__(self, engine, cap: Optional[int] = None):
+    ``session_factory`` makes the cache engine-agnostic: the dense
+    engine uses the default :class:`ResidentSession`; the sharded engine
+    plugs :class:`rca_tpu.parallel.sharded.ShardedResidentSession` in
+    (same ``(engine, key, dep_src, dep_dst)`` constructor, same
+    ``analyze``/accounting surface), so one LRU + lock discipline serves
+    both (ISSUE 8 satellite)."""
+
+    def __init__(self, engine, cap: Optional[int] = None,
+                 session_factory=None):
         self._engine = engine
+        self._factory = session_factory or ResidentSession
         self._cap = int(cap) if cap is not None else resident_cache_cap()
         self._sessions: "collections.OrderedDict[GraphDigest, ResidentSession]" = (
             collections.OrderedDict()
@@ -278,7 +287,7 @@ class ResidentCache:
                 self.hits += 1
             else:
                 self.misses += 1
-                sess = ResidentSession(self._engine, key, dep_src, dep_dst)
+                sess = self._factory(self._engine, key, dep_src, dep_dst)
                 self._sessions[key] = sess
                 while len(self._sessions) > self._cap:
                     self._sessions.popitem(last=False)

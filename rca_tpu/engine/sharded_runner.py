@@ -86,6 +86,7 @@ class ShardedGraphEngine(EngineAPI):
         params: Optional[PropagationParams] = None,
         mesh=None,
         spec: Optional[str] = None,
+        resident: Optional[bool] = None,
     ):
         from rca_tpu.parallel.mesh import make_mesh
 
@@ -136,6 +137,21 @@ class ShardedGraphEngine(EngineAPI):
                 [("dp", 1), ("sp", self.sp)],
                 list(np.asarray(self.mesh.devices).reshape(-1)[: self.sp]),
             )
+        # device-resident one-shot sessions (ISSUE 8 satellite — PR 6's
+        # named leftover): repeat analyze calls over a known graph scatter
+        # only their changed rows into the mesh-pinned feature batch
+        # instead of restaging it.  Same knob, cache, and bit-parity
+        # contract as the dense engine's resident path.
+        from rca_tpu.config import resident_enabled
+
+        self._resident_cache = None
+        if resident if resident is not None else resident_enabled():
+            from rca_tpu.engine.resident import ResidentCache
+            from rca_tpu.parallel.sharded import ShardedResidentSession
+
+            self._resident_cache = ResidentCache(
+                self, session_factory=ShardedResidentSession
+            )
 
     # -- core --------------------------------------------------------------
     def _shard(self, n: int, dep_src: np.ndarray, dep_dst: np.ndarray):
@@ -165,6 +181,15 @@ class ShardedGraphEngine(EngineAPI):
 
         n = features.shape[0]
         k = k or min(self.config.top_k_root_causes, n)
+        # resident fast path (ISSUE 8 satellite): a repeat request over a
+        # known graph digest scatters its dirty rows into the mesh-pinned
+        # batch and restages nothing — bit-identical to the staging path
+        # below (property-tested).  The timed path keeps the restaged
+        # methodology so latency figures stay comparable across rounds.
+        if self._resident_cache is not None and not timed:
+            return self._resident_cache.analyze(
+                features, dep_src, dep_dst, names, k,
+            )
         # finite-mask guard: host-side here (the features are being staged
         # from host anyway), same zeroing semantics as the dense engine's
         # fused on-device pass — score parity holds under poisoned input

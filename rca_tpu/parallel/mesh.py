@@ -32,6 +32,35 @@ def make_mesh(
     return Mesh(grid, names)
 
 
+def carve_device_groups(
+    sizes: Sequence[int],
+    devices: Optional[Sequence] = None,
+) -> list:
+    """Contiguous device groups for a serve-pool replica set.
+
+    ``sizes[i]`` devices go to replica ``i``, carved in order so a
+    sharded replica's group stays ICI-adjacent (same reasoning as
+    :func:`make_mesh`'s innermost-axis rule).  When the host exposes
+    fewer devices than the replica set asks for, groups WRAP AROUND and
+    share devices — replicas then oversubscribe hardware (still correct;
+    the serve pool's occupancy metrics make the sharing visible) instead
+    of refusing to start, which is the right degradation for the
+    single-device laptop running an 8-replica config.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        raise ValueError("carve_device_groups: no devices visible")
+    groups = []
+    cursor = 0
+    for size in sizes:
+        size = max(1, int(size))
+        groups.append(
+            [devices[(cursor + j) % len(devices)] for j in range(size)]
+        )
+        cursor = (cursor + size) % len(devices)
+    return groups
+
+
 def make_multislice_mesh(
     n_slices: int,
     per_slice_axes: Sequence[Tuple[str, int]],

@@ -1,0 +1,150 @@
+"""Declarative partition rules: ONE table names how every graph tensor
+shards (ISSUE 8 tentpole, the ``match_partition_rules`` /
+``make_shard_and_gather_fns`` pattern from SNIPPETS.md [2][3]).
+
+Before this module, :mod:`rca_tpu.parallel.sharded` hand-built its
+``PartitionSpec`` tuples at three independent call sites (the shard_map
+``in_specs``/``out_specs``, the distributed top-k, and the
+``stage_sharded`` uploads) — adding one staged array meant editing all
+of them in lockstep, and the serve pool's replica construction would
+have added a fourth copy.  Here the layout lives in one rule table:
+
+- :data:`GRAPH_RULES` maps tensor NAMES (regex) to partition specs, with
+  the :data:`BATCH` placeholder standing for whatever axes the caller
+  batches over (``("dp",)`` single-slice, ``("slice", "dp")``
+  multi-slice);
+- :func:`match_partition_rules` resolves a set of names against the
+  table (the fmengine/EasyDeL shape: regex lookup, loud failure on an
+  unmatched name, scalars never partitioned);
+- :func:`make_shard_and_gather_fns` turns resolved specs into per-name
+  device_put shard closures and host gather closures for one mesh;
+- the serve pool derives replica device groups from the SAME table:
+  :meth:`PartitionRuleSet.mesh_axes` names the axes a replica's
+  sub-mesh is built over (``rca_tpu.serve.replica`` — replica
+  construction, graph-tensor sharding, and device-group assignment all
+  read one source of truth).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+#: placeholder axis name: substituted with the caller's batch axes when a
+#: rule is resolved (one table serves single- and multi-slice meshes)
+BATCH = "__batch__"
+
+
+def resolve_batch_axes(
+    spec: Tuple, batch_axes: Sequence[str] = ("dp",)
+):
+    """A rule's spec with :data:`BATCH` replaced by the actual batch axes
+    (a tuple of axis names collapses to one mesh dimension — hypotheses
+    spread over ``("slice", "dp")`` shard a single array axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    batch = tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
+    return P(*(batch if part == BATCH else part for part in spec))
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRuleSet:
+    """An ordered (regex, spec) table plus the mesh axes it talks about."""
+
+    axes: Tuple[str, ...]                  # canonical axis order (dp, sp)
+    rules: Tuple[Tuple[str, Tuple], ...]   # (pattern, spec parts)
+
+    def spec_for(
+        self, name: str, batch_axes: Sequence[str] = ("dp",)
+    ):
+        """The first matching rule's spec for ``name`` (loud failure on
+        no match — a silently-replicated tensor is a perf bug that no
+        test catches)."""
+        for pattern, parts in self.rules:
+            if re.search(pattern, name) is not None:
+                return resolve_batch_axes(parts, batch_axes)
+        raise ValueError(
+            f"no partition rule matches tensor {name!r} "
+            f"(rule table axes {self.axes}); add it to GRAPH_RULES"
+        )
+
+    def mesh_axes(self) -> Tuple[str, ...]:
+        """The mesh axis names the table's specs place tensors over —
+        the axes a replica's sub-mesh must expose (serve-pool replica
+        construction reads these instead of hard-coding 'dp'/'sp')."""
+        return self.axes
+
+
+#: the graph-propagation layout (was: hand-built specs in sharded.py):
+#: hypothesis batches over the batch axes, node blocks + per-shard edge
+#: partitions + segscan layouts over 'sp', weights/scalars replicated.
+GRAPH_RULES = PartitionRuleSet(
+    axes=("dp", "sp"),
+    rules=(
+        # hypothesis feature batches: [B, n_pad, C] — batch over BATCH,
+        # nodes over sp, channels replicated
+        (r"(^|\.)(features_batch|fb|f_loc)$", (BATCH, "sp", None)),
+        # per-shard edge partition rows: [sp, e_pad]
+        (r"(^|\.)(src_local|src_global|dst_global|mask)$", ("sp", None)),
+        # segscan layouts (ShardedSegLayouts fields): [sp, ...]
+        (r"(^|\.)(dn|up)_(other|mask|flags|ends|has)$", ("sp", None)),
+        # replicated scalars + weight vectors
+        (r"(^|\.)(n_live|aw|hw|anomaly_w|hard_w)$", ()),
+        # delta-scatter staging (sharded resident session): tiny [U]/[U, C]
+        # blocks, replicated — the scatter itself lands them in the right
+        # shard
+        (r"(^|\.)(delta_idx|delta_rows)$", ()),
+        # outputs: the [B, 4, n_pad] diagnostic stack (diag axis
+        # replicated, nodes sharded), score vectors, merged top-k
+        (r"(^|\.)stack$", (BATCH, None, "sp")),
+        (r"(^|\.)scores$", (BATCH, "sp")),
+        (r"(^|\.)topk_(vals|idx)$", (BATCH, None)),
+    ),
+)
+
+
+def match_partition_rules(
+    rules: PartitionRuleSet,
+    names: Iterable[str],
+    batch_axes: Sequence[str] = ("dp",),
+) -> Dict[str, object]:
+    """Resolve ``names`` against the rule table → {name: PartitionSpec}.
+
+    The dict shape (rather than a pytree walk) fits this codebase: staged
+    graph tensors are a flat named set, not a Flax parameter tree."""
+    return {
+        name: rules.spec_for(name, batch_axes) for name in names
+    }
+
+
+def make_shard_and_gather_fns(
+    partition_specs: Dict[str, object],
+    mesh,
+) -> Tuple[Dict[str, object], Dict[str, object]]:
+    """Per-name shard/gather closures for one mesh (SNIPPETS.md [3]).
+
+    ``shard_fns[name](array)`` device_puts the array to its
+    :class:`NamedSharding`; ``gather_fns[name](array)`` pulls a sharded
+    device value back to one host ndarray (checkpoint/debug seam — the
+    hot paths never gather full tensors, see the resident-fetch rule)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    shard_fns: Dict[str, object] = {}
+    gather_fns: Dict[str, object] = {}
+    for name, spec in partition_specs.items():
+        sharding = NamedSharding(mesh, spec)
+
+        def shard_fn(x, _s=sharding):
+            import jax.numpy as jnp
+
+            return jax.device_put(jnp.asarray(x), _s)
+
+        def gather_fn(x):
+            return np.asarray(x)
+
+        shard_fns[name] = shard_fn
+        gather_fns[name] = gather_fn
+    return shard_fns, gather_fns

@@ -28,13 +28,18 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from rca_tpu.engine.propagate import (
     PropagationParams,
     _noisy_or,
     background_excess,
     combine_score,
+)
+from rca_tpu.parallel.rules import (
+    GRAPH_RULES,
+    make_shard_and_gather_fns,
+    match_partition_rules,
 )
 
 
@@ -333,19 +338,22 @@ def _jitted_shard_fn(
             lambda f: kernel(f, src_l, src_g, dst_g, mask, n_live, aw=aw, hw=hw)
         )(f_loc)
 
-    batch_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
-    n_seg = len(ShardedSegLayouts._fields) if use_segscan else 0
+    # arg layout from the ONE rule table (rules.GRAPH_RULES) — the same
+    # source stage_sharded derives its upload shardings and the serve
+    # pool derives its replica meshes from
+    arg_names = (
+        "f_loc", "src_local", "src_global", "dst_global", "mask",
+        "n_live", "aw", "hw",
+        *(ShardedSegLayouts._fields if use_segscan else ()),
+    )
     shard_fn = shard_map_compat(
         per_device,
         mesh=mesh,
-        in_specs=(
-            P(batch_spec, "sp", None),
-            P("sp", None), P("sp", None), P("sp", None), P("sp", None),
-            P(), P(), P(),
-            *([P("sp", None)] * n_seg),
+        in_specs=tuple(
+            GRAPH_RULES.spec_for(name, batch_axes) for name in arg_names
         ),
         # [B, 4, n_pad]: diagnostic axis replicated, nodes sharded
-        out_specs=P(batch_spec, None, "sp"),
+        out_specs=GRAPH_RULES.spec_for("stack", batch_axes),
         check_vma=False,
     )
     return jax.jit(shard_fn)
@@ -378,14 +386,16 @@ def _jitted_topk_fn(mesh: Mesh, k: int, batch_axes: tuple = ("dp",)):
         vv, pos = jax.lax.top_k(vg, k)
         return vv, jnp.take_along_axis(ig, pos, axis=1)
 
-    batch_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
     shard_fn = shard_map_compat(
         per_device,
         mesh=mesh,
-        in_specs=(P(batch_spec, "sp"),),
+        in_specs=(GRAPH_RULES.spec_for("scores", batch_axes),),
         # merged results are replicated across 'sp' (every shard holds the
         # same k winners after the gather+merge)
-        out_specs=(P(batch_spec, None), P(batch_spec, None)),
+        out_specs=(
+            GRAPH_RULES.spec_for("topk_vals", batch_axes),
+            GRAPH_RULES.spec_for("topk_idx", batch_axes),
+        ),
         check_vma=False,
     )
     return jax.jit(shard_fn)
@@ -424,18 +434,23 @@ def stage_sharded(
         use_segscan=seg is not None,
         error_contrast=params.error_contrast,
     )
-    batch_spec = tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
-    fb = jax.device_put(
-        jnp.asarray(features_batch),
-        NamedSharding(mesh, P(batch_spec, "sp", None)),
+    # upload shardings from the SAME rule table the shard_map's in_specs
+    # derive from — one source of truth for the whole staged layout
+    edge_names = ("src_local", "src_global", "dst_global", "mask")
+    seg_names = ShardedSegLayouts._fields if seg is not None else ()
+    shard_fns, _ = make_shard_and_gather_fns(
+        match_partition_rules(
+            GRAPH_RULES, ("features_batch", *edge_names, *seg_names),
+            batch_axes,
+        ),
+        mesh,
     )
-    edge_sharding = NamedSharding(mesh, P("sp", None))
+    fb = shard_fns["features_batch"](features_batch)
     args = tuple(
-        jax.device_put(jnp.asarray(x), edge_sharding)
-        for x in (graph.src_local, graph.src_global, graph.dst_global, graph.mask)
+        shard_fns[name](getattr(graph, name)) for name in edge_names
     )
     seg_args = tuple(
-        jax.device_put(jnp.asarray(x), edge_sharding) for x in seg
+        shard_fns[name](x) for name, x in zip(seg_names, seg)
     ) if seg is not None else ()
     n_live = jnp.asarray(graph.n, jnp.int32)
     awj, hwj = jnp.asarray(aw), jnp.asarray(hw)
@@ -514,3 +529,162 @@ def stage_batch_ranked(
     stack = stage_sharded(mesh, features_batch, graph, params, batch_axes)()
     vals, idx = sharded_topk(mesh, stack[:, 3], kk, batch_axes)
     return stack, batch_topk_diag(stack, idx), vals, idx
+
+
+# ---------------------------------------------------------------------------
+# Sharded one-shot resident session (ISSUE 8 satellite: close PR 6's
+# named leftover — the sharded analyze path got the top-k fetch treatment
+# in round 7 but still restaged the full feature batch per call)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_lane0(fb, idx, rows):
+    """Donated in-place row scatter into lane 0 of the sharded resident
+    feature batch: the [U] index block and [U, C] row block are tiny
+    replicated uploads (GRAPH_RULES ``delta_idx``/``delta_rows``); GSPMD
+    routes each row to the shard that owns it.  Pad slots aim at the
+    dummy node row with zero rows — already zero, so a no-op at any pad
+    width (same convention as the dense resident scatter)."""
+    return fb.at[0, idx].set(rows)
+
+
+class ShardedResidentSession:
+    """One graph's device-resident SHARDED analyze state: the multi-device
+    twin of :class:`rca_tpu.engine.resident.ResidentSession`, pluggable
+    into the same :class:`rca_tpu.engine.resident.ResidentCache` (the
+    cache's lock serializes access; the donated buffer swap must not
+    race).
+
+    The session pins the sharded edge partition, the segscan layouts, and
+    the [1, n_pad, C] feature batch on the mesh (shardings from
+    :data:`rca_tpu.parallel.rules.GRAPH_RULES`); a repeat request diffs
+    against a raw host mirror and ships O(changed rows) through the
+    donated scatter instead of restaging the batch.
+
+    Bit-parity contract: the resident buffer holds the SANITIZED features
+    (the sharded kernel has no fused finite-mask pass, so the host-side
+    ``finite_mask_rows_np`` guard runs per request over the raw input —
+    the same values the restaged path would upload, row for row; NaN rows
+    always diff as changed and re-scatter their zeroed form), so scores,
+    rankings, and sanitized-row counts are bit-identical to restaging —
+    property-tested in tests/test_resident.py.
+    """
+
+    def __init__(self, engine, key, dep_src, dep_dst):
+        n, num_features, n_edges, _ = key
+        self.engine = engine
+        self.key = key
+        self._n = n
+        self._num_features = num_features
+        self._n_edges = n_edges
+        self._graph = engine._shard(n, dep_src, dep_dst)
+        self._n_pad = self._graph.n_pad
+        self._mesh = engine._exec_mesh
+        p = engine.params
+        seg = sharded_seg_layouts_for(self._graph)
+        self._fn = _jitted_shard_fn(
+            self._mesh, p.steps, p.decay, p.explain_strength,
+            p.impact_bonus, ("dp",),
+            use_segscan=seg is not None,
+            error_contrast=p.error_contrast,
+        )
+        edge_names = ("src_local", "src_global", "dst_global", "mask")
+        seg_names = ShardedSegLayouts._fields if seg is not None else ()
+        shard_fns, _ = make_shard_and_gather_fns(
+            match_partition_rules(
+                GRAPH_RULES, ("features_batch", *edge_names, *seg_names),
+            ),
+            self._mesh,
+        )
+        self._shard_fb = shard_fns["features_batch"]
+        self._args = tuple(
+            shard_fns[name](getattr(self._graph, name))
+            for name in edge_names
+        )
+        self._seg_args = tuple(
+            shard_fns[name](x) for name, x in zip(seg_names, seg)
+        ) if seg is not None else ()
+        self._n_live = jnp.asarray(n, jnp.int32)
+        aw, hw = p.weight_arrays()
+        self._aw, self._hw = jnp.asarray(aw), jnp.asarray(hw)
+        self._fb = None              # device [1, n_pad, C], sharded
+        self._mirror = None          # np [n, C] RAW request mirror (diff base)
+        # accounting (ResidentCache.stats + bench read these)
+        self.requests = 0
+        self.delta_requests = 0
+        self.last_upload_rows = 0
+        self.upload_bytes = 0
+        self.fetch_bytes = 0
+
+    def _fetch_topk(self, diag, vals, idx):
+        """THE session's device-sync point: moves only the [4, kk]
+        diagnostic gather and the top-k pair (resident-fetch lint — no
+        full-[n_pad] fetch on this path)."""
+        diag, vals, idx = jax.device_get((diag, vals, idx))
+        self.fetch_bytes += diag.nbytes + vals.nbytes + idx.nbytes
+        return diag, vals, idx
+
+    def analyze(self, features, names, k: int):
+        import time as _time
+
+        from rca_tpu.engine.runner import finite_mask_rows_np, render_result
+
+        t0 = _time.perf_counter()
+        features = np.asarray(features, np.float32)
+        clean, n_bad = finite_mask_rows_np(features)
+        kk = min(k + 8, self._n_pad)
+        changed = (
+            None if self._mirror is None
+            else np.flatnonzero(np.any(features != self._mirror, axis=1))
+        )
+        if changed is None or 2 * len(changed) >= self._n_pad:
+            # first request over this graph — or the delta stopped paying:
+            # stage the full sanitized batch once and pin it on the mesh
+            fb_host = np.zeros(
+                (1, self._n_pad, self._num_features), np.float32
+            )
+            fb_host[0, : self._n] = clean
+            self._fb = self._shard_fb(fb_host)
+            self._mirror = features.copy()
+            self.last_upload_rows = self._n_pad
+            self.upload_bytes += fb_host.nbytes
+        elif len(changed):
+            # delta request: O(changed rows) up, donated sharded scatter.
+            # NaN rows diff as changed every time (NaN != NaN) and
+            # re-ship their sanitized (zeroed) form — parity holds
+            u = len(changed)
+            u_pad = 1 << max(0, (u - 1).bit_length())
+            idx_h = np.full(u_pad, self._n_pad - 1, np.int32)
+            rows_h = np.zeros((u_pad, self._num_features), np.float32)
+            idx_h[:u] = changed
+            rows_h[:u] = clean[changed]
+            with self._mesh:
+                self._fb = _scatter_lane0(
+                    self._fb, jnp.asarray(idx_h), jnp.asarray(rows_h)
+                )
+            # mirror updates only once the dispatch is accepted — a raise
+            # above leaves the old mirror, so the next request re-diffs
+            self._mirror[changed] = features[changed]
+            self.delta_requests += 1
+            self.last_upload_rows = u_pad
+            self.upload_bytes += idx_h.nbytes + rows_h.nbytes
+        else:
+            # identical request (retry, hypothesis re-rank): zero upload
+            self.delta_requests += 1
+            self.last_upload_rows = 0
+        self.requests += 1
+        with self._mesh:
+            stack = self._fn(
+                self._fb, *self._args, self._n_live, self._aw, self._hw,
+                *self._seg_args,
+            )
+        vals, idx = sharded_topk(self._mesh, stack[:, 3], kk)
+        diag = batch_topk_diag(stack, idx)
+        diag, vals, idx = self._fetch_topk(diag[0], vals[0], idx[0])
+        latency_ms = (_time.perf_counter() - t0) * 1e3
+        return render_result(
+            diag, vals, idx, names, self._n, k, latency_ms,
+            self._n_edges, engine=self.engine.engine_tag,
+            sanitized_rows=int(n_bad), stacked_dev=stack[0],
+        )

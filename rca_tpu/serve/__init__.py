@@ -16,6 +16,11 @@ layer (SERVING.md):
   (dispatch/fetch split; fetch is THE sync point, lint-enforced);
 - :mod:`rca_tpu.serve.loop` — the continuous-batching worker with
   breaker-gated degradation;
+- :mod:`rca_tpu.serve.replica` / :mod:`rca_tpu.serve.pool` — the
+  multi-replica, multi-device serving plane (ISSUE 8): N engine
+  replicas (dense/sharded mix over carved device groups) behind the
+  shared queue, shape-bucket-sticky routing, per-replica breakers, and
+  work-stealing failover with exactly-once completion;
 - :mod:`rca_tpu.serve.client` — in-process client, the coordinator's
   EngineAPI facade, and the ``rca serve --selftest`` harness;
 - :mod:`rca_tpu.serve.metrics` — per-tenant queue/occupancy metrics.
@@ -32,7 +37,13 @@ from rca_tpu.serve.client import ServeClient, ServeEngineAdapter, serve_selftest
 from rca_tpu.serve.dispatcher import BatchDispatcher, BatchHandle
 from rca_tpu.serve.loop import ServeLoop
 from rca_tpu.serve.metrics import ServeMetrics
+from rca_tpu.serve.pool import ServePool
 from rca_tpu.serve.queue import RequestQueue
+from rca_tpu.serve.replica import (
+    CompletionSink,
+    ReplicaWorker,
+    build_replica_engines,
+)
 from rca_tpu.serve.request import (
     PRIORITY_BATCH,
     PRIORITY_HIGH,
@@ -44,6 +55,10 @@ from rca_tpu.serve.request import (
 
 __all__ = [
     "ShapeBucketBatcher",
+    "ServePool",
+    "ReplicaWorker",
+    "CompletionSink",
+    "build_replica_engines",
     "ServeClient",
     "ServeEngineAdapter",
     "serve_selftest",

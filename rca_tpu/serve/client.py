@@ -157,6 +157,9 @@ def serve_selftest(
     config=None,
     submitters: int = 4,
     timeout_s: float = 300.0,
+    replicas: int = 1,
+    replica_mix: str = "",
+    kill_replica: bool = False,
 ) -> Dict[str, object]:
     """End-to-end scheduler smoke: ``n_requests`` mixed-tenant requests
     over three shape buckets, submitted from ``submitters`` concurrent
@@ -167,7 +170,19 @@ def serve_selftest(
     (the batching-parity contract), then returns the summary the CLI
     prints.  ``chaos`` wires a seeded fault hook into the dispatcher to
     exercise the breaker + degraded path (parity is then checked on the
-    ok responses only — degraded ones are stale by contract)."""
+    ok responses only — degraded ones are stale by contract).
+
+    ``replicas`` > 1 (or a non-empty ``replica_mix``) runs the same
+    contract through the :class:`rca_tpu.serve.pool.ServePool` — parity
+    is then checked per replica KIND against that replica's own engine,
+    the summary carries the per-replica occupancy / steal / breaker
+    rows, and exactly-once is asserted via the sink's
+    ``double_completions``.  ``kill_replica`` kills replica 0 mid-wave
+    (the chaos seam behind ``rca serve --selftest --kill-replica``): the
+    work-stealing rebalance must leave every request answered-or-shed
+    with zero double completions."""
+    import dataclasses as _dc
+
     from rca_tpu.cluster.generator import synthetic_cascade_arrays
     from rca_tpu.config import ServeConfig
     from rca_tpu.engine.runner import GraphEngine
@@ -184,10 +199,30 @@ def serve_selftest(
     ]
     tenants = [f"tenant-{c}" for c in "abcd"]
     rng = np.random.default_rng(seed)
-    loop = ServeLoop(
-        engine=engine, config=config or ServeConfig.from_env(),
-        fault_hook=fault_hook,
-    )
+    use_pool = replicas > 1 or bool(replica_mix) or kill_replica
+    if use_pool:
+        from rca_tpu.serve.pool import ServePool
+
+        cfg = _dc.replace(
+            config or ServeConfig.from_env(),
+            replicas=max(replicas, 2 if kill_replica else 1),
+            replica_mix=replica_mix,
+        )
+        loop = ServePool(config=cfg, fault_hook=fault_hook)
+    else:
+        loop = ServeLoop(
+            engine=engine, config=config or ServeConfig.from_env(),
+            fault_hook=fault_hook,
+        )
+    # parity oracles: the engine serving each replica kind (a pool's ok
+    # response names its engine tag; the solo rerun must use the SAME
+    # engine so dense-vs-sharded float differences cannot masquerade as
+    # batching-parity failures)
+    solo_by_tag = {"serve+single": engine}
+    if use_pool:
+        for r in loop.replicas:
+            solo_by_tag.setdefault(r.dispatcher.engine_tag,
+                                   r.dispatcher.engine)
     loop.queue.set_weight(tenants[0], 2.0)  # one heavy tenant
     specs = []
     for i in range(n_requests):
@@ -228,6 +263,10 @@ def serve_selftest(
         def submitter(worker: int) -> None:
             for i in range(worker, n_requests, submitters):
                 s = specs[i]
+                if kill_replica and worker == 0 and i >= n_requests // 2:
+                    # chaos seam: replica 0 dies mid-wave; the steal
+                    # protocol must keep every request answered-or-shed
+                    loop.replicas[0].kill()
                 requests[i] = client.submit(
                     s["features"], s["case"].dep_src, s["case"].dep_dst,
                     names=s["case"].names, tenant=s["tenant"], k=3,
@@ -286,7 +325,7 @@ def serve_selftest(
     ):
         if not resp.ok:
             continue
-        solo = engine.analyze_arrays(
+        solo = solo_by_tag.get(resp.result.engine, engine).analyze_arrays(
             spec["features"], spec["case"].dep_src, spec["case"].dep_dst,
             spec["case"].names, k=3,
         )
@@ -319,7 +358,7 @@ def serve_selftest(
             and resident_delta_requests >= 1
         ))
     )
-    return {
+    out = {
         "ok": bool(ok),
         "requests": n_requests,
         "chaos": bool(chaos),
@@ -331,6 +370,30 @@ def serve_selftest(
         "resident_delta_requests": resident_delta_requests,
         "delta_wave_ok": bool(delta_wave_ok),
         "device_batches": loop.device_batches,
-        "breaker_state": loop.breaker.state,
         "metrics": summary,
     }
+    if use_pool:
+        # pool-mode rows: exactly-once accounting + the per-replica
+        # occupancy / steal / breaker table (metrics["replicas"]) the
+        # CLI prints; a nonzero double_completions fails the selftest
+        out["replicas"] = len(loop.replicas)
+        out["replica_mix"] = [r.kind for r in loop.replicas]
+        out["kill_replica"] = bool(kill_replica)
+        out["steals_total"] = summary.get("steals_total", 0)
+        out["double_completions"] = loop.sink.double_completions
+        out["breaker_state"] = {
+            str(r.replica_id): (
+                r.breaker.state if r.alive() else "dead"
+            )
+            for r in loop.replicas
+        }
+        out["ok"] = bool(out["ok"] and loop.sink.double_completions == 0)
+        if kill_replica:
+            out["ok"] = bool(
+                out["ok"] and out["steals_total"] >= 0
+                and any(s == "dead"
+                        for s in out["breaker_state"].values())
+            )
+    else:
+        out["breaker_state"] = loop.breaker.state
+    return out

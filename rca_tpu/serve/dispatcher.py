@@ -47,6 +47,7 @@ import numpy as np
 
 from rca_tpu.config import bucket_for, serve_graph_cache_cap
 from rca_tpu.serve.request import GraphKey, K_CAP, ServeRequest
+from rca_tpu.util.threads import make_lock
 
 @dataclasses.dataclass
 class _PreparedGraph:
@@ -120,6 +121,11 @@ class BatchDispatcher:
         # cache + resident-reuse observability (ISSUE 6 satellite); the
         # serve loop points this at its ServeMetrics
         self.metrics = metrics
+        # the prepared-graph cache is read by the serve pool's router
+        # (bucket stickiness asks "is this graph resident HERE?") while
+        # the owning replica worker stages into it — one lock covers the
+        # lookup/insert/evict triple (ISSUE 8)
+        self._graphs_lock = make_lock("BatchDispatcher._graphs_lock")
         self._graphs: "collections.OrderedDict[GraphKey, _PreparedGraph]" = (
             collections.OrderedDict()
         )
@@ -132,11 +138,21 @@ class BatchDispatcher:
         )
 
     # -- per-graph staging ---------------------------------------------------
+    def has_graph(self, key: GraphKey) -> bool:
+        """Is this graph's staging state (edges + layouts + resident
+        base) already pinned here?  The serve pool's router uses this for
+        bucket stickiness — a resident bucket keeps dispatching to the
+        replica that holds its base."""
+        with self._graphs_lock:
+            return key in self._graphs
+
     def _prepared(self, req: ServeRequest) -> _PreparedGraph:
         key = req.graph_key
-        gs = self._graphs.get(key)
+        with self._graphs_lock:
+            gs = self._graphs.get(key)
+            if gs is not None:
+                self._graphs.move_to_end(key)
         if gs is not None:
-            self._graphs.move_to_end(key)
             if self.metrics is not None:
                 self.metrics.graph_cache("hit")
             return gs
@@ -173,9 +189,13 @@ class BatchDispatcher:
                 n_live=jnp.asarray(n, jnp.int32),
                 kk=min(K_CAP + 8, n_pad),
             )
-        self._graphs[key] = gs
-        while len(self._graphs) > self._cache_cap:
-            self._graphs.popitem(last=False)
+        evictions = 0
+        with self._graphs_lock:
+            self._graphs[key] = gs
+            while len(self._graphs) > self._cache_cap:
+                self._graphs.popitem(last=False)
+                evictions += 1
+        for _ in range(evictions):
             if self.metrics is not None:
                 self.metrics.graph_cache("eviction")
         return gs

@@ -31,28 +31,26 @@ body on a daemon worker for real serving.
 
 from __future__ import annotations
 
-import collections
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 from rca_tpu.config import ServeConfig
 from rca_tpu.resilience.policy import (
     CircuitBreaker,
     record_fault,
-    suppressed,
 )
 from rca_tpu.serve.batcher import ShapeBucketBatcher
 from rca_tpu.serve.dispatcher import BatchDispatcher, BatchHandle
 from rca_tpu.serve.metrics import ServeMetrics
 from rca_tpu.serve.queue import RequestQueue
-from rca_tpu.serve.request import GraphKey, ServeRequest, ServeResponse
+from rca_tpu.serve.replica import (
+    STAGE_AHEAD_BATCHES as _STAGE_AHEAD_BATCHES,
+    CompletionSink,
+)
+from rca_tpu.serve.request import ServeRequest, ServeResponse
 from rca_tpu.util.threads import make_thread
 
-#: last-known rankings kept per graph for degraded responses
-_LAST_KNOWN_CAP = 128
-#: staging window: how far the loop reads ahead of the current batch
-_STAGE_AHEAD_BATCHES = 4
 #: idle park time when nothing is queued, staged, or in flight
 _IDLE_WAIT_S = 0.05
 
@@ -93,16 +91,18 @@ class ServeLoop:
         # locking is what makes this safe from the worker thread while
         # submitters touch the same investigation)
         self.store = store
-        self._last_known: "collections.OrderedDict[GraphKey, List[dict]]" = (
-            collections.OrderedDict()
+        # response delivery is shared machinery with the serve pool
+        # (ISSUE 8): the sink owns the last-known ladder, exactly-once
+        # accounting, store notes, and recorder frames
+        self.sink = CompletionSink(
+            self.metrics, clock, store=store, recorder=recorder,
         )
         self._inflight: Optional[BatchHandle] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.device_batches = 0   # batches actually dispatched to device
         # flight recorder (ISSUE 5): every OK response logs its full
-        # request inputs + ranking as a self-contained serve frame,
-        # written only from the worker thread (one writer, no lock)
+        # request inputs + ranking as a self-contained serve frame
         self.recorder = recorder
         if recorder is not None:
             recorder.begin_session({
@@ -236,11 +236,7 @@ class ServeLoop:
         while self.batcher.staged():
             pending.extend(self.batcher.take_ready(drain=True) or [])
         for req in pending:
-            self.metrics.errors(req.tenant)
-            req.complete(ServeResponse(
-                status="error", request_id=req.request_id,
-                tenant=req.tenant, detail="serve loop stopped",
-            ))
+            self.sink.error(req, "serve loop stopped")
 
     # -- guarded device path -------------------------------------------------
     def _dispatch_guarded(
@@ -279,82 +275,14 @@ class ServeLoop:
                 )
             return
         self.breaker.record_success()
-        now = self.clock()
         width = len(handle.requests)
         self.metrics.record_batch(width)
         for req, result in zip(handle.requests, results):
-            ranked = [dict(r) for r in result.ranked]
-            self._remember(req.graph_key, ranked)
-            if self.recorder is not None:
-                # a recording failure must not fail the response
-                with suppressed("serve.record"):
-                    self.recorder.record_serve(req, ranked)
-            queue_ms = max(
-                0.0, (handle.dispatched_at - req.enqueued_at) * 1e3
-            )
-            self.metrics.answered(req.tenant, queue_ms)
-            self._store_note(req, result)
-            req.complete(ServeResponse(
-                status="ok", request_id=req.request_id, tenant=req.tenant,
-                ranked=ranked, queue_ms=round(queue_ms, 3),
-                batch_size=width,
-                deadline_missed=req.expired(now),
-                result=result,
-            ))
+            self.sink.ok(req, result, width, handle.dispatched_at)
 
-    # -- response helpers ----------------------------------------------------
-    def _remember(self, key: GraphKey, ranked: List[dict]) -> None:
-        self._last_known[key] = ranked
-        self._last_known.move_to_end(key)
-        while len(self._last_known) > _LAST_KNOWN_CAP:
-            self._last_known.popitem(last=False)
-
+    # -- response helpers (shared with the pool via CompletionSink) ----------
     def _respond_shed(self, req: ServeRequest, detail: str) -> None:
-        self.metrics.shed(req.tenant)
-        req.complete(ServeResponse(
-            status="shed", request_id=req.request_id, tenant=req.tenant,
-            detail=detail,
-        ))
+        self.sink.shed(req, detail)
 
     def _respond_degraded(self, req: ServeRequest, detail: str) -> None:
-        stale = self._last_known.get(req.graph_key)
-        if stale is not None:
-            self.metrics.degraded(req.tenant)
-            req.complete(ServeResponse(
-                status="degraded", request_id=req.request_id,
-                tenant=req.tenant, ranked=[dict(r) for r in stale],
-                detail=detail + " (serving last known ranking)",
-            ))
-        else:
-            self.metrics.errors(req.tenant)
-            req.complete(ServeResponse(
-                status="error", request_id=req.request_id,
-                tenant=req.tenant, detail=detail,
-            ))
-
-    def _store_note(self, req: ServeRequest, result) -> None:
-        """Optional investigation-store append for served requests — the
-        serve path's writes ride the store's fcntl locking, so concurrent
-        workers/submitters on one investigation cannot lose updates.  A
-        store failure must not fail the response (suppressed → bounded
-        fault log)."""
-        if self.store is None or req.investigation_id is None:
-            return
-        top = result.ranked[0]["component"] if result.ranked else None
-        with suppressed("serve.store_note"):
-            self.store.add_message(
-                req.investigation_id, "serve",
-                {
-                    "request_id": req.request_id,
-                    "tenant": req.tenant,
-                    "top_component": top,
-                    "engine": result.engine,
-                },
-            )
-            if self.recorder is not None:
-                # a recorded serve run stamps its investigations with the
-                # recording's path, so `rca replay --investigation <id>`
-                # can re-drive the analysis from the id alone
-                self.store.set_recording_ref(
-                    req.investigation_id, str(self.recorder.path)
-                )
+        self.sink.degraded(req, detail)

@@ -35,6 +35,12 @@ class ServeMetrics:
         # the resident base saved them from uploading
         self._graph_cache = {"hit": 0, "miss": 0, "eviction": 0}
         self._resident: Dict[str, Dict[str, int]] = {}
+        # serve-pool observability (ISSUE 8): per-replica dispatch
+        # counters + occupancy samples, work-steal accounting, and the
+        # last reported breaker/liveness state — `rca serve --selftest`
+        # prints these and bench's serve_pool section reads them
+        self._replicas: Dict[int, Dict[str, object]] = {}
+        self._replica_occ = PhaseStats()   # one phase per replica id
 
     def _tenant(self, tenant: str) -> Dict[str, int]:
         return self._counts.setdefault(
@@ -79,6 +85,42 @@ class ServeMetrics:
         with self._lock:
             self._graph_cache[event] += 1
 
+    # -- serve pool (ISSUE 8) ------------------------------------------------
+    def _replica(self, replica_id: int) -> Dict[str, object]:
+        return self._replicas.setdefault(int(replica_id), {
+            "batches": 0, "requests": 0,
+            "stolen_from": 0, "stolen_to": 0,
+            "state": "closed",
+        })
+
+    def replica_batch(self, replica_id: int, width: int) -> None:
+        """One device batch fetched OK on a replica."""
+        with self._lock:
+            rec = self._replica(replica_id)
+            rec["batches"] += 1
+            rec["requests"] += int(width)
+
+    def replica_occupancy(self, replica_id: int, occupancy: int) -> None:
+        """One occupancy sample: staged + in-flight requests the replica
+        held when sampled (taken per scheduling iteration that did
+        work)."""
+        with self._lock:
+            self._replica(replica_id)
+            self._replica_occ.record(f"r{int(replica_id)}", float(occupancy))
+
+    def stolen(self, from_replica: int, to_replica: int, n: int) -> None:
+        """``n`` staged requests moved off a dead/open replica onto a
+        survivor by the work-stealing rebalance."""
+        with self._lock:
+            self._replica(from_replica)["stolen_from"] += int(n)
+            self._replica(to_replica)["stolen_to"] += int(n)
+
+    def replica_state(self, replica_id: int, state: str) -> None:
+        """Latest breaker/liveness state (``closed``/``open``/
+        ``half-open``/``dead``) the replica reported."""
+        with self._lock:
+            self._replica(replica_id)["state"] = state
+
     def resident_reuse(self, tenant: str, rows_saved: int) -> None:
         """One request served via the resident delta path: ``rows_saved``
         feature rows came from the device-pinned base instead of the
@@ -112,7 +154,25 @@ class ServeMetrics:
                 }
             occ = list(self._occupancy)
             occ_sorted = sorted(occ)
+            replicas = {
+                str(rid): {
+                    **rec,
+                    "occupancy_p50": self._replica_occ.quantile(
+                        f"r{rid}", 0.50
+                    ),
+                    "occupancy_max": self._replica_occ.quantile(
+                        f"r{rid}", 1.0
+                    ),
+                }
+                for rid, rec in sorted(self._replicas.items())
+            }
             return {
+                **({
+                    "replicas": replicas,
+                    "steals_total": sum(
+                        r["stolen_from"] for r in self._replicas.values()
+                    ),
+                } if replicas else {}),
                 "tenants": per_tenant,
                 "batches": len(occ),
                 "dispatched_requests": self.dispatched_requests,

@@ -1,0 +1,551 @@
+"""Engine replicas for the serve pool (ISSUE 8 tentpole).
+
+A :class:`ReplicaWorker` is one slice of the serving plane: its own
+engine (dense on one device, or sharded over a device group carved from
+the mesh), its own :class:`rca_tpu.serve.dispatcher.BatchDispatcher`
+(prepared-graph cache + resident bases), its own
+:class:`rca_tpu.serve.batcher.ShapeBucketBatcher`, its own
+:class:`rca_tpu.resilience.policy.CircuitBreaker`, and its own worker
+thread.  The :class:`rca_tpu.serve.pool.ServePool` routes shape buckets
+from the ONE shared queue into replicas; everything the replica answers
+flows through the pool-wide :class:`CompletionSink`, which owns the
+exactly-once completion accounting and the degradation ladder's
+last-known rankings.
+
+Concurrency discipline (gravelock, ANALYSIS.md): worker threads are
+spawned via :func:`rca_tpu.util.threads.make_thread`; every mutable
+replica attribute the router or a stealing peer can touch is guarded by
+``ReplicaWorker._lock``, and the lock is NEVER held across a device
+dispatch or fetch — those run between critical sections, so stealing a
+dying replica's staged work never waits on its device round trip.  Lock
+order (one-way, no cycles): ``ServePool._route_lock`` →
+``ReplicaWorker._lock`` → ``BatchDispatcher._graphs_lock``;
+``CompletionSink._lock`` and ``ServeMetrics._lock`` are leaves.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Callable, List, Optional
+
+from rca_tpu.config import ServeConfig
+from rca_tpu.resilience.policy import (
+    CircuitBreaker,
+    record_fault,
+    suppressed,
+)
+from rca_tpu.serve.batcher import ShapeBucketBatcher
+from rca_tpu.serve.dispatcher import BatchDispatcher, BatchHandle
+from rca_tpu.serve.request import GraphKey, ServeRequest, ServeResponse
+from rca_tpu.util.threads import make_lock, make_thread
+
+#: last-known rankings kept pool-wide for degraded responses
+LAST_KNOWN_CAP = 128
+#: staging window: how far one replica reads ahead of its device
+STAGE_AHEAD_BATCHES = 4
+
+
+class ReplicaKilled(RuntimeError):
+    """Raised inside a replica's scheduling iteration after
+    :meth:`ReplicaWorker.kill` — the chaos/test seam for replica death
+    (the worker's crash handler turns it into the same rebalance a real
+    scheduling-loop exception triggers)."""
+
+
+class CompletionSink:
+    """The ONE place serve responses are delivered (pool-wide).
+
+    Shared by every replica (and by the single-replica
+    :class:`rca_tpu.serve.loop.ServeLoop`), it owns:
+
+    - **exactly-once accounting**: ``ServeRequest.complete`` is
+      first-writer-wins; a second completion attempt (a steal racing the
+      original owner) is counted in ``double_completions`` — the
+      replica-kill tests assert it stays ZERO, which is the proof the
+      steal protocol never re-serves an already-answered request;
+    - **the degradation ladder's memory**: last-known rankings per graph
+      key, shared pool-wide so ANY replica can serve a stale answer for
+      a graph some other replica computed;
+    - the optional investigation-store note and flight-recorder frame
+      for ok responses (serialized under the sink lock — with N workers
+      the recorder is no longer single-writer).
+    """
+
+    def __init__(self, metrics, clock: Callable[[], float],
+                 store=None, recorder=None):
+        self.metrics = metrics
+        self.clock = clock
+        self.store = store
+        self.recorder = recorder
+        self._lock = make_lock("CompletionSink._lock")
+        self._last_known: "collections.OrderedDict[GraphKey, List[dict]]" = (
+            collections.OrderedDict()
+        )
+        self.double_completions = 0
+
+    # -- exactly-once core ---------------------------------------------------
+    def _complete(self, req: ServeRequest, resp: ServeResponse) -> bool:
+        if req.complete(resp):
+            return True
+        with self._lock:
+            self.double_completions += 1
+        return False
+
+    # -- last-known ladder ---------------------------------------------------
+    def remember(self, key: GraphKey, ranked: List[dict]) -> None:
+        with self._lock:
+            self._last_known[key] = ranked
+            self._last_known.move_to_end(key)
+            while len(self._last_known) > LAST_KNOWN_CAP:
+                self._last_known.popitem(last=False)
+
+    def last_known(self, key: GraphKey) -> Optional[List[dict]]:
+        with self._lock:
+            return self._last_known.get(key)
+
+    # -- response paths ------------------------------------------------------
+    def ok(self, req: ServeRequest, result, width: int,
+           dispatched_at: float) -> None:
+        ranked = [dict(r) for r in result.ranked]
+        self.remember(req.graph_key, ranked)
+        if self.recorder is not None:
+            # a recording failure must not fail the response; the sink
+            # lock serializes frames now that N workers write through it
+            with suppressed("serve.record"):
+                with self._lock:
+                    self.recorder.record_serve(req, ranked)
+        queue_ms = max(0.0, (dispatched_at - req.enqueued_at) * 1e3)
+        self.metrics.answered(req.tenant, queue_ms)
+        self._store_note(req, result)
+        self._complete(req, ServeResponse(
+            status="ok", request_id=req.request_id, tenant=req.tenant,
+            ranked=ranked, queue_ms=round(queue_ms, 3), batch_size=width,
+            deadline_missed=req.expired(self.clock()),
+            result=result,
+        ))
+
+    def shed(self, req: ServeRequest, detail: str) -> None:
+        self.metrics.shed(req.tenant)
+        self._complete(req, ServeResponse(
+            status="shed", request_id=req.request_id, tenant=req.tenant,
+            detail=detail,
+        ))
+
+    def degraded(self, req: ServeRequest, detail: str) -> None:
+        """Last-known ranking when one exists, ``error`` otherwise — the
+        ladder's bottom rungs."""
+        stale = self.last_known(req.graph_key)
+        if stale is not None:
+            self.metrics.degraded(req.tenant)
+            self._complete(req, ServeResponse(
+                status="degraded", request_id=req.request_id,
+                tenant=req.tenant, ranked=[dict(r) for r in stale],
+                detail=detail + " (serving last known ranking)",
+            ))
+        else:
+            self.error(req, detail)
+
+    def error(self, req: ServeRequest, detail: str) -> None:
+        self.metrics.errors(req.tenant)
+        self._complete(req, ServeResponse(
+            status="error", request_id=req.request_id, tenant=req.tenant,
+            detail=detail,
+        ))
+
+    def _store_note(self, req: ServeRequest, result) -> None:
+        """Optional investigation-store append for served requests — the
+        store's fcntl locking makes this safe from any worker thread; a
+        store failure never fails the response."""
+        if self.store is None or req.investigation_id is None:
+            return
+        top = result.ranked[0]["component"] if result.ranked else None
+        with suppressed("serve.store_note"):
+            self.store.add_message(
+                req.investigation_id, "serve",
+                {
+                    "request_id": req.request_id,
+                    "tenant": req.tenant,
+                    "top_component": top,
+                    "engine": result.engine,
+                },
+            )
+            if self.recorder is not None:
+                self.store.set_recording_ref(
+                    req.investigation_id, str(self.recorder.path)
+                )
+
+
+class ReplicaWorker:
+    """One engine replica behind the pool's shared queue.
+
+    Life cycle: the pool routes requests in via :meth:`offer`; the
+    worker thread (or the pool's fake-clock ``run_once`` driver) forms
+    shape-bucket batches, dispatches them breaker-guarded, and fetches
+    one batch behind (the PR-2/3 dispatch/fetch split, per replica).  A
+    dead or breaker-open replica's staged work is taken back via
+    :meth:`take_staged`/:meth:`take_inflight` by the pool's
+    work-stealing rebalance.
+    """
+
+    def __init__(
+        self,
+        replica_id: int,
+        engine=None,
+        kind: str = "dense",
+        device=None,
+        config: Optional[ServeConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sink: Optional[CompletionSink] = None,
+        metrics=None,
+        fault_hook: Optional[Callable[[str], None]] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        dispatcher: Optional[BatchDispatcher] = None,
+        pool=None,
+    ):
+        self.replica_id = int(replica_id)
+        self.kind = kind
+        #: the device this replica commits its dispatches to (dense
+        #: replicas; sharded ones place through their engine's mesh)
+        self.device = device
+        self.config = config or ServeConfig.from_env()
+        self.clock = clock
+        self.sink = sink
+        self.metrics = metrics
+        self.pool = pool
+        self.batcher = ShapeBucketBatcher(
+            self.config.max_batch, self.config.max_wait_us, clock=clock
+        )
+        self.dispatcher = dispatcher or BatchDispatcher(
+            engine, fault_hook=fault_hook, metrics=metrics,
+        )
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=3, reset_after=1.0, clock=clock,
+            name=f"serve.replica{self.replica_id}",
+        )
+        self._lock = make_lock("ReplicaWorker._lock")
+        self._inflight: Optional[BatchHandle] = None
+        self._alive = True
+        self._killed = False
+        self._stop = False
+        self._thread = None
+        self._device_batches = 0
+        self._last_state = ""
+
+    # -- state the router reads ----------------------------------------------
+    def alive(self) -> bool:
+        with self._lock:
+            return self._alive and not self._killed
+
+    def routable(self) -> bool:
+        """May the router assign NEW work here?  Alive and breaker not
+        open (half-open replicas take work — that traffic is the probe
+        that closes the breaker)."""
+        return self.alive() and self.breaker.state != "open"
+
+    def occupancy(self) -> int:
+        """Requests this replica currently holds (staged + in flight)."""
+        with self._lock:
+            inflight = (
+                len(self._inflight.requests)
+                if self._inflight is not None else 0
+            )
+            return self.batcher.staged() + inflight
+
+    def has_room(self) -> bool:
+        with self._lock:
+            staged = self.batcher.staged()
+        return staged < self.config.max_batch * STAGE_AHEAD_BATCHES
+
+    def has_graph(self, key: GraphKey) -> bool:
+        return self.dispatcher.has_graph(key)
+
+    @property
+    def device_batches(self) -> int:
+        with self._lock:
+            return self._device_batches
+
+    # -- routing surface -----------------------------------------------------
+    def offer(self, req: ServeRequest) -> bool:
+        """Stage one routed request; False when this replica died between
+        the router's liveness check and the offer (the router then
+        re-places the request)."""
+        with self._lock:
+            if not self._alive or self._killed or self._stop:
+                return False
+            self.batcher.offer(req)
+            return True
+
+    # -- steal surface (pool rebalance; see pool.rebalance_from) -------------
+    def take_staged(self) -> List[ServeRequest]:
+        """Drain EVERYTHING staged here (steal path).  Idempotent: a
+        second taker gets an empty list."""
+        out: List[ServeRequest] = []
+        with self._lock:
+            while self.batcher.staged():
+                batch = self.batcher.take_ready(drain=True)
+                if not batch:
+                    break
+                out.extend(batch)
+        return out
+
+    def take_inflight(self) -> Optional[BatchHandle]:
+        """Atomically claim the in-flight batch (steal path) — the taker
+        owns its fetch; a second taker gets None, which is what makes
+        double-completion impossible by construction."""
+        with self._lock:
+            handle, self._inflight = self._inflight, None
+            return handle
+
+    def kill(self) -> None:
+        """Chaos/test seam: the next scheduling iteration raises
+        :class:`ReplicaKilled`, driving the same crash-and-rebalance path
+        a real worker death takes."""
+        with self._lock:
+            self._killed = True
+
+    def mark_dead(self, exc: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self._alive = False
+        if exc is not None and not isinstance(exc, ReplicaKilled):
+            record_fault(f"serve.replica{self.replica_id}", exc)
+        self._note_state("dead")
+
+    def _note_state(self, state: Optional[str] = None) -> None:
+        if self.metrics is None:
+            return
+        if state is None:
+            state = self.breaker.state if self.alive() else "dead"
+        with self._lock:
+            changed = state != self._last_state
+            self._last_state = state
+        if changed:
+            self.metrics.replica_state(self.replica_id, state)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ReplicaWorker":
+        if self._thread is None or not self._thread.is_alive():
+            with self._lock:
+                self._stop = False
+            self._thread = make_thread(
+                self._run, name=f"rca-serve-replica{self.replica_id}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def request_stop(self) -> None:
+        with self._lock:
+            self._stop = True
+
+    def join(self, timeout: float = 10.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    # -- scheduling ----------------------------------------------------------
+    def run_once(self, now: Optional[float] = None) -> bool:
+        """One replica scheduling iteration: shed → form batch →
+        breaker-guarded dispatch → fetch the PREVIOUS batch (its device
+        round trip overlapped this iteration's host work).  Raises
+        :class:`ReplicaKilled` after :meth:`kill` — callers (the worker
+        thread's crash handler, the pool's fake-clock driver) turn that
+        into death + rebalance."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            if self._killed:
+                raise ReplicaKilled(
+                    f"replica {self.replica_id} killed"
+                )
+            expired = self.batcher.shed_expired(now)
+        worked = False
+        for req in expired:
+            self.sink.shed(req, detail="expired_in_batcher")
+            worked = True
+        # open breaker: complete what is already in flight (the dispatch
+        # happened — fetch either serves it or degrades it; submitters
+        # must not park until the half-open probe), then hand staged
+        # work back to the pool (work-stealing rebalance); with no pool
+        # (or stealing off) the ladder answers degraded instead
+        if self.breaker.state == "open":
+            self._note_state()
+            prev = self.take_inflight()
+            if prev is not None:
+                self._fetch_guarded(prev)
+                worked = True
+            if self.pool is not None:
+                worked |= self.pool.rebalance_from(
+                    self, reason="breaker_open"
+                ) > 0
+            return worked
+        with self._lock:
+            drain = (
+                self._inflight is None
+                and (self.pool is None or len(self.pool.queue) == 0)
+            )
+            batch = self.batcher.take_ready(now, drain=drain)
+        handle = None
+        if batch:
+            worked = True
+            live: List[ServeRequest] = []
+            for req in batch:
+                # last call: an expired request must not ride a device
+                # slot even when its batch is already formed
+                if req.expired(now):
+                    self.sink.shed(req, detail="expired_at_dispatch")
+                else:
+                    live.append(req)
+            if live:
+                handle = self._dispatch_guarded(live)
+        prev = self.take_inflight()
+        if prev is not None:
+            # fetch the PREVIOUS batch only after this iteration's
+            # dispatch is in flight
+            self._fetch_guarded(prev)
+            worked = True
+        if handle is not None:
+            with self._lock:
+                self._inflight = handle
+        if worked and self.metrics is not None:
+            self.metrics.replica_occupancy(
+                self.replica_id, self.occupancy()
+            )
+        self._note_state()
+        return worked
+
+    def drain_inflight(self) -> None:
+        """Fetch whatever is still in flight (clean-shutdown path — the
+        results exist; submitters must not park forever)."""
+        prev = self.take_inflight()
+        if prev is not None:
+            self._fetch_guarded(prev)
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    if self._stop:
+                        break
+                worked = False
+                if self.pool is not None:
+                    worked |= self.pool.route_once()
+                worked |= self.run_once()
+                if not worked and self.pool is not None:
+                    with self._lock:
+                        timeout = self.batcher.next_ready_in()
+                    self.pool.park(timeout)
+        except Exception as exc:  # noqa: BLE001 - crash = replica death
+            self.mark_dead(exc)
+            if self.pool is not None:
+                self.pool.rebalance_from(self, reason="replica_death")
+            return
+        self.drain_inflight()
+
+    # -- guarded device path -------------------------------------------------
+    def _device_ctx(self):
+        """Dense replicas commit their dispatches to their carved device;
+        sharded replicas place through the engine's mesh."""
+        if self.device is None:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(self.device)
+
+    def _dispatch_guarded(
+        self, batch: List[ServeRequest]
+    ) -> Optional[BatchHandle]:
+        if not self.breaker.allow():
+            # raced from half-open to open (or another probe is out):
+            # give the batch back to the pool rather than burning it
+            if self.pool is not None:
+                self.pool.redistribute(
+                    batch, exclude=self, reason="breaker_open"
+                )
+            else:
+                for req in batch:
+                    self.sink.degraded(req, detail="circuit_open")
+            return None
+        try:
+            with self._device_ctx():
+                handle = self.dispatcher.dispatch(batch, now=self.clock())
+        except Exception as exc:
+            record_fault(f"serve.replica{self.replica_id}.dispatch", exc)
+            self.breaker.record_failure()
+            for req in batch:
+                self.sink.degraded(
+                    req, detail=f"dispatch_failed:{type(exc).__name__}"
+                )
+            return None
+        with self._lock:
+            self._device_batches += 1
+        return handle
+
+    def _fetch_guarded(self, handle: BatchHandle) -> None:
+        try:
+            with self._device_ctx():
+                results = self.dispatcher.fetch(handle)
+        except Exception as exc:
+            record_fault(f"serve.replica{self.replica_id}.fetch", exc)
+            self.breaker.record_failure()
+            for req in handle.requests:
+                self.sink.degraded(
+                    req, detail=f"fetch_failed:{type(exc).__name__}"
+                )
+            return
+        self.breaker.record_success()
+        width = len(handle.requests)
+        if self.metrics is not None:
+            self.metrics.record_batch(width)
+            self.metrics.replica_batch(self.replica_id, width)
+        for req, result in zip(handle.requests, results):
+            self.sink.ok(req, result, width, handle.dispatched_at)
+
+
+def build_replica_engines(
+    specs,
+    devices=None,
+    config=None,
+    params=None,
+):
+    """``(kind, group_size|None)`` specs (from
+    :func:`rca_tpu.config.parse_replica_mix`) → ``(kind, engine,
+    device|None)`` triples, with device groups carved contiguously from
+    the visible devices (:func:`rca_tpu.parallel.mesh.
+    carve_device_groups`) and sharded sub-meshes built over the axes the
+    partition-rule table names (:data:`rca_tpu.parallel.rules.
+    GRAPH_RULES`) — replica construction, graph-tensor sharding, and
+    device-group assignment all read the one rule table."""
+    import jax
+
+    from rca_tpu.parallel.mesh import carve_device_groups, make_mesh
+    from rca_tpu.parallel.rules import GRAPH_RULES
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = max(1, len(specs))
+    sizes = [
+        group if group is not None
+        else (1 if kind == "dense" else max(1, len(devices) // n))
+        for kind, group in specs
+    ]
+    groups = carve_device_groups(sizes, devices)
+    batch_axis, shard_axis = GRAPH_RULES.mesh_axes()
+    out = []
+    for (kind, _), group in zip(specs, groups):
+        if kind == "sharded":
+            from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+            mesh = make_mesh(
+                [(batch_axis, 1), (shard_axis, len(group))], group
+            )
+            out.append((kind, ShardedGraphEngine(
+                mesh=mesh, config=config, params=params,
+            ), None))
+        else:
+            from rca_tpu.engine.runner import GraphEngine
+
+            out.append((
+                kind, GraphEngine(config=config, params=params), group[0],
+            ))
+    return out

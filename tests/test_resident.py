@@ -268,3 +268,81 @@ def test_depth2_record_replay_parity_with_resident_sessions(
     assert summary["uncaught_exceptions"] == 0
     assert summary["replay"]["parity_ok"], summary["replay"]
     assert summary["replay"]["ticks_replayed"] == 30
+
+
+# -- sharded one-shot resident session (ISSUE 8 satellite) -------------------
+
+def _sharded_engines(sp=4):
+    from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+    return (
+        ShardedGraphEngine(spec=f"sp={sp}", resident=True),
+        ShardedGraphEngine(spec=f"sp={sp}", resident=False),
+    )
+
+
+def test_sharded_resident_delta_parity_property():
+    """PR 6's named leftover, closed: the sharded one-shot path gets the
+    same ResidentSession-backed delta treatment — and the same bit-parity
+    property gate over update/delete/NaN sequences."""
+    case = _case(120, seed=7)
+    n, C = case.features.shape
+    resident, fresh = _sharded_engines()
+    rng = np.random.default_rng(13)
+    feats = case.features.copy()
+    for step in range(10):
+        kind = step % 4
+        if kind == 0:      # sparse update
+            rows = rng.integers(0, n, rng.integers(1, 6))
+            feats[rows] = np.clip(
+                feats[rows] + rng.uniform(-0.3, 0.3, (len(rows), C)),
+                0, 1,
+            ).astype(np.float32)
+        elif kind == 1:    # delete: services going silent
+            feats[rng.integers(0, n, 2)] = 0.0
+        elif kind == 2:    # poisoned telemetry
+            feats[int(rng.integers(0, n))] = np.nan
+        else:              # heal + dense churn (delta stops paying)
+            feats = np.nan_to_num(feats)
+            feats = np.clip(
+                feats + rng.uniform(-0.02, 0.02, feats.shape), 0, 1
+            ).astype(np.float32)
+        a = resident.analyze_arrays(
+            feats, case.dep_src, case.dep_dst, case.names, k=5
+        )
+        b = fresh.analyze_arrays(
+            feats, case.dep_src, case.dep_dst, case.names, k=5
+        )
+        _assert_bitwise(a, b, ctx=f"sharded step {step} kind {kind}")
+    stats = resident._resident_cache.stats()
+    assert stats["sessions"] == 1
+    assert stats["delta_requests"] >= 4, stats
+
+
+def test_sharded_resident_upload_is_o_changed_rows():
+    case = _case(200, seed=5)
+    resident, _ = _sharded_engines()
+    resident.analyze_case(case, k=5)
+    sess = next(iter(resident._resident_cache._sessions.values()))
+    assert sess.last_upload_rows == sess._n_pad  # first staging is bulk
+    f2 = np.clip(case.features.copy(), 0, 1)
+    f2[17] = np.clip(f2[17] + 0.25, 0, 1)
+    resident.analyze_arrays(f2, case.dep_src, case.dep_dst, case.names, k=5)
+    assert sess.last_upload_rows == 1            # one dirty row
+    resident.analyze_arrays(f2, case.dep_src, case.dep_dst, case.names, k=5)
+    assert sess.last_upload_rows == 0            # identical repeat
+    assert sess.delta_requests == 2
+
+
+def test_sharded_resident_matches_dense_rankings():
+    """Cross-engine sanity: the sharded resident path ranks like the
+    dense engine (allclose contract, as for the restaged sharded path)."""
+    case = _case(96, seed=2)
+    sharded, _ = _sharded_engines()
+    dense = GraphEngine(resident=False)
+    a = sharded.analyze_case(case, k=5)
+    b = dense.analyze_case(case, k=5)
+    assert [r["component"] for r in a.ranked] == [
+        r["component"] for r in b.ranked
+    ]
+    np.testing.assert_allclose(a.score, b.score, atol=2e-5)

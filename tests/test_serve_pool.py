@@ -1,0 +1,525 @@
+"""ISSUE 8: the multi-replica, multi-device serving plane.
+
+Covers the serve-pool contracts:
+
+- config: ``RCA_SERVE_REPLICAS`` / ``RCA_SERVE_STEAL`` /
+  ``RCA_SERVE_REPLICA_MIX`` validation round trips, replica-mix parsing,
+  device-group carving;
+- partition rules: the declarative table resolves every staged graph
+  tensor to the spec the hand-built code used, and unmatched names fail
+  loudly;
+- routing policy (fake clock, stub devices): home stickiness, resident
+  (prepared-graph) stickiness, least-occupied placement for cold
+  buckets;
+- failover: replica kill recovers with every request answered-or-shed
+  and ZERO double completions (staged work stolen, the orphaned
+  in-flight batch claimed-and-fetched exactly once); an open breaker
+  hands staged work to survivors; stealing disabled rides the
+  degradation ladder instead of hanging;
+- pool-vs-solo coalesced bit parity on the real engine, including the
+  pooled selftest and its kill-replica chaos mode;
+- an 8-thread pool stress under ``RCA_RSAN=1`` so gravelock's runtime
+  cross-check covers the new thread/lock family (route lock, replica
+  locks, completion sink).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.generator import synthetic_cascade_arrays
+from rca_tpu.config import ServeConfig, parse_replica_mix
+from rca_tpu.engine import GraphEngine
+from rca_tpu.serve import ServeClient, ServePool, ServeRequest, serve_selftest
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _req(tenant="t", n=8, k=3, seed=0, **kw) -> ServeRequest:
+    rng = np.random.default_rng(seed)
+    feats = rng.uniform(0, 1, (n, 4)).astype(np.float32)
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    return ServeRequest(
+        tenant=tenant, features=feats, dep_src=src, dep_dst=dst, k=k, **kw
+    )
+
+
+class StubHandle:
+    def __init__(self, requests, dispatched_at):
+        self.requests = requests
+        self.dispatched_at = dispatched_at
+
+
+class StubResult:
+    def __init__(self, tag):
+        self.ranked = [{"component": f"svc-{tag}", "score": 1.0}]
+        self.engine = "stub"
+        self.score = np.ones(1, np.float32)
+
+
+class StubDispatcher:
+    """Device-free dispatcher with scriptable failures + a scriptable
+    prepared-graph cache (resident stickiness)."""
+
+    engine = None
+    engine_tag = "stub"
+
+    def __init__(self):
+        self.dispatched = []   # batch widths in dispatch order
+        self.fail_next = []    # ops to fail, consumed front-first
+        self.graphs = set()    # keys has_graph answers True for
+
+    def has_graph(self, key):
+        return key in self.graphs
+
+    def dispatch(self, batch, now=None):
+        if self.fail_next and self.fail_next[0] == "dispatch":
+            self.fail_next.pop(0)
+            raise RuntimeError("injected dispatch failure")
+        self.dispatched.append(len(batch))
+        self.graphs.add(batch[0].graph_key)
+        return StubHandle(list(batch), now if now is not None else 0.0)
+
+    def fetch(self, handle):
+        if self.fail_next and self.fail_next[0] == "fetch":
+            self.fail_next.pop(0)
+            raise RuntimeError("injected fetch failure")
+        return [StubResult(i) for i, _ in enumerate(handle.requests)]
+
+
+def _policy_pool(n=2, clock=None, **cfg_kw):
+    """Single-threaded pool over stub dispatchers (never start()ed)."""
+    clock = clock or FakeClock()
+    cfg_kw.setdefault("max_wait_us", 0)
+    stubs = [StubDispatcher() for _ in range(n)]
+    pool = ServePool(
+        dispatchers=stubs,
+        config=ServeConfig(replicas=n, **cfg_kw),
+        clock=clock,
+    )
+    return pool, stubs, clock
+
+
+def _drain(pool, iters=10):
+    for _ in range(iters):
+        pool.run_once()
+
+
+# -- config (satellite: new RCA_SERVE_* knobs) --------------------------------
+
+def test_pool_config_env_round_trip(monkeypatch):
+    monkeypatch.setenv("RCA_SERVE_REPLICAS", "4")
+    monkeypatch.setenv("RCA_SERVE_STEAL", "0")
+    monkeypatch.setenv("RCA_SERVE_REPLICA_MIX", "dense:2,sharded@4:2")
+    cfg = ServeConfig.from_env()
+    assert cfg.replicas == 4
+    assert cfg.steal is False
+    assert cfg.replica_specs() == (
+        ("dense", None), ("dense", None),
+        ("sharded", 4), ("sharded", 4),
+    )
+
+
+def test_pool_config_defaults(monkeypatch):
+    for name in ("RCA_SERVE_REPLICAS", "RCA_SERVE_STEAL",
+                 "RCA_SERVE_REPLICA_MIX"):
+        monkeypatch.delenv(name, raising=False)
+    cfg = ServeConfig.from_env()
+    assert cfg.replicas == 1 and cfg.steal is True
+    assert cfg.replica_specs() == (("dense", None),)
+
+
+@pytest.mark.parametrize("name,bad", [
+    ("RCA_SERVE_REPLICAS", "0"),
+    ("RCA_SERVE_REPLICAS", "65"),
+    ("RCA_SERVE_REPLICAS", "abc"),
+    ("RCA_SERVE_STEAL", "maybe"),
+    ("RCA_SERVE_REPLICA_MIX", "gpu:2"),
+    ("RCA_SERVE_REPLICA_MIX", "dense:0"),
+    ("RCA_SERVE_REPLICA_MIX", "sharded@0:1"),
+])
+def test_pool_config_rejects_bad_env(monkeypatch, name, bad):
+    monkeypatch.setenv(name, bad)
+    with pytest.raises(ValueError):
+        ServeConfig.from_env()
+
+
+def test_parse_replica_mix_shapes():
+    assert parse_replica_mix("", 3) == (
+        ("dense", None), ("dense", None), ("dense", None),
+    )
+    assert parse_replica_mix("sharded@2") == (("sharded", 2),)
+    assert parse_replica_mix("dense:2, sharded@4:1") == (
+        ("dense", None), ("dense", None), ("sharded", 4),
+    )
+    with pytest.raises(ValueError, match="kind"):
+        parse_replica_mix("quantum:2")
+
+
+def test_carve_device_groups_wraps_when_oversubscribed():
+    from rca_tpu.parallel.mesh import carve_device_groups
+
+    devices = ["d0", "d1", "d2"]
+    groups = carve_device_groups([1, 2, 2], devices)
+    assert groups == [["d0"], ["d1", "d2"], ["d0", "d1"]]
+    with pytest.raises(ValueError):
+        carve_device_groups([1], [])
+
+
+# -- partition rules (tentpole: one declarative table) ------------------------
+
+def test_partition_rules_match_hand_built_layout():
+    from jax.sharding import PartitionSpec as P
+
+    from rca_tpu.parallel.rules import GRAPH_RULES, match_partition_rules
+
+    specs = match_partition_rules(
+        GRAPH_RULES,
+        ("features_batch", "src_local", "dn_flags", "up_ends",
+         "n_live", "aw", "stack", "scores", "topk_vals"),
+    )
+    assert specs["features_batch"] == P("dp", "sp", None)
+    assert specs["src_local"] == P("sp", None)
+    assert specs["dn_flags"] == P("sp", None)
+    assert specs["up_ends"] == P("sp", None)
+    assert specs["n_live"] == P()
+    assert specs["aw"] == P()
+    assert specs["stack"] == P("dp", None, "sp")
+    assert specs["scores"] == P("dp", "sp")
+    assert specs["topk_vals"] == P("dp", None)
+
+
+def test_partition_rules_batch_axes_substitution():
+    from jax.sharding import PartitionSpec as P
+
+    from rca_tpu.parallel.rules import GRAPH_RULES
+
+    assert GRAPH_RULES.spec_for(
+        "features_batch", batch_axes=("slice", "dp")
+    ) == P(("slice", "dp"), "sp", None)
+    assert GRAPH_RULES.mesh_axes() == ("dp", "sp")
+
+
+def test_partition_rules_unmatched_name_fails_loudly():
+    from rca_tpu.parallel.rules import GRAPH_RULES
+
+    with pytest.raises(ValueError, match="no partition rule"):
+        GRAPH_RULES.spec_for("mystery_tensor")
+
+
+# -- routing policy (fake clock, stub devices) --------------------------------
+
+def test_routing_cold_bucket_goes_least_occupied():
+    pool, stubs, _ = _policy_pool(n=2)
+    # preload replica 0 with a different bucket so it is busier
+    for i in range(4):
+        pool.submit(_req("a", n=8, seed=i))
+    pool.route_once()
+    assert pool.replicas[0].occupancy() == 4
+    pool.submit(_req("b", n=16, seed=9))   # cold bucket
+    pool.route_once()
+    assert pool.replicas[1].occupancy() == 1
+
+
+def test_routing_sticky_home_keeps_bucket_on_replica():
+    pool, stubs, _ = _policy_pool(n=2)
+    pool.submit(_req("a", n=8, seed=0))
+    pool.route_once()
+    _drain(pool)
+    # the bucket now lives on replica 0 (home + prepared graph); later
+    # requests follow it even though replica 1 is emptier
+    for i in range(3):
+        pool.submit(_req("a", n=8, seed=10 + i))
+    pool.route_once()
+    assert pool.replicas[0].occupancy() == 3
+    assert pool.replicas[1].occupancy() == 0
+
+
+def test_routing_resident_stickiness_beats_occupancy():
+    pool, stubs, _ = _policy_pool(n=2)
+    probe = _req("a", n=8, seed=0)
+    # replica 1 already holds this graph's prepared state (resident
+    # base), e.g. from before its bucket went cold and lost its home
+    stubs[1].graphs.add(probe.graph_key)
+    pool.submit(probe)
+    pool.route_once()
+    assert pool.replicas[1].occupancy() == 1
+
+
+# -- failover -----------------------------------------------------------------
+
+def test_replica_kill_recovers_staged_and_inflight():
+    """The satellite's core gate: kill a replica holding BOTH staged and
+    in-flight work — every request answered-or-shed, zero double
+    completions, steals counted."""
+    pool, stubs, _ = _policy_pool(n=2, max_batch=4)
+    reqs = [_req("a", n=8, seed=i) for i in range(10)]
+    reqs += [_req("b", n=16, seed=i) for i in range(4)]
+    for r in reqs:
+        pool.submit(r)
+    pool.route_once()
+    # replica 0 dispatches one 4-wide batch (in flight) and keeps the
+    # rest of its bucket staged; then it dies
+    pool.replicas[0].run_once()
+    assert pool.replicas[0]._inflight is not None
+    assert pool.replicas[0].batcher.staged() >= 1
+    pool.replicas[0].kill()
+    _drain(pool)
+    resps = [r.result(timeout=0) for r in reqs]
+    assert all(resp.status == "ok" for resp in resps)
+    assert pool.sink.double_completions == 0
+    m = pool.metrics.summary()
+    assert m["replicas"]["0"]["state"] == "dead"
+    # replica 1 served its own staged work AND the stolen bucket
+    assert m["steals_total"] >= 1
+    assert stubs[1].dispatched
+
+
+def test_replica_kill_before_dispatch_steals_everything():
+    pool, stubs, _ = _policy_pool(n=2)
+    reqs = [_req("a", n=8, seed=i) for i in range(5)]
+    for r in reqs:
+        pool.submit(r)
+    pool.route_once()
+    victim = next(r for r in pool.replicas if r.occupancy())
+    victim.kill()
+    _drain(pool)
+    assert all(r.result(timeout=0).status == "ok" for r in reqs)
+    assert pool.sink.double_completions == 0
+    assert pool.metrics.summary()["steals_total"] == 5
+
+
+def test_breaker_open_hands_staged_work_to_survivors():
+    pool, stubs, clock = _policy_pool(n=2)
+    # three consecutive dispatch failures open replica 0's breaker
+    stubs[0].fail_next = ["dispatch", "dispatch", "dispatch"]
+    burned = []
+    for i in range(3):
+        r = _req("a", n=8, seed=i)
+        burned.append(r)
+        pool.submit(r)
+        _drain(pool, iters=2)
+    assert pool.replicas[0].breaker.state == "open"
+    # those requests rode the ladder (no last-known yet -> error)
+    assert {r.result(timeout=0).status for r in burned} == {"error"}
+    # new same-bucket traffic must NOT pile onto the open replica
+    later = [_req("a", n=8, seed=10 + i) for i in range(4)]
+    for r in later:
+        pool.submit(r)
+    _drain(pool)
+    assert all(r.result(timeout=0).status == "ok" for r in later)
+    assert stubs[1].dispatched  # the survivor served them
+
+
+def test_no_steal_rides_degradation_ladder():
+    pool, stubs, _ = _policy_pool(n=2, steal=False)
+    # seed last-known for bucket "a" via a served request
+    first = _req("a", n=8, seed=0)
+    pool.submit(first)
+    _drain(pool)
+    assert first.result(timeout=0).status == "ok"
+    # stage more work on the home replica, then kill it
+    home = pool.replicas[pool._home[first.graph_key]]
+    stale = [_req("a", n=8, seed=10 + i) for i in range(3)]
+    for r in stale:
+        pool.submit(r)
+    pool.route_once()
+    assert home.occupancy() == 3
+    home.kill()
+    _drain(pool)
+    # stealing off: the victim's staged work degrades (last-known) —
+    # answered, never hung, never re-dispatched
+    assert {r.result(timeout=0).status for r in stale} == {"degraded"}
+    assert pool.metrics.summary()["steals_total"] == 0
+    assert pool.sink.double_completions == 0
+
+
+def test_all_replicas_down_degrades_instead_of_hanging():
+    pool, stubs, _ = _policy_pool(n=2)
+    for r in pool.replicas:
+        r.kill()
+    req = _req("a", n=8, seed=0)
+    pool.submit(req)
+    _drain(pool)
+    assert req.result(timeout=0).status == "error"  # no last-known yet
+
+
+def test_pool_shutdown_resolves_everything():
+    pool, stubs, _ = _policy_pool(n=2, max_wait_us=10_000_000,
+                                  max_batch=64)
+    reqs = [_req("a", seed=i) for i in range(4)]
+    for r in reqs:
+        pool.submit(r)
+    pool.start()
+    pool.stop()
+    assert all(r.done() for r in reqs)  # nobody left parked forever
+
+
+def test_pool_expired_requests_shed_at_every_stage():
+    clock = FakeClock()
+    pool, stubs, clock = _policy_pool(n=2, clock=clock)
+    dead = _req("a", deadline_s=5.0)
+    live = _req("a", seed=9, deadline_s=100.0)
+    pool.submit(dead)
+    pool.submit(live)
+    clock.advance(10.0)
+    _drain(pool)
+    assert dead.result(timeout=0).status == "shed"
+    assert live.result(timeout=0).status == "ok"
+    assert sum(sum(s.dispatched) for s in stubs) == 1
+
+
+# -- real engine: pool-vs-solo bit parity ------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    return GraphEngine()
+
+
+def test_pool_parity_vs_solo(engine):
+    """A request served by ANY replica of the pool is bit-identical to
+    the same request analyzed solo (the satellite's parity gate)."""
+    case = synthetic_cascade_arrays(60, n_roots=1, seed=3)
+    rng = np.random.default_rng(0)
+    pool = ServePool(
+        engines=[engine, GraphEngine()],
+        config=ServeConfig(replicas=2),
+    )
+    feats = [
+        np.clip(case.features + rng.uniform(
+            0, 0.05, case.features.shape
+        ).astype(np.float32), 0, 1)
+        for _ in range(12)
+    ]
+    with pool:
+        client = ServeClient(pool)
+        reqs = [
+            client.submit(
+                f, case.dep_src, case.dep_dst, names=case.names,
+                tenant=f"t{i % 3}", k=3,
+            )
+            for i, f in enumerate(feats)
+        ]
+        resps = [r.result(120.0) for r in reqs]
+    assert all(r.status == "ok" for r in resps)
+    for f, resp in zip(feats, resps):
+        solo = engine.analyze_arrays(
+            f, case.dep_src, case.dep_dst, case.names, k=3,
+        )
+        assert resp.ranked == solo.ranked
+        assert np.array_equal(resp.result.score, solo.score)
+    assert pool.sink.double_completions == 0
+
+
+def test_pool_selftest_contract(engine):
+    """The pooled selftest behind ``rca serve --selftest --replicas N``:
+    contract + parity + per-replica metric rows."""
+    out = serve_selftest(n_requests=24, seed=0, engine=engine, replicas=2)
+    assert out["ok"], out
+    assert out["all_resolved"] and out["parity_ok"]
+    assert out["replicas"] == 2
+    assert out["double_completions"] == 0
+    assert set(out["metrics"]["replicas"]) == {"0", "1"}
+    assert set(out["breaker_state"]) == {"0", "1"}
+
+
+def test_pool_selftest_kill_replica(engine):
+    """Kill-replica chaos through the full threaded stack: recovery
+    drops nothing and completion stays exactly-once."""
+    out = serve_selftest(
+        n_requests=24, seed=1, engine=engine, replicas=2,
+        kill_replica=True,
+    )
+    assert out["ok"], out
+    assert out["all_resolved"] and out["parity_ok"]
+    assert out["by_status"].get("error", 0) == 0
+    assert out["double_completions"] == 0
+    assert "dead" in out["breaker_state"].values()
+
+
+def test_pool_mixed_dense_sharded_parity(engine):
+    """A dense+sharded mix serves with per-kind bit parity (sharded
+    responses check against the replica's own sharded engine)."""
+    out = serve_selftest(
+        n_requests=16, seed=0, engine=engine,
+        replica_mix="dense:1,sharded@2:1",
+    )
+    assert out["ok"], out
+    assert out["parity_ok"]
+    assert out["replica_mix"] == ["dense", "sharded"]
+
+
+# -- rsan: the new thread/lock family under the runtime sanitizer ------------
+
+def test_pool_stress_under_rsan():
+    """Satellite: an 8-thread barrage through a STARTED pool (real
+    worker threads + submitters + a mid-run replica kill) with every
+    lock sanitized — no observed races, no lock-order contradiction
+    against gravelock's static model, and the new locks really were
+    contended across threads."""
+    from rca_tpu.analysis.concurrency import model_for, rsan
+    from rca_tpu.analysis.concurrency.crosscheck import (
+        order_contradictions,
+    )
+    from rca_tpu.analysis.core import repo_root
+
+    was = rsan.enabled()
+    rsan.enable()
+    rsan.RSAN.reset()
+    try:
+        stubs = [StubDispatcher() for _ in range(4)]
+        pool = ServePool(
+            dispatchers=stubs,
+            config=ServeConfig(replicas=4, max_wait_us=0),
+        )
+        reqs = [[] for _ in range(8)]
+
+        def submitter(w: int) -> None:
+            for i in range(24):
+                r = _req(f"t{w % 3}", n=8 + 8 * (w % 2), seed=w * 100 + i)
+                reqs[w].append(r)
+                pool.submit(r)
+                if w == 0 and i == 12:
+                    pool.replicas[0].kill()
+
+        with pool:
+            threads = [
+                threading.Thread(target=submitter, args=(w,))
+                for w in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            resps = [r.result(60.0) for lane in reqs for r in lane]
+        assert all(r.status in ("ok", "degraded", "error")
+                   for r in resps)
+        assert all(r.done() for lane in reqs for r in lane)
+        assert pool.sink.double_completions == 0
+
+        assert rsan.RSAN.races_observed() == []
+        lt = rsan.RSAN.lock_threads()
+        assert len(lt.get("ServePool._route_lock", ())) >= 2
+        assert len(lt.get("ReplicaWorker._lock", ())) >= 2
+        assert len(lt.get("CompletionSink._lock", ())) >= 2
+        static_edges = model_for(repo_root()).static_order_edges()
+        assert order_contradictions(
+            static_edges, rsan.RSAN.order_edges()
+        ) == []
+    finally:
+        rsan.RSAN.reset()
+        if not was:
+            rsan.disable()
