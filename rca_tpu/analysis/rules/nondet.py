@@ -40,6 +40,10 @@ from rca_tpu.analysis.core import FileContext, Finding, Rule, register
 #: determinism argument rests on (prefix match on repo-relative paths)
 REPLAY_SCOPE = (
     "rca_tpu/replay/",
+    # the gateway (ISSUE 9) fronts the serve plane and its canary mints
+    # recordings — wall reads there would make sampled corpora
+    # host-dependent, so the whole package times through clock seams
+    "rca_tpu/gateway/",
     "rca_tpu/engine/streaming.py",
     "rca_tpu/engine/live.py",
     "rca_tpu/parallel/streaming.py",

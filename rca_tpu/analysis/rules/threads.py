@@ -1,4 +1,5 @@
-"""Rule ``thread-discipline``: threads and locks are built in ONE place.
+"""Rule ``thread-discipline``: threads, locks, and sockets are built in
+ONE place each.
 
 Raw ``threading.Thread(...)`` / ``threading.Lock()`` (and the rest of
 the lock family) construction anywhere in ``rca_tpu/`` outside
@@ -8,6 +9,15 @@ daemon flag (root discovery cannot miss one), every lock carries its
 ``"Class.attr"`` identity (the static model and the rsan runtime record
 agree on names), and flipping ``RCA_RSAN=1`` shims every lock in the
 process without touching a call site.
+
+The same discipline covers SOCKETS (ISSUE 9): raw ``socket.socket(...)``
+(or ``socket.create_server`` / ``create_connection``) construction
+outside ``rca_tpu/util/net.py`` is a finding — the gateway is the
+package's only network surface and its listeners are named, reuse-flag
+and backlog decisions are made once, and an address-in-use failure is
+attributable to its owner.  Library-internal sockets (``http.client``,
+the HTTP server's accepted connections) are stdlib code, out of scope
+by construction.
 
 Subclassing ``threading.Thread`` stays legal (the subclass calls
 ``super().__init__(name=..., daemon=...)`` — it IS a named, explicit
@@ -24,13 +34,18 @@ from typing import List
 from rca_tpu.analysis.core import FileContext, Finding, Rule, register
 
 SEAM = "rca_tpu/util/threads.py"
+NET_SEAM = "rca_tpu/util/net.py"
 #: the rsan shim wraps the raw primitives by definition
 EXEMPT = (SEAM, "rca_tpu/analysis/concurrency/rsan.py")
+NET_EXEMPT = (NET_SEAM,)
 
 BANNED = {
     "Thread", "Lock", "RLock", "Condition", "Semaphore",
     "BoundedSemaphore",
 }
+
+#: socket-constructing callables (module attribute form: socket.<name>)
+NET_BANNED = {"socket", "create_server", "create_connection"}
 
 MESSAGE = (
     "raw `threading.{name}(...)` construction outside {seam} — use "
@@ -39,29 +54,43 @@ MESSAGE = (
     "thread-root discovery"
 )
 
+NET_MESSAGE = (
+    "raw `socket.{name}(...)` construction outside {seam} — use "
+    "make_server_socket so the listener is named, reuse/backlog policy "
+    "is decided once, and bind failures are attributable"
+)
+
 
 @register
 class ThreadDisciplineRule(Rule):
     name = "thread-discipline"
     summary = ("threading.Thread/Lock/... constructed only via "
-               "rca_tpu/util/threads.py (named, rsan-shimmable)")
-    why = ("an anonymous raw thread or lock is invisible to gravelock's "
-           "root discovery and to the rsan cross-check — the analyses "
-           "are only as sound as the constructor seam is complete")
+               "rca_tpu/util/threads.py (named, rsan-shimmable); "
+               "socket.socket only via rca_tpu/util/net.py")
+    why = ("an anonymous raw thread, lock, or listening socket is "
+           "invisible to gravelock's root discovery, the rsan "
+           "cross-check, and fd attribution — the analyses are only as "
+           "sound as the constructor seams are complete")
 
     def applies_to(self, relpath: str) -> bool:
         return relpath.startswith("rca_tpu/") and relpath not in EXEMPT
 
     def scan(self, ctx: FileContext) -> List[Finding]:
-        # names imported straight from threading count as raw too
+        # names imported straight from threading/socket count as raw too
         from_threading = set()
+        from_socket = set()
         for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.ImportFrom) \
-                    and node.module == "threading":
-                for alias in node.names:
-                    if alias.name in BANNED:
-                        from_threading.add(alias.asname or alias.name)
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "threading":
+                    for alias in node.names:
+                        if alias.name in BANNED:
+                            from_threading.add(alias.asname or alias.name)
+                elif node.module == "socket":
+                    for alias in node.names:
+                        if alias.name in NET_BANNED:
+                            from_socket.add(alias.asname or alias.name)
 
+        net_applies = ctx.relpath not in NET_EXEMPT
         hits: List[Finding] = []
 
         def walk(node: ast.AST, func: str) -> None:
@@ -70,17 +99,28 @@ class ThreadDisciplineRule(Rule):
             if isinstance(node, ast.Call):
                 f = node.func
                 bad = None
+                bad_net = None
                 if (isinstance(f, ast.Attribute)
-                        and isinstance(f.value, ast.Name)
-                        and f.value.id == "threading"
-                        and f.attr in BANNED):
-                    bad = f.attr
-                elif isinstance(f, ast.Name) and f.id in from_threading:
-                    bad = f.id
+                        and isinstance(f.value, ast.Name)):
+                    if f.value.id == "threading" and f.attr in BANNED:
+                        bad = f.attr
+                    elif f.value.id == "socket" and f.attr in NET_BANNED:
+                        bad_net = f.attr
+                elif isinstance(f, ast.Name):
+                    if f.id in from_threading:
+                        bad = f.id
+                    elif f.id in from_socket:
+                        bad_net = f.id
                 if bad is not None:
                     hits.append(ctx.finding(
                         self, node.lineno,
                         MESSAGE.format(name=bad, seam=SEAM), func=func,
+                    ))
+                elif bad_net is not None and net_applies:
+                    hits.append(ctx.finding(
+                        self, node.lineno,
+                        NET_MESSAGE.format(name=bad_net, seam=NET_SEAM),
+                        func=func,
                     ))
             for child in ast.iter_child_nodes(node):
                 walk(child, func)

@@ -40,6 +40,14 @@ TICK_MODULES = {
     # BatchDispatcher.fetch — including the steal path's orphan fetch
     "rca_tpu/serve/replica.py": set(),
     "rca_tpu/serve/pool.py": set(),
+    # gateway (ISSUE 9): the wire front door never touches the device —
+    # handlers park on req.result() like any in-process submitter, so
+    # fetch stays the serve path's ONE sync point even under wire load
+    "rca_tpu/gateway/server.py": set(),
+    "rca_tpu/gateway/wire.py": set(),
+    "rca_tpu/gateway/client.py": set(),
+    "rca_tpu/gateway/export.py": set(),
+    "rca_tpu/gateway/canary.py": set(),
 }
 
 MESSAGE = (

@@ -13,7 +13,14 @@
 - ``chaos``     seeded fault-injection soak over a synthetic world
                 (``--record`` writes a flight recording + replay-parity leg)
 - ``serve``     multi-tenant serving scheduler (continuous shape-bucketed
-                batching; ``--selftest`` asserts the serving contract)
+                batching; ``--selftest`` asserts the serving contract;
+                ``--listen HOST:PORT`` puts the stdlib-HTTP gateway in
+                front — the wire front door, SERVING.md §Gateway)
+- ``canary``    replay-driven regression canary (REPLAY.md §Canary):
+                sample live investigations into minted recordings,
+                replay them against a candidate build/config, exit
+                nonzero on ranking divergence (the bisected tick is in
+                the report)
 - ``replay``    deterministic incident replay from a flight recording:
                 tick-for-tick bit-parity, ``--seek`` time travel,
                 ``--bisect`` first-divergent-tick search, ``--mint``
@@ -448,6 +455,8 @@ def cmd_serve(args) -> int:
     if args.no_steal:
         overrides["steal"] = False
     config = ServeConfig.from_env(**overrides)
+    if args.listen:
+        return _serve_listen(args, config)
     if args.selftest:
         from rca_tpu.serve import serve_selftest
 
@@ -523,6 +532,133 @@ def cmd_serve(args) -> int:
         "metrics": loop.metrics.summary(),
     }, indent=None if args.compact else 2, default=str))
     return 0 if by_status.get("ok", 0) == args.requests else 1
+
+
+def _serve_listen(args, config) -> int:
+    """``rca serve --listen HOST:PORT`` (SERVING.md §Gateway): start the
+    serving plane (ServeLoop, or the pool when the resolved replica
+    count exceeds 1), put the stdlib-HTTP gateway in front, print ONE
+    JSON line naming the bound address (port 0 = kernel-chosen, so
+    callers read it from here), and serve until SIGTERM/SIGINT.  The
+    shutdown summary (per-tenant/per-replica metrics) goes to stderr —
+    stdout stays machine-parseable."""
+    import signal
+    import threading
+
+    from rca_tpu.config import gateway_port
+    from rca_tpu.engine import make_engine
+    from rca_tpu.gateway import GatewayServer
+    from rca_tpu.serve import ServeLoop, ServePool
+    from rca_tpu.store import InvestigationStore
+    from rca_tpu.util.net import parse_hostport
+
+    host, port = parse_hostport(args.listen, gateway_port())
+    recorder = None
+    if args.record:
+        from rca_tpu.replay import Recorder
+
+        recorder = Recorder(args.record, mode="serve")
+    # wire requests carrying an investigation_id land store notes +
+    # recording_ref exactly like in-process submissions
+    store = InvestigationStore(root=args.log_dir)
+    pooled = len(config.replica_specs()) > 1
+    if pooled:
+        loop = ServePool(config=config, recorder=recorder, store=store)
+    else:
+        loop = ServeLoop(engine=make_engine(), config=config,
+                         recorder=recorder, store=store)
+    loop.start()
+    gw = GatewayServer(loop, host=host, port=port)
+    gw.start()
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    print(json.dumps({
+        "listening": gw.address,
+        "replicas": len(loop.replicas) if pooled else 1,
+        "max_body": gw.max_body,
+        "endpoints": ["/v1/analyze", "/v1/subscribe", "/metrics",
+                      "/healthz"],
+        **({"recording": recorder.path} if recorder is not None else {}),
+    }), flush=True)
+    try:
+        while not stop.is_set():
+            stop.wait(0.5)
+    finally:
+        gw.close()
+        loop.stop()
+        if recorder is not None:
+            recorder.close()
+        snap = gw.metrics.snapshot()
+        print(json.dumps({
+            "stopped": True,
+            "gateway_requests": {
+                f"{route}:{code}": n
+                for (route, code), n in snap["requests"].items()
+            },
+            "metrics": loop.metrics.summary(),
+        }, default=str), file=sys.stderr)
+    return 0
+
+
+def cmd_canary(args) -> int:
+    """Replay-driven regression canary (REPLAY.md §Canary): sample live
+    investigations into minted recordings (stamping each into the store
+    as a replayable ``recording_ref``), replay them — plus any
+    ``--corpus`` recordings — against the candidate build/config, and
+    exit nonzero on ranking divergence.  The report names the exact
+    bisected tick (stream) or request index (serve)."""
+    import glob
+    import os as _os
+
+    from rca_tpu.gateway import build_candidate_engine, run_canary
+
+    m = re.fullmatch(r"(\d+)svc", args.fixture or "20svc")
+    if not m:
+        raise SystemExit(
+            f"canary needs a synthetic fixture (<N>svc), got "
+            f"{args.fixture!r}"
+        )
+    candidate, info = build_candidate_engine(
+        kind=args.candidate_engine,
+        weights=args.candidate_weights,
+        decay=args.candidate_decay,
+        explain_strength=args.candidate_explain_strength,
+        impact_bonus=args.candidate_impact_bonus,
+    )
+    corpus = []
+    if args.corpus:
+        if _os.path.isdir(args.corpus):
+            corpus = sorted(glob.glob(_os.path.join(args.corpus, "*.rcz")))
+        else:
+            corpus = [args.corpus]
+    store = None
+    if not args.no_store:
+        from rca_tpu.store import InvestigationStore
+
+        store = InvestigationStore(root=args.log_dir)
+    report = run_canary(
+        args.out,
+        rounds=args.rounds,
+        ticks=args.ticks,
+        services=int(m.group(1)),
+        seed=args.seed,
+        sample_rate=args.sample_rate,
+        mode=args.mode,
+        k=args.top,
+        candidate=candidate,
+        candidate_info=info,
+        corpus=corpus,
+        store=store,
+        serve_requests=args.requests,
+    )
+    print(json.dumps(report, indent=None if args.compact else 2,
+                     default=str))
+    return 0 if report["ok"] else 1
 
 
 def _replay_engine(choice: Optional[str]):
@@ -833,9 +969,79 @@ def build_parser() -> argparse.ArgumentParser:
                     "(implies a pool of >= 2 replicas)")
     sp.add_argument("--record", default=None, metavar="PATH",
                     help="flight-record every served request to PATH "
-                    "(load-demo mode); re-check with `rca replay PATH`")
+                    "(load-demo and --listen modes); re-check with "
+                    "`rca replay PATH`")
+    sp.add_argument("--listen", default=None, metavar="HOST:PORT",
+                    help="serve over the wire: start the stdlib-HTTP "
+                    "gateway (POST /v1/analyze, GET /v1/subscribe, "
+                    "/metrics, /healthz) in front of the scheduler and "
+                    "run until SIGTERM; port 0 binds an ephemeral port "
+                    "(the bound address prints as the first stdout "
+                    "line); default port $RCA_GATEWAY_PORT")
+    sp.add_argument("--log-dir", default="logs",
+                    help="investigation store root for --listen "
+                    "(wire requests carrying an investigation_id "
+                    "append serve notes there)")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "canary",
+        help="replay-driven regression canary: sample live "
+        "investigations into minted recordings, replay them against a "
+        "candidate build/config, exit nonzero on ranking divergence "
+        "(the exact bisected tick is in the report; REPLAY.md §Canary)",
+    )
+    sp.add_argument("--out", default="logs/canary",
+                    help="directory the minted canary corpus grows in")
+    sp.add_argument("--corpus", default=None, metavar="PATH",
+                    help="existing recordings added to the replay gate "
+                    "(a directory of *.rcz, or one file) — e.g. a "
+                    "previous canary's corpus or a recorded gateway "
+                    "session")
+    sp.add_argument("--rounds", type=int, default=2,
+                    help="sampling rounds (each records one session at "
+                    "the sample rate)")
+    sp.add_argument("--ticks", type=int, default=12,
+                    help="streaming ticks per sampled session")
+    sp.add_argument("--requests", type=int, default=8,
+                    help="serve requests per sampled wave (mode "
+                    "serve/both)")
+    sp.add_argument("--fixture", default="20svc",
+                    help="<N>svc synthetic world per sampled session")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--sample-rate", type=float, default=None,
+                    dest="sample_rate",
+                    help="per-round recording probability (override "
+                    "$RCA_CANARY_SAMPLE_RATE; default 1.0)")
+    sp.add_argument("--mode", default="stream",
+                    choices=["stream", "serve", "both"],
+                    help="what each round samples: streaming "
+                    "investigations (bisect names the exact tick), "
+                    "serve waves (first divergent request index), or "
+                    "both")
+    sp.add_argument("--top", type=int, default=5)
+    sp.add_argument("--candidate-engine", default="auto",
+                    dest="candidate_engine",
+                    help="auto (= current build, recorded kind) | "
+                    "single | sharded")
+    sp.add_argument("--candidate-weights", default=None,
+                    dest="candidate_weights", metavar="CKPT",
+                    help="candidate scoring checkpoint (RCA_WEIGHTS "
+                    "form) the corpus replays against")
+    sp.add_argument("--candidate-decay", type=float, default=None,
+                    dest="candidate_decay",
+                    help="perturb the candidate's per-hop decay")
+    sp.add_argument("--candidate-explain-strength", type=float,
+                    default=None, dest="candidate_explain_strength")
+    sp.add_argument("--candidate-impact-bonus", type=float,
+                    default=None, dest="candidate_impact_bonus")
+    sp.add_argument("--no-store", action="store_true", dest="no_store",
+                    help="skip stamping sampled recordings into the "
+                    "investigation store")
+    sp.add_argument("--log-dir", default="logs")
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_canary)
 
     sp = sub.add_parser(
         "replay",

@@ -63,6 +63,22 @@ def env_int_opt(name: str, lo: int, hi: int) -> Optional[int]:
     return env_int(name, 0, lo, hi)
 
 
+def env_float(name: str, default: float, lo: float, hi: float) -> float:
+    """A range-checked float env knob; empty/unset means ``default``."""
+    raw = (os.environ.get(name) or "").strip()
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r}: expected a number in [{lo}, {hi}]"
+        )
+    if not lo <= value <= hi:
+        raise ValueError(f"{name}={value}: out of range [{lo}, {hi}]")
+    return value
+
+
 def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
     """A free-form env value (path, address, API key): pass-through with
     no validation beyond centralizing the read.  None when unset."""
@@ -359,6 +375,41 @@ class ServeConfig:
         }
         env.update(overrides)
         return cls(**env)
+
+
+# -- gateway + canary (ISSUE 9) ---------------------------------------------
+# env knobs for the wire front door (rca_tpu/gateway, SERVING.md §Gateway)
+# and the replay-driven regression canary (REPLAY.md §Canary), each
+# validated here so a typo'd value fails loudly:
+#
+#   RCA_GATEWAY_PORT      [0, 65535]  default listen port for
+#                         `rca serve --listen` when the spec omits one
+#                         (default 8321; 0 = kernel-chosen ephemeral —
+#                         the CLI prints the bound port)
+#   RCA_GATEWAY_MAX_BODY  [1024, 1_073_741_824]  largest request body the
+#                         gateway accepts, bytes (default 8 MiB; larger
+#                         bodies get 413 before any parse — backpressure
+#                         must not require reading the flood first)
+#   RCA_CANARY_SAMPLE_RATE [0.0, 1.0]  probability `rca canary` records a
+#                         given sampling round into the regression corpus
+#                         (default 1.0 — every round; production tuning
+#                         trades corpus freshness for record overhead)
+
+
+def gateway_port() -> int:
+    """``RCA_GATEWAY_PORT``: the gateway's default listen port."""
+    return env_int("RCA_GATEWAY_PORT", 8321, 0, 65535)
+
+
+def gateway_max_body() -> int:
+    """``RCA_GATEWAY_MAX_BODY``: request-body byte cap (413 beyond it)."""
+    return env_int("RCA_GATEWAY_MAX_BODY", 8 * 1024 * 1024, 1024,
+                   1 << 30)
+
+
+def canary_sample_rate() -> float:
+    """``RCA_CANARY_SAMPLE_RATE``: per-round recording probability."""
+    return env_float("RCA_CANARY_SAMPLE_RATE", 1.0, 0.0, 1.0)
 
 
 # -- persistent compilation cache (ISSUE 2 satellite) -----------------------

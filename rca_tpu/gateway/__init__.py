@@ -1,0 +1,49 @@
+"""Gateway + canary: the wire front door over the serving plane, and
+the replay-driven continuous regression canary (ISSUE 9, SERVING.md
+§Gateway, REPLAY.md §Canary).
+
+- :mod:`rca_tpu.gateway.wire`    JSON ⇄ serve-contract codec + the
+  honest HTTP status map (queue_full→429, shed→503, degraded→200+flag);
+- :mod:`rca_tpu.gateway.server`  :class:`GatewayServer`: stdlib-HTTP
+  front over a started ``ServeLoop``/``ServePool`` (`rca serve
+  --listen`) with tenant tagging from a header, chunked streaming tick
+  subscriptions, `/metrics`, and breaker-fed `/healthz`;
+- :mod:`rca_tpu.gateway.export`  the Prometheus text exposition;
+- :mod:`rca_tpu.gateway.client`  :class:`GatewayClient`, the wire twin
+  of the in-process ``ServeClient``;
+- :mod:`rca_tpu.gateway.canary`  `rca canary`: sample live
+  investigations into minted recordings, replay them against a
+  candidate build/config, fail on ranking divergence with the exact
+  bisected tick.
+"""
+
+from rca_tpu.gateway.canary import build_candidate_engine, run_canary
+from rca_tpu.gateway.client import GatewayClient
+from rca_tpu.gateway.export import render_metrics_text
+from rca_tpu.gateway.server import GatewayMetrics, GatewayServer, TickHub
+from rca_tpu.gateway.wire import (
+    DEFAULT_TENANT,
+    TENANT_HEADER,
+    WireError,
+    decode_analyze,
+    encode_analyze,
+    response_body,
+    status_code_for,
+)
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "TENANT_HEADER",
+    "GatewayClient",
+    "GatewayMetrics",
+    "GatewayServer",
+    "TickHub",
+    "WireError",
+    "build_candidate_engine",
+    "decode_analyze",
+    "encode_analyze",
+    "render_metrics_text",
+    "response_body",
+    "run_canary",
+    "status_code_for",
+]

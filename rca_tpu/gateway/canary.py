@@ -1,0 +1,293 @@
+"""Replay-driven continuous regression canary (ISSUE 9, `rca canary`).
+
+PR 5 made incidents replayable; this module makes replay a RELEASE GATE.
+A canary run has two phases:
+
+1. **Sample**: drive live investigations — streaming sessions and/or
+   serve waves over seeded synthetic worlds — with the flight recorder
+   attached, at ``RCA_CANARY_SAMPLE_RATE`` (a seeded Bernoulli draw per
+   round, so production can trade corpus freshness for record
+   overhead).  Each sampled recording is minted into a one-file corpus
+   fixture (:func:`rca_tpu.replay.mint_recording`) and stamped into the
+   investigation store via ``recording_ref`` — the same replayable-by-id
+   plumbing served investigations already carry.
+
+2. **Replay against a candidate**: every minted (or supplied) recording
+   re-drives through a CANDIDATE engine — a different build, a perturbed
+   scoring config (``--candidate-decay`` etc.), a different engine kind
+   — and the run fails on ANY ranking divergence.  For stream
+   recordings, :func:`rca_tpu.replay.bisect_divergence` names the exact
+   first divergent tick (and dumps both sides' tensors); serve
+   recordings name the first divergent request index.
+
+That turns the replay corpus from a static fixture set into a
+self-refreshing regression stream (ROADMAP item 5): recordings minted
+from today's traffic are the parity oracle tomorrow's candidate must
+pass before it ships.  LogGD (PAPERS.md) validates on recorded event
+streams rather than live clusters for exactly this reproducibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: report lists every recording, but caps the divergence detail it
+#: inlines (the dump file carries the full tensors)
+_DIVERGENCE_DETAIL_CAP = 8
+
+
+def build_candidate_engine(
+    kind: str = "auto",
+    weights: Optional[str] = None,
+    decay: Optional[float] = None,
+    explain_strength: Optional[float] = None,
+    impact_bonus: Optional[float] = None,
+) -> Tuple[Optional[object], Dict[str, Any]]:
+    """The candidate the corpus replays against.
+
+    With everything defaulted the candidate IS the current build (the
+    replayer picks each recording's recorded engine kind) — that is the
+    CI shape: yesterday's recordings gate today's tree.  Any override
+    builds an explicit engine: ``kind`` forces single/sharded,
+    ``weights`` loads a checkpoint, and the three scalar knobs perturb
+    the scoring params (which is also how the tests plant a divergence
+    the bisect must localize)."""
+    overrides = {
+        key: value for key, value in (
+            ("decay", decay),
+            ("explain_strength", explain_strength),
+            ("impact_bonus", impact_bonus),
+        ) if value is not None
+    }
+    info: Dict[str, Any] = {"kind": kind, "weights": weights,
+                            "param_overrides": overrides}
+    if kind == "auto" and weights is None and not overrides:
+        info["note"] = "current build, recorded engine kind"
+        return None, info
+    from rca_tpu.config import RCAConfig
+    from rca_tpu.engine.runner import GraphEngine, resolve_params
+
+    params = None
+    if weights:
+        from rca_tpu.engine.train import load_params
+
+        params = load_params(weights)
+    base = resolve_params(RCAConfig.from_env(), params)
+    if overrides:
+        base = dataclasses.replace(base, **overrides)
+    if kind == "sharded":
+        from rca_tpu.engine.sharded_runner import ShardedGraphEngine
+
+        return ShardedGraphEngine(params=base), info
+    return GraphEngine(params=base), info
+
+
+# -- sampling ----------------------------------------------------------------
+
+def _sample_stream(tmp: str, out_path: str, ticks: int, services: int,
+                   seed: int, k: int) -> Dict[str, Any]:
+    """One recorded streaming investigation, minted to ``out_path``."""
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.replay import Recorder, mint_recording
+
+    world = synthetic_cascade_world(services, n_roots=1, seed=seed)
+    recorder = Recorder(os.path.join(tmp, "stream"), mode="stream")
+    session = LiveStreamingSession(
+        MockClusterClient(world), "synthetic", k=k,
+        topology_check_every=10, recorder=recorder,
+    )
+    rng = np.random.default_rng(seed)
+    for t in range(ticks):
+        if t % 3 == 0:
+            # journaled churn so the recording carries real deltas, not
+            # an all-quiet tape
+            i = int(rng.integers(0, services))
+            name = f"pod-svc-{i:05d}" if services > 5 else "pod-0"
+            world.touch("pod_metrics", "synthetic", name)
+        session.poll()
+    recorder.close()
+    stats = mint_recording(recorder.path, out_path)
+    return {"mode": "stream", "ticks": stats["ticks"]}
+
+
+def _sample_serve(tmp: str, out_path: str, requests: int, services: int,
+                  seed: int, k: int) -> Dict[str, Any]:
+    """One recorded serve wave, minted to ``out_path``."""
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine.runner import GraphEngine
+    from rca_tpu.replay import Recorder, mint_recording
+    from rca_tpu.serve import ServeClient, ServeLoop
+
+    case = synthetic_cascade_arrays(services, n_roots=1, seed=seed)
+    rng = np.random.default_rng(seed)
+    recorder = Recorder(os.path.join(tmp, "serve"), mode="serve")
+    loop = ServeLoop(engine=GraphEngine(), recorder=recorder)
+    with loop:
+        client = ServeClient(loop)
+        reqs = [
+            client.submit(
+                np.clip(
+                    case.features + rng.uniform(
+                        0, 0.05, case.features.shape
+                    ).astype(np.float32),
+                    0, 1,
+                ),
+                case.dep_src, case.dep_dst, names=case.names,
+                tenant=f"canary-{i % 2}", k=k,
+            )
+            for i in range(requests)
+        ]
+        for r in reqs:
+            r.result(120.0)
+    recorder.close()
+    stats = mint_recording(recorder.path, out_path)
+    return {"mode": "serve", "requests": stats["serve"]}
+
+
+# -- replay gate -------------------------------------------------------------
+
+def _replay_one(path: str, engine) -> Dict[str, Any]:
+    """Replay one recording against the candidate; on stream divergence,
+    bisect to the exact tick."""
+    from rca_tpu.replay import (
+        bisect_divergence,
+        load_recording,
+        replay_serve,
+        replay_stream,
+    )
+
+    rec = load_recording(path)
+    entry: Dict[str, Any] = {"recording": str(path), "mode": rec.mode}
+    if rec.mode == "serve":
+        report = replay_serve(path, engine=engine)
+        entry["requests"] = report["requests_recorded"]
+        entry["parity_ok"] = bool(report["parity_ok"])
+        entry["first_divergent_index"] = report["first_divergent_index"]
+        return entry
+    report = replay_stream(path, engine=engine)
+    entry["ticks"] = report["ticks_replayed"]
+    entry["parity_ok"] = bool(report["parity_ok"])
+    entry["engine_replayed"] = report["engine_replayed"]
+    if not report["parity_ok"]:
+        # bisect names the EXACT first divergent tick (fresh-session
+        # probes; REPLAY.md) and dumps both sides' tensors for diffing
+        bisect = bisect_divergence(path, engine=engine)
+        entry["first_divergent_tick"] = bisect["first_divergent_tick"]
+        entry["probes"] = bisect["probes"]
+        entry["dump"] = bisect.get("dump")
+    return entry
+
+
+def run_canary(
+    out_dir: str,
+    rounds: int = 2,
+    ticks: int = 12,
+    services: int = 20,
+    seed: int = 0,
+    sample_rate: Optional[float] = None,
+    mode: str = "stream",
+    k: int = 5,
+    candidate=None,
+    candidate_info: Optional[Dict[str, Any]] = None,
+    corpus: Optional[List[str]] = None,
+    store=None,
+    serve_requests: int = 8,
+) -> Dict[str, Any]:
+    """Sample → mint → replay-against-candidate; ``ok`` iff every
+    replayed recording holds bit parity.
+
+    ``mode``: ``stream`` | ``serve`` | ``both`` — what each sampling
+    round records.  ``corpus`` adds pre-existing recordings (e.g. minted
+    by an earlier canary, or a recorded gateway session) to the replay
+    gate without re-sampling them.  ``store`` (an
+    :class:`rca_tpu.store.InvestigationStore`) gets one investigation
+    per sampled recording with its ``recording_ref`` pointing at the
+    minted file — the corpus is replayable by investigation id."""
+    if mode not in ("stream", "serve", "both"):
+        raise ValueError(f"mode must be stream|serve|both, got {mode!r}")
+    if sample_rate is None:
+        from rca_tpu.config import canary_sample_rate
+
+        sample_rate = canary_sample_rate()
+    os.makedirs(out_dir, exist_ok=True)
+    sampler = random.Random(seed)
+    sampled: List[Dict[str, Any]] = []
+    skipped = 0
+    minted: List[str] = []
+    for i in range(int(rounds)):
+        # the seeded Bernoulli draw is consumed every round regardless
+        # of outcome, so (seed, round) always addresses the same draw
+        take = sampler.random() < sample_rate
+        if not take:
+            skipped += 1
+            continue
+        legs = ("stream", "serve") if mode == "both" else (mode,)
+        for leg in legs:
+            out_path = os.path.join(
+                out_dir, f"canary-{leg}-{seed}-{i}.rcz"
+            )
+            tmp = tempfile.mkdtemp(prefix="rca_canary_")
+            try:
+                if leg == "stream":
+                    info = _sample_stream(
+                        tmp, out_path, ticks=ticks, services=services,
+                        seed=seed + i, k=k,
+                    )
+                else:
+                    info = _sample_serve(
+                        tmp, out_path, requests=serve_requests,
+                        services=services, seed=seed + i, k=k,
+                    )
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            info["recording"] = out_path
+            if store is not None:
+                inv = store.create_investigation(
+                    f"canary {leg} round {i} (seed {seed + i})",
+                    namespace="synthetic",
+                    recording_ref=out_path,
+                )
+                info["investigation_id"] = inv["id"]
+            sampled.append(info)
+            minted.append(out_path)
+
+    results = [
+        _replay_one(path, candidate)
+        for path in list(minted) + list(corpus or [])
+    ]
+    divergent = [r for r in results if not r["parity_ok"]]
+    first: Optional[Dict[str, Any]] = None
+    if divergent:
+        d = divergent[0]
+        first = {
+            "recording": d["recording"],
+            **({"tick": d["first_divergent_tick"]}
+               if "first_divergent_tick" in d else {}),
+            **({"index": d["first_divergent_index"]}
+               if d.get("first_divergent_index") is not None else {}),
+        }
+    return {
+        "ok": not divergent,
+        "mode": mode,
+        "rounds": int(rounds),
+        "sample_rate": float(sample_rate),
+        "sampled": len(sampled),
+        "skipped": skipped,
+        "candidate": candidate_info or {
+            "kind": "auto", "note": "current build",
+        },
+        "recordings": results,
+        "divergent": [
+            r["recording"] for r in divergent[:_DIVERGENCE_DETAIL_CAP]
+        ],
+        "first_divergence": first,
+    }

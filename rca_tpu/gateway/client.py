@@ -1,0 +1,115 @@
+"""Wire client: the stdlib-HTTP twin of the in-process ``ServeClient``.
+
+Used by the tests (loopback bit-parity vs in-process submission), the
+bench's ``gateway`` section (wire-vs-in-process overhead), and any
+out-of-process caller that wants a typed surface instead of raw curl.
+One :class:`GatewayClient` holds no connection state between calls —
+each request opens, speaks, and closes (HTTP keep-alive is a transport
+optimization the parity and backpressure contracts must not depend on).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from rca_tpu.gateway.wire import TENANT_HEADER, encode_analyze
+
+
+class GatewayClient:
+    def __init__(self, host: str, port: int, timeout_s: float = 60.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+
+    def _conn(self, timeout_s: Optional[float] = None
+              ) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=timeout_s if timeout_s is not None else self.timeout_s,
+        )
+
+    # -- analyze -------------------------------------------------------------
+    def analyze(
+        self,
+        features, dep_src, dep_dst,
+        names=None, tenant: Optional[str] = None, k: int = 5,
+        priority: str = "normal", deadline_ms: Optional[float] = None,
+        investigation_id: Optional[str] = None,
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """One analyze request over the wire.  Returns ``(http_code,
+        body, headers)`` — the caller maps 429/503 to its own backoff
+        using the ``Retry-After`` header, exactly as an external load
+        balancer would."""
+        body = json.dumps(encode_analyze(
+            features, dep_src, dep_dst, names=names, k=k,
+            priority=priority, deadline_ms=deadline_ms,
+            investigation_id=investigation_id,
+        )).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        if tenant is not None:
+            headers[TENANT_HEADER] = tenant
+        conn = self._conn()
+        try:
+            conn.request("POST", "/v1/analyze", body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+            payload = json.loads(resp.read().decode("utf-8"))
+            return resp.status, payload, dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    # -- streaming subscription ----------------------------------------------
+    def subscribe(
+        self,
+        tenant: Optional[str] = None,
+        max_events: int = 0,
+        idle_s: float = 30.0,
+        timeout_s: Optional[float] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Yield served-response events as they stream (chunked NDJSON).
+        Ends after ``max_events`` (0 = server default/unbounded), after
+        ``idle_s`` with no event, or when the gateway shuts down."""
+        query = f"/v1/subscribe?idle_s={idle_s}"
+        if tenant is not None:
+            query += f"&tenant={tenant}"
+        if max_events:
+            query += f"&max={int(max_events)}"
+        conn = self._conn(timeout_s)
+        try:
+            conn.request("GET", query)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"subscribe: HTTP {resp.status}: "
+                    f"{resp.read(256)!r}"
+                )
+            while True:
+                line = resp.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if line:
+                    yield json.loads(line.decode("utf-8"))
+        finally:
+            conn.close()
+
+    # -- observability endpoints ---------------------------------------------
+    def metrics_text(self) -> str:
+        conn = self._conn()
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            return resp.read().decode("utf-8")
+        finally:
+            conn.close()
+
+    def healthz(self) -> Tuple[int, Dict[str, Any]]:
+        conn = self._conn()
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+        finally:
+            conn.close()

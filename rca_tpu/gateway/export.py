@@ -1,0 +1,157 @@
+"""`/metrics` text exposition: ServeMetrics + gateway counters.
+
+Prometheus text format (``# TYPE`` lines + ``name{labels} value``), built
+from two CONSISTENT snapshots — :meth:`rca_tpu.serve.metrics.ServeMetrics.
+summary` (one lock-guarded copy of the whole serving plane: per-tenant
+counters, per-replica rows, cache events) and the gateway's own HTTP
+counters — so a scrape never interleaves with the replicas mutating the
+live accumulators (ISSUE 9's snapshot-consistency fix).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+_TENANT_COUNTERS = (
+    "submitted", "answered", "shed", "rejected", "degraded", "errors",
+)
+
+
+def _esc(value: str) -> str:
+    return (
+        str(value).replace("\\", "\\\\").replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _line(out: List[str], name: str, value, **labels) -> None:
+    if value is None:
+        return
+    if labels:
+        lab = ",".join(
+            f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+        )
+        out.append(f"{name}{{{lab}}} {value}")
+    else:
+        out.append(f"{name} {value}")
+
+
+def _head(out: List[str], name: str, kind: str, help_: str) -> None:
+    out.append(f"# HELP {name} {help_}")
+    out.append(f"# TYPE {name} {kind}")
+
+
+def render_metrics_text(
+    serve_summary: Dict[str, Any],
+    gateway: Optional[Dict[str, Any]] = None,
+    healthy: Optional[bool] = None,
+) -> str:
+    """The full exposition body (text/plain; version=0.0.4)."""
+    out: List[str] = []
+
+    _head(out, "rca_serve_requests_total", "counter",
+          "serve outcomes per tenant")
+    tenants = serve_summary.get("tenants", {})
+    for tenant, rec in sorted(tenants.items()):
+        for key in _TENANT_COUNTERS:
+            _line(out, "rca_serve_requests_total", rec.get(key, 0),
+                  tenant=tenant, outcome=key)
+
+    _head(out, "rca_serve_queue_ms", "gauge",
+          "per-tenant time-in-queue quantiles (ms)")
+    for tenant, rec in sorted(tenants.items()):
+        _line(out, "rca_serve_queue_ms", rec.get("queue_ms_p50"),
+              tenant=tenant, quantile="0.5")
+        _line(out, "rca_serve_queue_ms", rec.get("queue_ms_p99"),
+              tenant=tenant, quantile="0.99")
+
+    _head(out, "rca_serve_resident_delta_requests_total", "counter",
+          "requests served via the resident delta path, per tenant")
+    for tenant, rec in sorted(tenants.items()):
+        _line(out, "rca_serve_resident_delta_requests_total",
+              rec.get("resident_delta_requests", 0), tenant=tenant)
+
+    _head(out, "rca_serve_batches_total", "counter",
+          "device batches dispatched")
+    _line(out, "rca_serve_batches_total", serve_summary.get("batches", 0))
+    _head(out, "rca_serve_dispatched_requests_total", "counter",
+          "requests that rode a device batch")
+    _line(out, "rca_serve_dispatched_requests_total",
+          serve_summary.get("dispatched_requests", 0))
+    _head(out, "rca_serve_queue_depth_peak", "gauge",
+          "peak queue depth observed at admission")
+    _line(out, "rca_serve_queue_depth_peak",
+          serve_summary.get("queue_depth_peak", 0))
+
+    _head(out, "rca_serve_graph_cache_events_total", "counter",
+          "prepared-graph cache events")
+    for event, n in sorted(
+        (serve_summary.get("graph_cache") or {}).items()
+    ):
+        _line(out, "rca_serve_graph_cache_events_total", n, event=event)
+
+    replicas = serve_summary.get("replicas") or {}
+    if replicas:
+        _head(out, "rca_serve_replica_batches_total", "counter",
+              "device batches fetched OK per replica")
+        for rid, rec in sorted(replicas.items()):
+            _line(out, "rca_serve_replica_batches_total",
+                  rec.get("batches", 0), replica=rid)
+        _head(out, "rca_serve_replica_requests_total", "counter",
+              "requests served per replica")
+        for rid, rec in sorted(replicas.items()):
+            _line(out, "rca_serve_replica_requests_total",
+                  rec.get("requests", 0), replica=rid)
+        _head(out, "rca_serve_replica_stolen_total", "counter",
+              "work-steal moves per replica and direction")
+        for rid, rec in sorted(replicas.items()):
+            _line(out, "rca_serve_replica_stolen_total",
+                  rec.get("stolen_from", 0), replica=rid,
+                  direction="from")
+            _line(out, "rca_serve_replica_stolen_total",
+                  rec.get("stolen_to", 0), replica=rid, direction="to")
+        _head(out, "rca_serve_replica_state", "gauge",
+              "1 for the replica's current breaker/liveness state")
+        for rid, rec in sorted(replicas.items()):
+            _line(out, "rca_serve_replica_state", 1, replica=rid,
+                  state=str(rec.get("state", "closed")))
+        _head(out, "rca_serve_replica_occupancy", "gauge",
+              "per-replica occupancy quantiles (staged + in flight)")
+        for rid, rec in sorted(replicas.items()):
+            _line(out, "rca_serve_replica_occupancy",
+                  rec.get("occupancy_p50"), replica=rid, quantile="0.5")
+            _line(out, "rca_serve_replica_occupancy",
+                  rec.get("occupancy_max"), replica=rid, quantile="1.0")
+
+    if gateway is not None:
+        _head(out, "rca_gateway_requests_total", "counter",
+              "gateway HTTP responses by route and code")
+        for (route, code), n in sorted(gateway.get("requests", {}).items()):
+            _line(out, "rca_gateway_requests_total", n, route=route,
+                  code=str(code))
+        _head(out, "rca_gateway_request_ms", "gauge",
+              "gateway request latency quantiles (ms) by route")
+        for route, rec in sorted(gateway.get("latency", {}).items()):
+            _line(out, "rca_gateway_request_ms", rec.get("p50"),
+                  route=route, quantile="0.5")
+            _line(out, "rca_gateway_request_ms", rec.get("p99"),
+                  route=route, quantile="0.99")
+        _head(out, "rca_gateway_streams_opened_total", "counter",
+              "tick subscriptions opened")
+        _line(out, "rca_gateway_streams_opened_total",
+              gateway.get("streams_opened", 0))
+        _head(out, "rca_gateway_stream_events_total", "counter",
+              "tick events delivered to subscribers")
+        _line(out, "rca_gateway_stream_events_total",
+              gateway.get("stream_events", 0))
+        _head(out, "rca_gateway_body_rejections_total", "counter",
+              "requests refused for exceeding RCA_GATEWAY_MAX_BODY")
+        _line(out, "rca_gateway_body_rejections_total",
+              gateway.get("body_rejections", 0))
+
+    if healthy is not None:
+        _head(out, "rca_gateway_up", "gauge",
+              "1 while the serving plane is routable")
+        _line(out, "rca_gateway_up", 1 if healthy else 0)
+
+    return "\n".join(out) + "\n"

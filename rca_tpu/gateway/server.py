@@ -1,0 +1,500 @@
+"""The wire front door: stdlib HTTP over the serving plane (ISSUE 9).
+
+Until this layer, nothing outside the process could reach the serve
+queue — the pool is threads-in-one-process.  :class:`GatewayServer` puts
+an HTTP/1.1 surface (no dependencies beyond the standard library, same
+policy as ``cluster/trace_backend.py``) in front of any started
+:class:`rca_tpu.serve.loop.ServeLoop` or :class:`rca_tpu.serve.pool.
+ServePool`, mapped onto the existing ``ServeRequest``/``ServeResponse``
+contract through :mod:`rca_tpu.gateway.wire`:
+
+- ``POST /v1/analyze``   one analyze request; auth-less tenant tagging
+  from the ``X-RCA-Tenant`` header; backpressure mapped honestly
+  (queue_full→429+Retry-After, shed→503, degraded→200 with a
+  ``degraded`` flag, error→500, gateway wait bound→504);
+- ``GET /v1/subscribe``  chunked streaming tick subscription: one JSON
+  line per response this gateway serves (optionally filtered to one
+  tenant) — a live investigation watches its rankings arrive instead of
+  polling;
+- ``GET /metrics``       Prometheus text exposition of the serving
+  plane's per-tenant/per-replica counters plus the gateway's own HTTP
+  counters (one consistent snapshot each, see serve/metrics.py);
+- ``GET /healthz``       breaker-fed liveness: 200 while the plane is
+  routable (any live, non-open replica), 503 otherwise.
+
+Concurrency discipline (gravelock, ANALYSIS.md): every connection thread
+is spawned NAMED through :func:`rca_tpu.util.threads.spawn` (the server
+overrides ``socketserver``'s anonymous-thread spawn), the listening
+socket is built through the :mod:`rca_tpu.util.net` seam, gateway state
+(:class:`GatewayMetrics`, :class:`TickHub`) is lock-guarded, and the
+gateway never touches the device — requests park on ``req.result()``
+like any in-process submitter, so fetch stays the serve path's only
+sync point (tick-sync lint covers this package).  Timing goes through
+the injectable ``clock`` seam (nondet-discipline: the gateway is
+replay-adjacent — its recordings must stay host-independent).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from rca_tpu.config import gateway_max_body, gateway_port
+from rca_tpu.gateway.export import render_metrics_text
+from rca_tpu.gateway.wire import (
+    TENANT_HEADER,
+    WireError,
+    decode_analyze,
+    response_body,
+    status_code_for,
+)
+from rca_tpu.obslog.profiling import PhaseStats
+from rca_tpu.serve.client import ServeClient
+from rca_tpu.util.net import bound_address, make_server_socket
+from rca_tpu.util.threads import make_lock, spawn
+
+#: default gateway-side wait bound on one analyze request (504 past it);
+#: generous — the scheduler's own deadline/shed machinery is the real
+#: latency policy, this only bounds a wedged plane
+DEFAULT_TIMEOUT_S = 60.0
+
+#: idle poll while a subscriber waits for its next event (also the
+#: shutdown-notice latency for parked streams)
+_STREAM_POLL_S = 0.25
+
+
+class GatewayMetrics:
+    """The gateway's own HTTP counters (the serve plane's live in
+    :class:`rca_tpu.serve.metrics.ServeMetrics`).  ``snapshot()`` returns
+    one consistent copy for the exporter — same discipline as the serve
+    metrics' summary."""
+
+    def __init__(self) -> None:
+        self._lock = make_lock("GatewayMetrics._lock")
+        self._requests: Dict[Tuple[str, int], int] = {}
+        self._latency = PhaseStats()   # one phase per route
+        self._streams_opened = 0
+        self._stream_events = 0
+        self._body_rejections = 0
+
+    def response(self, route: str, code: int, ms: float) -> None:
+        with self._lock:
+            key = (route, int(code))
+            self._requests[key] = self._requests.get(key, 0) + 1
+            self._latency.record(route, float(ms))
+
+    def stream_opened(self) -> None:
+        with self._lock:
+            self._streams_opened += 1
+
+    def stream_event(self) -> None:
+        with self._lock:
+            self._stream_events += 1
+
+    def body_rejected(self) -> None:
+        with self._lock:
+            self._body_rejections += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            requests = dict(self._requests)
+            latency = self._latency.snapshot()
+            streams_opened = self._streams_opened
+            stream_events = self._stream_events
+            body_rejections = self._body_rejections
+        return {
+            "requests": requests,
+            "latency": {
+                route: {
+                    "p50": latency.quantile(route, 0.50),
+                    "p99": latency.quantile(route, 0.99),
+                }
+                for route in latency.phases()
+            },
+            "streams_opened": streams_opened,
+            "stream_events": stream_events,
+            "body_rejections": body_rejections,
+        }
+
+
+class TickHub:
+    """Pub/sub of served responses for streaming subscriptions.
+
+    The analyze path publishes every terminal response it delivers; each
+    subscriber owns a bounded queue.  A slow subscriber DROPS events
+    (``queue.Full`` is swallowed) rather than ever back-pressuring the
+    serving plane — the stream is observability, not the system of
+    record."""
+
+    #: events a parked subscriber may lag before drops start
+    QUEUE_CAP = 1024
+
+    def __init__(self) -> None:
+        self._lock = make_lock("TickHub._lock")
+        self._subs: Dict[int, Tuple[Optional[str], "queue.Queue"]] = {}
+        self._counter = itertools.count()
+        self.dropped = 0
+
+    def subscribe(
+        self, tenant: Optional[str] = None
+    ) -> Tuple[int, "queue.Queue"]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.QUEUE_CAP)
+        with self._lock:
+            sid = next(self._counter)
+            self._subs[sid] = (tenant, q)
+        return sid, q
+
+    def unsubscribe(self, sid: int) -> None:
+        with self._lock:
+            self._subs.pop(sid, None)
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def publish(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            subs = list(self._subs.values())
+        for tenant, q in subs:
+            if tenant is not None and tenant != event.get("tenant"):
+                continue
+            try:
+                q.put_nowait(event)
+            except queue.Full:
+                with self._lock:
+                    self.dropped += 1
+
+
+class _GatewayHTTPServer(HTTPServer):
+    """HTTPServer over a seam-built socket, spawning NAMED connection
+    threads (socketserver's ThreadingMixIn spawns anonymous raw threads,
+    which the thread-discipline rule exists to prevent)."""
+
+    daemon_threads = True
+
+    def __init__(self, sock, handler_cls, gateway: "GatewayServer"):
+        addr = bound_address(sock)
+        super().__init__(addr, handler_cls, bind_and_activate=False)
+        # TCPServer pre-built an unbound socket; replace it with the
+        # seam's listening one
+        self.socket.close()
+        self.socket = sock
+        self.server_name, self.server_port = addr
+        self.gateway = gateway
+        self._conn_counter = itertools.count()
+
+    def process_request(self, request, client_address) -> None:
+        spawn(
+            self._process_request_thread,
+            name=f"rca-gateway-conn{next(self._conn_counter)}",
+            daemon=True,
+            args=(request, client_address),
+        )
+
+    def _process_request_thread(self, request, client_address) -> None:
+        from rca_tpu.resilience.policy import suppressed
+
+        # a client hanging up mid-response (BrokenPipe, reset) is normal
+        # wire weather, not a server fault; record it in the bounded
+        # fault log, never crash the acceptor or spam stderr
+        with suppressed("gateway.connection"):
+            self.finish_request(request, client_address)
+        self.shutdown_request(request)
+
+    def handle_error(self, request, client_address) -> None:  # pragma: no cover
+        pass
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "rca-gateway/1"
+
+    # BaseHTTPRequestHandler logs every request to stderr by default;
+    # the gateway's observability surface is /metrics, not chatter
+    def log_message(self, fmt, *args) -> None:  # noqa: D401
+        pass
+
+    @property
+    def gateway(self) -> "GatewayServer":
+        return self.server.gateway
+
+    # -- response plumbing ---------------------------------------------------
+    def _send_json(
+        self, code: int, body: Dict[str, Any],
+        retry_after: Optional[int] = None,
+    ) -> None:
+        payload = json.dumps(body).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(retry_after))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_text(self, code: int, text: str,
+                   content_type: str = "text/plain; charset=utf-8") -> None:
+        payload = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _route(self, handler: Callable[[], int], route: str) -> None:
+        gw = self.gateway
+        t0 = gw.clock()
+        try:
+            code = handler()
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-write; nothing left to answer
+            self.close_connection = True
+            return
+        except Exception as exc:  # noqa: BLE001 - the wire must answer
+            code = 500
+            try:
+                self._send_json(500, {
+                    "status": "error",
+                    "detail": f"gateway:{type(exc).__name__}",
+                })
+            except OSError:
+                self.close_connection = True
+        gw.metrics.response(route, code, (gw.clock() - t0) * 1e3)
+
+    # -- routes --------------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802 - http.server contract
+        path = urlsplit(self.path).path
+        if path == "/v1/analyze":
+            self._route(self._post_analyze, "analyze")
+        else:
+            self._route(
+                lambda: (self._send_json(
+                    404, {"status": "error", "detail": f"no route {path}"}
+                ) or 404),
+                "unknown",
+            )
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server contract
+        parts = urlsplit(self.path)
+        if parts.path == "/healthz":
+            self._route(self._get_healthz, "healthz")
+        elif parts.path == "/metrics":
+            self._route(self._get_metrics, "metrics")
+        elif parts.path == "/v1/subscribe":
+            self._route(
+                lambda: self._get_subscribe(parse_qs(parts.query)),
+                "subscribe",
+            )
+        else:
+            self._route(
+                lambda: (self._send_json(
+                    404,
+                    {"status": "error", "detail": f"no route {parts.path}"},
+                ) or 404),
+                "unknown",
+            )
+
+    def _post_analyze(self) -> int:
+        gw = self.gateway
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > gw.max_body:
+            # refuse BEFORE reading the flood: backpressure that only
+            # engages after parsing the payload is not backpressure
+            gw.metrics.body_rejected()
+            self.close_connection = True
+            self._send_json(413, {
+                "status": "error",
+                "detail": f"body {length} B over the "
+                f"{gw.max_body} B cap (RCA_GATEWAY_MAX_BODY)",
+            })
+            return 413
+        raw = self.rfile.read(length)
+        try:
+            body = json.loads(raw.decode("utf-8"))
+            kwargs = decode_analyze(
+                body, header_tenant=self.headers.get(TENANT_HEADER)
+            )
+        except (WireError, UnicodeDecodeError,
+                json.JSONDecodeError) as exc:
+            self._send_json(400, {"status": "error", "detail": str(exc)})
+            return 400
+        req = gw.client.submit(**kwargs)
+        try:
+            resp = req.result(gw.timeout_s)
+        except TimeoutError:
+            self._send_json(504, {
+                "status": "error", "request_id": req.request_id,
+                "tenant": req.tenant,
+                "detail": f"not completed within {gw.timeout_s}s",
+            })
+            return 504
+        out = response_body(resp)
+        gw.hub.publish(out)
+        code, retry_after = status_code_for(resp.status)
+        self._send_json(code, out, retry_after=retry_after)
+        return code
+
+    def _get_healthz(self) -> int:
+        health = self.gateway.health()
+        code = 200 if health["ok"] else 503
+        self._send_json(code, health)
+        return code
+
+    def _get_metrics(self) -> int:
+        gw = self.gateway
+        text = render_metrics_text(
+            gw.loop.metrics.summary(),
+            gateway=gw.metrics.snapshot(),
+            healthy=gw.health()["ok"],
+        )
+        self._send_text(200, text,
+                        content_type="text/plain; version=0.0.4")
+        return 200
+
+    def _get_subscribe(self, query: Dict[str, list]) -> int:
+        """Chunked stream: one JSON line per served response.  ``tenant``
+        filters; ``max`` (default 0 = unbounded) ends the stream after N
+        events; ``idle_s`` (default 30) ends it after that long with no
+        event.  The stream also ends when the gateway shuts down."""
+        gw = self.gateway
+        tenant = (query.get("tenant") or [None])[0]
+        try:
+            max_events = int((query.get("max") or ["0"])[0])
+            idle_s = float((query.get("idle_s") or ["30"])[0])
+        except ValueError:
+            self._send_json(400, {
+                "status": "error",
+                "detail": "max/idle_s must be numeric",
+            })
+            return 400
+        sid, q = gw.hub.subscribe(tenant)
+        gw.metrics.stream_opened()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        sent = 0
+        idle = 0.0
+        try:
+            while not gw.closing.is_set():
+                try:
+                    event = q.get(timeout=_STREAM_POLL_S)
+                except queue.Empty:
+                    idle += _STREAM_POLL_S
+                    if idle >= idle_s:
+                        break
+                    continue
+                idle = 0.0
+                self._write_chunk(
+                    json.dumps(event).encode("utf-8") + b"\n"
+                )
+                gw.metrics.stream_event()
+                sent += 1
+                if max_events and sent >= max_events:
+                    break
+            self._write_chunk(b"")   # terminal zero-length chunk
+        finally:
+            gw.hub.unsubscribe(sid)
+            self.close_connection = True
+        return 200
+
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode("ascii"))
+        if data:
+            self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+        self.wfile.flush()
+
+
+class GatewayServer:
+    """The front door over one started serving plane.
+
+    ``loop`` is a started :class:`ServeLoop` or :class:`ServePool` (the
+    gateway does not own its lifecycle — N gateways can front one
+    plane, which is the multi-process stepping stone ROADMAP item 2
+    names).  ``port`` 0 binds an ephemeral port; read ``self.port``."""
+
+    def __init__(
+        self,
+        loop,
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        max_body: Optional[int] = None,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.loop = loop
+        self.client = ServeClient(loop)
+        self.clock = clock
+        self.max_body = int(max_body) if max_body is not None \
+            else gateway_max_body()
+        self.timeout_s = float(timeout_s)
+        self.metrics = GatewayMetrics()
+        self.hub = TickHub()
+        self.closing = threading.Event()
+        sock = make_server_socket(
+            "gateway", host, port if port is not None else gateway_port()
+        )
+        self.host, self.port = bound_address(sock)
+        self._httpd = _GatewayHTTPServer(sock, _Handler, self)
+        self._thread = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- health (breaker-fed, ISSUE 9) ---------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """Liveness from breaker state: a pool is healthy while ANY
+        replica is routable (alive, breaker not open); a single loop
+        while its breaker is not open."""
+        loop = self.loop
+        if hasattr(loop, "replicas"):
+            states = {
+                str(r.replica_id): (
+                    r.breaker.state if r.alive() else "dead"
+                )
+                for r in loop.replicas
+            }
+            ok = any(r.routable() for r in loop.replicas)
+            return {
+                "ok": bool(ok), "replicas": states,
+                "queue_depth": len(loop.queue),
+            }
+        state = loop.breaker.state
+        return {
+            "ok": state != "open", "breaker": state,
+            "queue_depth": len(loop.queue),
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "GatewayServer":
+        if self._thread is None or not self._thread.is_alive():
+            self.closing.clear()
+            self._thread = spawn(
+                self._httpd.serve_forever, name="rca-gateway-accept",
+                daemon=True,
+            )
+        return self
+
+    def close(self) -> None:
+        self.closing.set()           # parked subscribers end their streams
+        if self._thread is not None:
+            # shutdown() parks on serve_forever's exit event — only
+            # meaningful while the acceptor is actually running
+            self._httpd.shutdown()
+            self._thread.join(10.0)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self) -> "GatewayServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

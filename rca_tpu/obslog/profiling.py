@@ -100,6 +100,20 @@ class PhaseStats:
     def count(self, phase: str) -> int:
         return len(self._samples.get(phase, []))
 
+    def phases(self) -> List[str]:
+        return list(self._samples)
+
+    def snapshot(self) -> "PhaseStats":
+        """A deep-copied twin of the current samples.  PhaseStats itself
+        is lock-free by design (per-instance accumulators on one thread);
+        holders that share one across threads (ServeMetrics, the gateway
+        metrics) take the copy UNDER their own lock and derive quantiles
+        off-lock, so an exporter scrape never interleaves with the hot
+        path's appends (ISSUE 9 snapshot-consistency fix)."""
+        twin = PhaseStats()
+        twin._samples = {k: list(v) for k, v in self._samples.items()}
+        return twin
+
 
 @contextlib.contextmanager
 def maybe_jax_profile(tag: str):

@@ -133,62 +133,88 @@ class ServeMetrics:
             rec["rows_saved"] += int(rows_saved)
 
     # -- reporting -----------------------------------------------------------
-    def summary(self) -> Dict[str, object]:
+    def snapshot(self) -> Dict[str, object]:
+        """One CONSISTENT deep copy of all raw state, taken under the
+        lock (ISSUE 9 small fix).  The previous ``summary()`` derived
+        quantiles (per-tenant sorts, per-replica sorts) while HOLDING
+        the lock, so every ``/metrics`` scrape or pool status read
+        blocked the replicas' hot-path counter updates for the whole
+        computation — and any caller reaching into the accumulators
+        directly saw them mid-mutation.  Now the lock covers only the
+        copy; derivation happens on the exporter's thread over data no
+        replica can touch.  Audited with gravelock/rsan
+        (tests/test_gateway.py::test_metrics_snapshot_consistent_under_rsan)."""
         with self._lock:
-            per_tenant = {}
-            # union: a tenant that only ever rode the delta path (direct
-            # dispatcher callers) still shows its reuse counters
-            for tenant in sorted(set(self._counts) | set(self._resident)):
-                counts = self._counts.get(
-                    tenant, {k: 0 for k in _COUNTER_KEYS}
-                )
-                resident = self._resident.get(
-                    tenant, {"delta_requests": 0, "rows_saved": 0}
-                )
-                per_tenant[tenant] = {
-                    **counts,
-                    "queue_ms_p50": self._queue_ms.quantile(tenant, 0.50),
-                    "queue_ms_p99": self._queue_ms.quantile(tenant, 0.99),
-                    "resident_delta_requests": resident["delta_requests"],
-                    "resident_rows_saved": resident["rows_saved"],
-                }
-            occ = list(self._occupancy)
-            occ_sorted = sorted(occ)
-            replicas = {
-                str(rid): {
-                    **rec,
-                    "occupancy_p50": self._replica_occ.quantile(
-                        f"r{rid}", 0.50
-                    ),
-                    "occupancy_max": self._replica_occ.quantile(
-                        f"r{rid}", 1.0
-                    ),
-                }
-                for rid, rec in sorted(self._replicas.items())
-            }
             return {
-                **({
-                    "replicas": replicas,
-                    "steals_total": sum(
-                        r["stolen_from"] for r in self._replicas.values()
-                    ),
-                } if replicas else {}),
-                "tenants": per_tenant,
-                "batches": len(occ),
+                "counts": {t: dict(c) for t, c in self._counts.items()},
+                "queue_ms": self._queue_ms.snapshot(),
+                "occupancy": list(self._occupancy),
+                "depth_peak": self._depth_peak,
                 "dispatched_requests": self.dispatched_requests,
-                "batch_occupancy_mean": (
-                    round(sum(occ) / len(occ), 2) if occ else None
-                ),
-                "batch_occupancy_p50": (
-                    occ_sorted[len(occ_sorted) // 2] if occ_sorted else None
-                ),
-                "batch_occupancy_max": max(occ) if occ else None,
-                "queue_depth_peak": self._depth_peak,
                 "graph_cache": dict(self._graph_cache),
-                "shed_total": sum(
-                    c["shed"] for c in self._counts.values()
-                ),
-                "rejected_total": sum(
-                    c["rejected"] for c in self._counts.values()
-                ),
+                "resident": {
+                    t: dict(r) for t, r in self._resident.items()
+                },
+                "replicas": {
+                    rid: dict(rec)
+                    for rid, rec in self._replicas.items()
+                },
+                "replica_occ": self._replica_occ.snapshot(),
             }
+
+    def summary(self) -> Dict[str, object]:
+        snap = self.snapshot()
+        counts: Dict[str, Dict[str, int]] = snap["counts"]
+        resident: Dict[str, Dict[str, int]] = snap["resident"]
+        queue_ms = snap["queue_ms"]
+        per_tenant = {}
+        # union: a tenant that only ever rode the delta path (direct
+        # dispatcher callers) still shows its reuse counters
+        for tenant in sorted(set(counts) | set(resident)):
+            tcounts = counts.get(tenant, {k: 0 for k in _COUNTER_KEYS})
+            treuse = resident.get(
+                tenant, {"delta_requests": 0, "rows_saved": 0}
+            )
+            per_tenant[tenant] = {
+                **tcounts,
+                "queue_ms_p50": queue_ms.quantile(tenant, 0.50),
+                "queue_ms_p99": queue_ms.quantile(tenant, 0.99),
+                "resident_delta_requests": treuse["delta_requests"],
+                "resident_rows_saved": treuse["rows_saved"],
+            }
+        occ = snap["occupancy"]
+        occ_sorted = sorted(occ)
+        replica_occ = snap["replica_occ"]
+        replicas = {
+            str(rid): {
+                **rec,
+                "occupancy_p50": replica_occ.quantile(f"r{rid}", 0.50),
+                "occupancy_max": replica_occ.quantile(f"r{rid}", 1.0),
+            }
+            for rid, rec in sorted(snap["replicas"].items())
+        }
+        return {
+            **({
+                "replicas": replicas,
+                "steals_total": sum(
+                    r["stolen_from"]
+                    for r in snap["replicas"].values()
+                ),
+            } if replicas else {}),
+            "tenants": per_tenant,
+            "batches": len(occ),
+            "dispatched_requests": snap["dispatched_requests"],
+            "batch_occupancy_mean": (
+                round(sum(occ) / len(occ), 2) if occ else None
+            ),
+            "batch_occupancy_p50": (
+                occ_sorted[len(occ_sorted) // 2] if occ_sorted else None
+            ),
+            "batch_occupancy_max": max(occ) if occ else None,
+            "queue_depth_peak": snap["depth_peak"],
+            "graph_cache": snap["graph_cache"],
+            "shed_total": sum(c["shed"] for c in counts.values()),
+            "rejected_total": sum(
+                c["rejected"] for c in counts.values()
+            ),
+        }

@@ -1,0 +1,82 @@
+"""The ONE place ``rca_tpu/`` constructs sockets.
+
+The gateway (rca_tpu/gateway, SERVING.md §Gateway) is the package's only
+network surface, and its listening sockets are built here for the same
+reasons threads and locks are built in :mod:`rca_tpu.util.threads`:
+
+- **named, attributable resources**: ``make_server_socket("gateway",
+  host, port)`` stamps the purpose into the construction site, so a
+  leaked fd or an address-in-use failure names its owner instead of a
+  bare ``socket.socket`` three frames deep;
+- **one validated construction path**: reuse flags, backlog, and the
+  bind/listen sequence are decided once — every listener behaves the
+  same under restart (``SO_REUSEADDR``) and port-0 ephemeral binding
+  (tests and ``rca serve --listen 127.0.0.1:0`` read the kernel-chosen
+  port back from the returned socket);
+- **lint-enforceable**: the graftlint ``thread-discipline`` rule flags
+  raw ``socket.socket(...)`` construction anywhere else in ``rca_tpu/``,
+  so the seam cannot silently erode (stdlib internals — the HTTP
+  server's accepted connections, ``http.client`` outbound sockets — are
+  library code and out of scope by construction).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Tuple
+
+
+def make_server_socket(
+    name: str,
+    host: str,
+    port: int,
+    backlog: int = 64,
+) -> socket.socket:
+    """A bound, LISTENING TCP socket named for its owner.
+
+    ``port`` 0 binds an ephemeral port — read the kernel's choice back
+    via :func:`bound_address`.  Raises ``OSError`` (address in use,
+    permission) with the owner name prefixed, so the failure is
+    attributable."""
+    if not 0 <= int(port) <= 65535:
+        raise ValueError(f"{name}: port {port} out of range [0, 65535]")
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((host, int(port)))
+        sock.listen(int(backlog))
+    except OSError as exc:
+        sock.close()
+        raise OSError(f"{name}: cannot listen on {host}:{port}: {exc}") from exc
+    return sock
+
+
+def bound_address(sock: socket.socket) -> Tuple[str, int]:
+    """The (host, port) a server socket actually bound — the kernel's
+    choice when the requested port was 0."""
+    host, port = sock.getsockname()[:2]
+    return str(host), int(port)
+
+
+def parse_hostport(spec: str, default_port: int) -> Tuple[str, int]:
+    """``HOST[:PORT]`` → ``(host, port)``; a bare ``:PORT`` listens on
+    all interfaces of localhost's default.  Malformed specs fail loudly."""
+    spec = (spec or "").strip()
+    if not spec:
+        raise ValueError("empty listen address (want HOST:PORT)")
+    if ":" in spec:
+        host, _, port_s = spec.rpartition(":")
+        host = host or "127.0.0.1"
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise ValueError(
+                f"listen address {spec!r}: port {port_s!r} is not an integer"
+            )
+    else:
+        host, port = spec, default_port
+    if not 0 <= port <= 65535:
+        raise ValueError(
+            f"listen address {spec!r}: port {port} out of range [0, 65535]"
+        )
+    return host, port

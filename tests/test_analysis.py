@@ -146,6 +146,7 @@ class Pair:
                 pass
 """, 1),
     "thread-discipline": ("rca_tpu/serve/bad_threads.py", """\
+import socket
 import threading
 
 def main(fn):
@@ -153,7 +154,10 @@ def main(fn):
     t = threading.Thread(target=fn, args=(lock,))  # raw anonymous thread
     t.start()
     return t
-""", 2),
+
+def listener():
+    return socket.socket()         # raw socket outside util/net.py
+""", 3),
     "env-discipline": ("rca_tpu/engine/bad_env.py", """\
 import os
 
@@ -294,6 +298,21 @@ class Worker:
     def bump(self):
         with self._lock:
             self._done += 1
+"""),
+        ("rca_tpu/gateway/good_socket.py", """\
+from rca_tpu.util.net import make_server_socket
+
+def listen(host, port):
+    return make_server_socket("gateway", host, port)  # the seam itself
+"""),
+        ("rca_tpu/util/net.py", """\
+import socket
+
+def make_server_socket(name, host, port):
+    sock = socket.socket()         # legal ONLY in the net seam
+    sock.bind((host, port))
+    sock.listen(8)
+    return sock
 """),
         ("rca_tpu/serve/good_order.py", """\
 from rca_tpu.util.threads import make_lock

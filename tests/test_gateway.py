@@ -1,0 +1,702 @@
+"""ISSUE 9: the wire front door + the replay-driven regression canary.
+
+Covers the gateway contracts:
+
+- wire codec: float32 → JSON → float32 is the identity (the parity
+  argument), malformed bodies fail loudly, the status map is honest;
+- config: ``RCA_GATEWAY_PORT`` / ``RCA_GATEWAY_MAX_BODY`` /
+  ``RCA_CANARY_SAMPLE_RATE`` validation round trips;
+- loopback round-trip BIT parity vs in-process ``ServeClient`` at
+  concurrency 16 (in-process gateway, and a subprocess-spawned
+  ``rca serve --listen`` — the acceptance gate);
+- honest backpressure: queue_full→429 with Retry-After, shed→503,
+  oversized body→413, malformed→400, unknown route→404;
+- chunked streaming subscription drain + tenant filtering;
+- replica-kill under wire load: every request gets a terminal HTTP
+  answer, zero double completions;
+- breaker-fed /healthz and the /metrics exposition;
+- the ServeMetrics consistent-snapshot fix under ``RCA_RSAN=1``;
+- the canary: self-parity on the current build, and a deliberately
+  perturbed scoring config caught at the exact bisected tick (also via
+  the ``rca canary`` CLI exit code).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.generator import synthetic_cascade_arrays
+from rca_tpu.config import (
+    ServeConfig,
+    canary_sample_rate,
+    gateway_max_body,
+    gateway_port,
+)
+from rca_tpu.engine.runner import GraphEngine
+from rca_tpu.gateway import (
+    GatewayClient,
+    GatewayServer,
+    TickHub,
+    WireError,
+    decode_analyze,
+    encode_analyze,
+    status_code_for,
+)
+from rca_tpu.serve import ServeClient, ServeLoop, ServePool, ServeRequest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GraphEngine()
+
+
+@pytest.fixture(scope="module")
+def case():
+    return synthetic_cascade_arrays(48, n_roots=1, seed=3)
+
+
+def _req(tenant="t", n=8, k=3, seed=0, **kw) -> ServeRequest:
+    rng = np.random.default_rng(seed)
+    feats = rng.uniform(0, 1, (n, 4)).astype(np.float32)
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    return ServeRequest(
+        tenant=tenant, features=feats, dep_src=src, dep_dst=dst, k=k, **kw
+    )
+
+
+# -- wire codec ---------------------------------------------------------------
+
+def test_wire_roundtrip_is_float32_identity():
+    rng = np.random.default_rng(0)
+    feats = rng.uniform(0, 1, (17, 4)).astype(np.float32)
+    body = json.loads(json.dumps(encode_analyze(
+        feats, np.arange(16, dtype=np.int32),
+        np.arange(1, 17, dtype=np.int32), names=[f"s{i}" for i in range(17)],
+        tenant="t", k=3, deadline_ms=250.0,
+    )))
+    kwargs = decode_analyze(body)
+    assert kwargs["features"].dtype == np.float32
+    # the parity argument: float32 -> JSON -> float32 is bit-exact
+    assert np.array_equal(kwargs["features"], feats)
+    assert kwargs["tenant"] == "t" and kwargs["k"] == 3
+    assert kwargs["deadline_ms"] == 250.0
+
+
+def test_wire_header_tenant_wins_over_body():
+    body = encode_analyze(np.zeros((2, 2), np.float32), [0], [1],
+                          tenant="body-tenant")
+    kwargs = decode_analyze(body, header_tenant="header-tenant")
+    assert kwargs["tenant"] == "header-tenant"
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda b: b.pop("features"), "features"),
+    (lambda b: b.update(features=[1, 2, 3]), "2-d"),
+    (lambda b: b.update(dep_src=[0, 1]), "equal length"),
+    (lambda b: b.update(priority="urgent"), "priority"),
+    (lambda b: b.update(k=0), "'k'"),
+    (lambda b: b.update(names="not-a-list"), "names"),
+])
+def test_wire_rejects_malformed(mutate, match):
+    body = encode_analyze(np.zeros((2, 2), np.float32), [0], [1])
+    mutate(body)
+    with pytest.raises(WireError, match=match):
+        decode_analyze(body)
+
+
+def test_status_map_is_honest():
+    assert status_code_for("ok") == (200, None)
+    assert status_code_for("degraded") == (200, None)
+    code, retry = status_code_for("queue_full")
+    assert code == 429 and retry >= 1
+    code, retry = status_code_for("shed")
+    assert code == 503 and retry >= 1
+    assert status_code_for("error")[0] == 500
+
+
+# -- config knobs (satellite) -------------------------------------------------
+
+def test_gateway_config_env_round_trip(monkeypatch):
+    monkeypatch.setenv("RCA_GATEWAY_PORT", "9001")
+    monkeypatch.setenv("RCA_GATEWAY_MAX_BODY", "65536")
+    monkeypatch.setenv("RCA_CANARY_SAMPLE_RATE", "0.25")
+    assert gateway_port() == 9001
+    assert gateway_max_body() == 65536
+    assert canary_sample_rate() == 0.25
+
+
+def test_gateway_config_defaults(monkeypatch):
+    for name in ("RCA_GATEWAY_PORT", "RCA_GATEWAY_MAX_BODY",
+                 "RCA_CANARY_SAMPLE_RATE"):
+        monkeypatch.delenv(name, raising=False)
+    assert gateway_port() == 8321
+    assert gateway_max_body() == 8 * 1024 * 1024
+    assert canary_sample_rate() == 1.0
+
+
+@pytest.mark.parametrize("name,bad", [
+    ("RCA_GATEWAY_PORT", "70000"),
+    ("RCA_GATEWAY_PORT", "abc"),
+    ("RCA_GATEWAY_MAX_BODY", "10"),
+    ("RCA_CANARY_SAMPLE_RATE", "1.5"),
+    ("RCA_CANARY_SAMPLE_RATE", "often"),
+])
+def test_gateway_config_rejects_bad_env(monkeypatch, name, bad):
+    monkeypatch.setenv(name, bad)
+    with pytest.raises(ValueError):
+        {"RCA_GATEWAY_PORT": gateway_port,
+         "RCA_GATEWAY_MAX_BODY": gateway_max_body,
+         "RCA_CANARY_SAMPLE_RATE": canary_sample_rate}[name]()
+
+
+# -- loopback parity (the tentpole gate) -------------------------------------
+
+def test_wire_parity_vs_inprocess_concurrency_16(engine, case):
+    """Concurrency-16 loopback load: every wire ranking is bit-identical
+    to the in-process ServeClient submission AND to a solo analysis."""
+    rng = np.random.default_rng(1)
+    feats = [
+        np.clip(case.features + rng.uniform(
+            0, 0.05, case.features.shape
+        ).astype(np.float32), 0, 1)
+        for _ in range(16)
+    ]
+    loop = ServeLoop(engine=engine).start()
+    try:
+        with GatewayServer(loop, port=0) as gw:
+            cl = GatewayClient(gw.host, gw.port)
+            wire: list = [None] * 16
+
+            def worker(i: int) -> None:
+                code, body, _ = cl.analyze(
+                    feats[i], case.dep_src, case.dep_dst,
+                    names=case.names, tenant=f"t{i % 4}", k=3,
+                )
+                wire[i] = (code, body)
+
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(16)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            inproc = ServeClient(loop)
+            for i, f in enumerate(feats):
+                code, body = wire[i]
+                assert code == 200 and body["status"] == "ok"
+                assert body["degraded"] is False
+                resp = inproc.analyze(
+                    f, case.dep_src, case.dep_dst, names=case.names,
+                    tenant="oracle", k=3,
+                )
+                assert body["ranked"] == resp.ranked
+                solo = engine.analyze_arrays(
+                    f, case.dep_src, case.dep_dst, case.names, k=3
+                )
+                assert body["ranked"] == solo.ranked
+    finally:
+        loop.stop()
+
+
+# -- honest backpressure ------------------------------------------------------
+
+def test_backpressure_429_503_413_400_404(engine, case):
+    """Queue at capacity → 429 + Retry-After; expired deadline → 503;
+    oversized body → 413; malformed body → 400; unknown route → 404.
+    The loop is deliberately NOT started, so the queue stays saturated
+    and every outcome completes synchronously at admission."""
+    loop = ServeLoop(engine=engine, config=ServeConfig(queue_cap=2))
+    with GatewayServer(loop, port=0, max_body=256 * 1024) as gw:
+        cl = GatewayClient(gw.host, gw.port)
+        # saturate the queue in-process (these requests stay parked —
+        # the loop never runs)
+        for i in range(2):
+            assert loop.submit(_req(seed=i))
+        code, body, headers = cl.analyze(
+            case.features, case.dep_src, case.dep_dst, k=3,
+        )
+        assert code == 429
+        assert body["status"] == "queue_full"
+        assert int(headers.get("Retry-After", 0)) >= 1
+        # deadline already expired -> shed at admission -> 503
+        code, body, headers = cl.analyze(
+            case.features, case.dep_src, case.dep_dst, k=3,
+            deadline_ms=-1.0,
+        )
+        assert code == 503
+        assert body["status"] == "shed"
+        assert int(headers.get("Retry-After", 0)) >= 1
+        # oversized body refused before parsing
+        big = np.zeros((600, 128), np.float32)
+        code, body, _ = cl.analyze(big, [0], [1], k=3)
+        assert code == 413
+        assert "RCA_GATEWAY_MAX_BODY" in body["detail"]
+        # malformed JSON -> 400; unknown route -> 404
+        conn = http.client.HTTPConnection(gw.host, gw.port, timeout=10)
+        try:
+            conn.request("POST", "/v1/analyze", body=b"{not json",
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 400
+            resp.read()
+        finally:
+            conn.close()
+        conn = http.client.HTTPConnection(gw.host, gw.port, timeout=10)
+        try:
+            conn.request("GET", "/v1/nope")
+            resp = conn.getresponse()
+            assert resp.status == 404
+            resp.read()
+        finally:
+            conn.close()
+        snap = gw.metrics.snapshot()
+        assert snap["body_rejections"] == 1
+        assert snap["requests"][("analyze", 429)] == 1
+        assert snap["requests"][("analyze", 503)] == 1
+
+
+# -- streaming subscriptions --------------------------------------------------
+
+def test_streaming_subscription_drain(engine, case):
+    """Open a chunked subscription, serve N requests, and drain exactly
+    the matching events (tenant filter included)."""
+    loop = ServeLoop(engine=engine).start()
+    try:
+        with GatewayServer(loop, port=0) as gw:
+            cl = GatewayClient(gw.host, gw.port)
+            got: list = []
+            ready = threading.Event()
+
+            def subscriber() -> None:
+                ready.set()
+                for ev in cl.subscribe(tenant="watch-me", max_events=3,
+                                       idle_s=20.0, timeout_s=60.0):
+                    got.append(ev)
+
+            t = threading.Thread(target=subscriber)
+            t.start()
+            ready.wait(10.0)
+            # subscription registration races the first publish; wait
+            # until the hub actually holds the subscriber
+            for _ in range(100):
+                if gw.hub.subscriber_count():
+                    break
+                threading.Event().wait(0.05)
+            for i in range(3):
+                code, _, _ = cl.analyze(
+                    case.features, case.dep_src, case.dep_dst, k=3,
+                    tenant="watch-me",
+                )
+                assert code == 200
+                # an event for a DIFFERENT tenant must not reach this
+                # subscriber
+                cl.analyze(case.features, case.dep_src, case.dep_dst,
+                           k=3, tenant="other")
+            t.join(30.0)
+            assert not t.is_alive()
+            assert len(got) == 3
+            assert all(ev["tenant"] == "watch-me" for ev in got)
+            assert all(ev["status"] == "ok" for ev in got)
+            assert gw.metrics.snapshot()["stream_events"] == 3
+    finally:
+        loop.stop()
+
+
+def test_tickhub_slow_subscriber_drops_never_blocks():
+    hub = TickHub()
+    sid, q = hub.subscribe()
+    for i in range(hub.QUEUE_CAP + 5):
+        hub.publish({"tenant": "t", "i": i})
+    assert q.qsize() == hub.QUEUE_CAP
+    assert hub.dropped == 5
+    hub.unsubscribe(sid)
+    hub.publish({"tenant": "t"})   # no subscriber: no-op, no raise
+
+
+# -- failover under wire load -------------------------------------------------
+
+class _StubDispatcher:
+    engine = None
+    engine_tag = "stub"
+
+    def __init__(self):
+        self.graphs = set()
+
+    def has_graph(self, key):
+        return key in self.graphs
+
+    def dispatch(self, batch, now=None):
+        self.graphs.add(batch[0].graph_key)
+
+        class _H:
+            requests = list(batch)
+            dispatched_at = now if now is not None else 0.0
+
+        return _H()
+
+    def fetch(self, handle):
+        class _R:
+            ranked = [{"component": "svc", "score": 1.0}]
+            engine = "stub"
+            score = np.ones(1, np.float32)
+
+        return [_R() for _ in handle.requests]
+
+
+def test_replica_kill_under_wire_load():
+    """Kill a replica while wire load is in flight: every HTTP request
+    gets a terminal answer (answered-or-shed as status codes) and
+    completion stays exactly-once."""
+    pool = ServePool(
+        dispatchers=[_StubDispatcher() for _ in range(3)],
+        config=ServeConfig(replicas=3, max_wait_us=0),
+    ).start()
+    try:
+        with GatewayServer(pool, port=0) as gw:
+            cl = GatewayClient(gw.host, gw.port, timeout_s=90.0)
+            codes: list = []
+            codes_lock = threading.Lock()
+
+            def worker(w: int) -> None:
+                rng = np.random.default_rng(w)
+                for i in range(6):
+                    feats = rng.uniform(
+                        0, 1, (8 + 8 * (w % 2), 4)
+                    ).astype(np.float32)
+                    src = np.arange(feats.shape[0] - 1, dtype=np.int32)
+                    dst = np.arange(1, feats.shape[0], dtype=np.int32)
+                    if w == 0 and i == 3:
+                        pool.replicas[0].kill()
+                    code, body, _ = cl.analyze(
+                        feats, src, dst, tenant=f"t{w % 3}", k=2,
+                    )
+                    with codes_lock:
+                        codes.append((code, body["status"]))
+
+            threads = [
+                threading.Thread(target=worker, args=(w,))
+                for w in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(codes) == 48
+            # terminal, honest outcomes only — never a hang, never a 504
+            assert all(code in (200, 500, 503) for code, _ in codes)
+            assert pool.sink.double_completions == 0
+            # the plane survived: most of the load was served
+            assert sum(1 for code, _ in codes if code == 200) >= 40
+            code, health = cl.healthz()
+            assert code == 200   # survivors keep the plane routable
+            assert health["replicas"]["0"] == "dead"
+    finally:
+        pool.stop()
+
+
+# -- healthz + metrics --------------------------------------------------------
+
+def test_healthz_maps_breaker_and_death(engine):
+    import time as _time
+
+    from rca_tpu.resilience.policy import CircuitBreaker
+
+    loop = ServeLoop(engine=engine, breaker=CircuitBreaker(
+        failure_threshold=3, reset_after=3600.0, clock=_time.monotonic,
+        name="test.gateway.breaker",
+    ))
+    with GatewayServer(loop, port=0) as gw:
+        cl = GatewayClient(gw.host, gw.port)
+        code, health = cl.healthz()
+        assert code == 200 and health["ok"] and health["breaker"] == "closed"
+        # force the breaker open: health must go 503 (reset_after is an
+        # hour, so the probe window cannot flip it back mid-test)
+        for _ in range(5):
+            loop.breaker.record_failure()
+        code, health = cl.healthz()
+        assert code == 503 and not health["ok"]
+        assert health["breaker"] == "open"
+    pool = ServePool(
+        dispatchers=[_StubDispatcher() for _ in range(2)],
+        config=ServeConfig(replicas=2, max_wait_us=0),
+    )
+    with GatewayServer(pool, port=0) as gw:
+        cl = GatewayClient(gw.host, gw.port)
+        assert cl.healthz()[0] == 200
+        for r in pool.replicas:
+            r.kill()
+        code, health = cl.healthz()
+        assert code == 503
+        assert set(health["replicas"].values()) == {"dead"}
+
+
+def test_metrics_endpoint_exports_tenant_and_replica_rows(case):
+    pool = ServePool(
+        dispatchers=[_StubDispatcher() for _ in range(2)],
+        config=ServeConfig(replicas=2, max_wait_us=0),
+    ).start()
+    try:
+        with GatewayServer(pool, port=0) as gw:
+            cl = GatewayClient(gw.host, gw.port)
+            for i in range(4):
+                code, _, _ = cl.analyze(
+                    case.features, case.dep_src, case.dep_dst,
+                    tenant=f"tenant-{i % 2}", k=3,
+                )
+                assert code == 200
+            text = cl.metrics_text()
+            assert ('rca_serve_requests_total{outcome="answered",'
+                    'tenant="tenant-0"}') in text
+            assert 'rca_serve_replica_requests_total{replica="0"}' in text
+            assert 'rca_serve_replica_state{replica="1"' in text
+            assert ('rca_gateway_requests_total{code="200",'
+                    'route="analyze"} 4') in text
+            assert "rca_gateway_up 1" in text
+    finally:
+        pool.stop()
+
+
+# -- ServeMetrics consistent snapshot under rsan (small fix) ------------------
+
+def test_metrics_snapshot_consistent_under_rsan():
+    """Regression for the ISSUE 9 small fix: 8 writer threads hammer
+    every ServeMetrics surface while a reader snapshots concurrently —
+    each snapshot must be internally CONSISTENT (the invariants that
+    hold under the lock hold in the copy), rsan observes no races, and
+    the metrics lock really was contended across threads."""
+    from rca_tpu.analysis.concurrency import rsan
+    from rca_tpu.serve.metrics import ServeMetrics
+
+    was = rsan.enabled()
+    rsan.enable()
+    rsan.RSAN.reset()
+    try:
+        metrics = ServeMetrics()
+
+        def writer(w: int) -> None:
+            tenant = f"t{w % 3}"
+            for i in range(300):
+                metrics.submitted(tenant, i % 7)
+                metrics.answered(tenant, float(i % 11))
+                metrics.record_batch(1 + i % 4)
+                metrics.replica_occupancy(w % 2, i % 5)
+                metrics.replica_batch(w % 2, 1 + i % 4)
+                if i % 50 == 0:
+                    metrics.stolen(w % 2, (w + 1) % 2, 1)
+
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(8)
+        ]
+        for t in threads:
+            t.start()
+        bad = []
+        while any(t.is_alive() for t in threads):
+            snap = metrics.snapshot()
+            # invariants maintained under one lock must survive the copy
+            if sum(snap["occupancy"]) != snap["dispatched_requests"]:
+                bad.append("occupancy-vs-dispatched")
+            for tenant, counts in snap["counts"].items():
+                if snap["queue_ms"].count(tenant) != counts["answered"]:
+                    bad.append(f"queue-samples-vs-answered:{tenant}")
+            summary = metrics.summary()   # derives OFF-lock, no raise
+            assert isinstance(summary["tenants"], dict)
+        for t in threads:
+            t.join()
+        assert not bad, bad
+        final = metrics.snapshot()
+        assert sum(final["occupancy"]) == final["dispatched_requests"]
+        assert final["dispatched_requests"] == 8 * sum(
+            1 + i % 4 for i in range(300)
+        )
+        assert rsan.RSAN.races_observed() == []
+        lt = rsan.RSAN.lock_threads()
+        assert len(lt.get("ServeMetrics._lock", ())) >= 2
+    finally:
+        rsan.RSAN.reset()
+        if not was:
+            rsan.disable()
+
+
+# -- canary -------------------------------------------------------------------
+
+def test_canary_self_parity_and_store_refs(tmp_path):
+    """Current-build canary: sampling mints replayable recordings,
+    stamps recording_refs, and parity holds (the regression stream's
+    steady state)."""
+    from rca_tpu.gateway import run_canary
+    from rca_tpu.replay import load_recording
+    from rca_tpu.store import InvestigationStore
+
+    store = InvestigationStore(root=str(tmp_path / "logs"))
+    report = run_canary(
+        str(tmp_path / "corpus"), rounds=1, ticks=6, services=12,
+        seed=5, mode="both", store=store, serve_requests=4,
+    )
+    assert report["ok"], report
+    assert report["sampled"] == 2      # one stream + one serve leg
+    assert {r["mode"] for r in report["recordings"]} == {
+        "stream", "serve",
+    }
+    listed = store.list_investigations()
+    assert len(listed) == 2 and all(i["replayable"] for i in listed)
+    ref = store.get_recording_ref(listed[0]["id"])
+    assert ref and load_recording(ref).clean_close
+
+
+def test_canary_sample_rate_zero_samples_nothing(tmp_path):
+    from rca_tpu.gateway import run_canary
+
+    report = run_canary(
+        str(tmp_path / "corpus"), rounds=3, ticks=4, services=8,
+        seed=0, sample_rate=0.0,
+    )
+    assert report["ok"]                # vacuously: nothing to replay
+    assert report["sampled"] == 0 and report["skipped"] == 3
+
+
+def test_canary_catches_perturbed_config_at_bisected_tick(tmp_path):
+    """The acceptance gate: a deliberately perturbed scoring config
+    diverges, the canary fails, and the tick it names IS the exact tick
+    an independent bisect localizes."""
+    from rca_tpu.gateway import build_candidate_engine, run_canary
+    from rca_tpu.replay import bisect_divergence
+
+    candidate, info = build_candidate_engine(decay=0.5)
+    assert info["param_overrides"] == {"decay": 0.5}
+    report = run_canary(
+        str(tmp_path / "corpus"), rounds=1, ticks=8, services=12,
+        seed=3, mode="stream", candidate=candidate,
+        candidate_info=info,
+    )
+    assert not report["ok"]
+    assert report["first_divergence"] is not None
+    named = report["first_divergence"]["tick"]
+    entry = report["recordings"][0]
+    assert entry["parity_ok"] is False
+    assert entry["first_divergent_tick"] == named
+    assert os.path.exists(entry["dump"])
+    # the exactness claim: an independent bisect of the same recording
+    # against the same candidate names the same tick
+    independent = bisect_divergence(
+        entry["recording"], engine=candidate,
+        dump_path=str(tmp_path / "dump.json"),
+    )
+    assert independent["divergent"]
+    assert independent["first_divergent_tick"] == named
+
+
+def test_canary_cli_exits_nonzero_on_divergence(tmp_path, capsys):
+    """`rca canary` against a perturbed candidate exits nonzero and the
+    report names the divergent tick (acceptance criterion)."""
+    from rca_tpu.cli import main
+
+    rc = main([
+        "canary", "--out", str(tmp_path / "corpus"),
+        "--rounds", "1", "--ticks", "6", "--fixture", "12svc",
+        "--seed", "4", "--candidate-decay", "0.45",
+        "--log-dir", str(tmp_path / "logs"), "--compact",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    report = json.loads(out)
+    assert not report["ok"]
+    assert isinstance(report["first_divergence"]["tick"], int)
+    # and the clean run exits 0, growing the same corpus dir
+    rc = main([
+        "canary", "--out", str(tmp_path / "corpus2"),
+        "--rounds", "1", "--ticks", "6", "--fixture", "12svc",
+        "--seed", "4", "--no-store", "--compact",
+    ])
+    assert rc == 0
+
+
+# -- subprocess `rca serve --listen` (the acceptance gate) --------------------
+
+def test_subprocess_listen_wire_parity(tmp_path, engine, case):
+    """Spawn `rca serve --listen 127.0.0.1:0` as a real subprocess,
+    drive a concurrency-16 loopback load, and assert bitwise ranking
+    parity vs in-process analysis.  SIGTERM shuts it down cleanly."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RCA_SHARD"] = "off"   # dense engine: bitwise parity is
+    #                            like-for-like vs the local GraphEngine
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rca_tpu", "serve",
+         "--listen", "127.0.0.1:0", "--max-batch", "8",
+         "--log-dir", str(tmp_path / "logs")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env, cwd=REPO_ROOT,
+    )
+    try:
+        banner: list = []
+
+        def read_banner() -> None:
+            banner.append(proc.stdout.readline())
+
+        reader = threading.Thread(target=read_banner)
+        reader.start()
+        reader.join(180.0)
+        assert banner and banner[0], (
+            f"no listen banner; stderr: {proc.stderr.read()[-2000:]}"
+            if proc.poll() is not None else "gateway did not report in"
+        )
+        info = json.loads(banner[0])
+        host, port = info["listening"].rsplit(":", 1)
+        assert info["endpoints"] == [
+            "/v1/analyze", "/v1/subscribe", "/metrics", "/healthz",
+        ]
+        cl = GatewayClient(host, int(port), timeout_s=120.0)
+        rng = np.random.default_rng(2)
+        feats = [
+            np.clip(case.features + rng.uniform(
+                0, 0.05, case.features.shape
+            ).astype(np.float32), 0, 1)
+            for _ in range(16)
+        ]
+        results: list = [None] * 16
+
+        def worker(i: int) -> None:
+            results[i] = cl.analyze(
+                feats[i], case.dep_src, case.dep_dst,
+                names=case.names, tenant=f"t{i % 4}", k=3,
+            )
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, f in enumerate(feats):
+            code, body, _ = results[i]
+            assert code == 200, body
+            solo = engine.analyze_arrays(
+                f, case.dep_src, case.dep_dst, case.names, k=3
+            )
+            # bitwise ranking parity ACROSS THE PROCESS BOUNDARY
+            assert body["ranked"] == solo.ranked
+        code, health = cl.healthz()
+        assert code == 200 and health["ok"]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(60.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(10.0)
+    assert proc.returncode == 0
