@@ -71,11 +71,12 @@ def replay_metrics(n_services: int = 50, ticks: int = 40) -> dict:
     from rca_tpu.engine.live import LiveStreamingSession
     from rca_tpu.replay import Recorder, replay_stream
 
-    def run_session(recorder=None):
+    def run_session(recorder=None, use_columnar=True):
         world = synthetic_cascade_world(n_services, n_roots=1, seed=0)
         sess = LiveStreamingSession(
             MockClusterClient(world), "synthetic", k=5,
             topology_check_every=10, recorder=recorder,
+            use_columnar=use_columnar,
         )
         times = []
         rng = np.random.default_rng(1)
@@ -93,11 +94,20 @@ def replay_metrics(n_services: int = 50, ticks: int = 40) -> dict:
     plain_ms = run_session()
     tmp = tempfile.mkdtemp(prefix="rca_replay_bench_")
     rec_path = f"{tmp}/rec"
+    rec_path_dict = f"{tmp}/rec_dict"
     try:
         recorder = Recorder(rec_path)
         recorded_ms = run_session(recorder)
         recorder.close()
         bytes_per_tick = recorder.bytes_written / max(1, ticks)
+        # dict-path twin (ISSUE 10): same world/schedule recorded through
+        # the per-object capture path — the coldiff frames' byte and
+        # overhead delta is reported side by side
+        plain_dict_ms = run_session(use_columnar=False)
+        recorder_d = Recorder(rec_path_dict)
+        recorded_dict_ms = run_session(recorder_d, use_columnar=False)
+        recorder_d.close()
+        bytes_per_tick_dict = recorder_d.bytes_written / max(1, ticks)
         t0 = time.perf_counter()
         report = replay_stream(rec_path)
         replay_s = time.perf_counter() - t0
@@ -108,7 +118,15 @@ def replay_metrics(n_services: int = 50, ticks: int = 40) -> dict:
             "record_overhead_pct": round(
                 100.0 * (recorded_ms - plain_ms) / max(plain_ms, 1e-9), 1
             ),
+            "record_overhead_pct_dict": round(
+                100.0 * (recorded_dict_ms - plain_dict_ms)
+                / max(plain_dict_ms, 1e-9), 1
+            ),
             "log_bytes_per_tick": round(bytes_per_tick, 1),
+            "log_bytes_per_tick_dict": round(bytes_per_tick_dict, 1),
+            "coldiff_bytes_ratio": round(
+                bytes_per_tick / max(bytes_per_tick_dict, 1e-9), 3
+            ),
             "replay_ticks_per_sec": round(
                 report["ticks_replayed"] / max(replay_s, 1e-9), 1
             ),
@@ -117,6 +135,143 @@ def replay_metrics(n_services: int = 50, ticks: int = 40) -> dict:
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def columnar_capture_metrics(n_services: int = 20_000,
+                             pods_per_service: int = 5) -> dict:
+    """Columnar world state at 100k pods (ISSUE 10 tentpole gate).
+
+    Capture-layer measurements (no engine: the tick executables are
+    benched elsewhere and a 20k-service XLA compile would only blur the
+    capture numbers this section exists to isolate):
+
+    - steady columnar sweep (capture + vectorized extract) vs ONE dict
+      sweep over the same world — the O(dirty rows) vs O(objects) claim;
+    - busy capture after journaled churn, and the quiet-feed drain cost
+      (sweep-vs-quiet ratio);
+    - recorded bytes/tick for busy columnar captures (coldiff frames);
+    - BIT parity columnar-vs-dict asserted on the full 100k-pod
+      FeatureSet in this same run (a fast capture that changed one bit
+      would be measuring nothing).
+    """
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from rca_tpu.cluster.columnar import ColumnarClientState
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+    from rca_tpu.features.extract import extract_features
+    from rca_tpu.replay import Recorder
+
+    ns = "col100k"
+    t0 = time.perf_counter()
+    world = synthetic_cascade_world(
+        n_services, n_roots=3, seed=2, namespace=ns,
+        pods_per_service=pods_per_service,
+    )
+    build_s = time.perf_counter() - t0
+    n_pods = sum(len(v) for v in world.pods.values())
+    client = MockClusterClient(world)
+    state = ColumnarClientState()
+
+    t0 = time.perf_counter()
+    snap = ClusterSnapshot.capture(client, ns, columnar_state=state)
+    first_capture_s = time.perf_counter() - t0  # includes the table build
+
+    sweep_ms = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        snap = ClusterSnapshot.capture(client, ns, columnar_state=state)
+        fs_col = extract_features(snap)
+        sweep_ms.append((time.perf_counter() - t0) * 1e3)
+
+    # ONE dict sweep for the ratio + the parity gate (bitwise, full set)
+    t0 = time.perf_counter()
+    snap_d = ClusterSnapshot.capture(client, ns, columnar=False)
+    fs_dict = extract_features(snap_d)
+    dict_sweep_ms = (time.perf_counter() - t0) * 1e3
+    parity_ok = (
+        fs_col.pod_names == fs_dict.pod_names
+        and fs_col.service_names == fs_dict.service_names
+        and fs_col.pod_features.tobytes() == fs_dict.pod_features.tobytes()
+        and fs_col.service_features.tobytes()
+        == fs_dict.service_features.tobytes()
+        and fs_col.memb_pod.tobytes() == fs_dict.memb_pod.tobytes()
+        and fs_col.memb_svc.tobytes() == fs_dict.memb_svc.tobytes()
+        and fs_col.pod_service.tobytes() == fs_dict.pod_service.tobytes()
+        and fs_col.pod_node.tobytes() == fs_dict.pod_node.tobytes()
+    )
+    assert parity_ok, "columnar-vs-dict bit parity FAILED at 100k pods"
+
+    # quiet feed drain (what a no-change poll costs the capture layer)
+    cursor = client.watch_changes(ns, None)["cursor"]
+    quiet_ms = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        resp = client.watch_changes(ns, cursor)
+        cursor = resp["cursor"]
+        quiet_ms.append((time.perf_counter() - t0) * 1e3)
+
+    # busy capture + recorded bytes/tick: journaled churn, coldiff frames
+    rng = np.random.default_rng(3)
+    tmp = tempfile.mkdtemp(prefix="rca_col_bench_")
+    try:
+        recorder = Recorder(f"{tmp}/rec")
+        rec_client = recorder.wrap_client(client)
+        rec_state = ColumnarClientState()
+        recorder.begin_tick(0)
+        snap_b = ClusterSnapshot.capture(
+            rec_client, ns, columnar_state=rec_state,
+        )
+        bootstrap_bytes = recorder.bytes_written
+        busy_ms = []
+        pod_names_flat = [
+            p["metadata"]["name"] for p in world.pods[ns]
+        ]
+        busy_ticks = 10
+        for t in range(1, busy_ticks + 1):
+            for _ in range(20):
+                world.touch(
+                    "pod_metrics", ns,
+                    pod_names_flat[int(rng.integers(0, n_pods))],
+                )
+            recorder.begin_tick(t)
+            t0 = time.perf_counter()
+            # traces carry forward on un-journaled busy polls — the live
+            # session's contract; re-fetching (and re-recording) the 20k
+            # trace payloads per tick would swamp the coldiff bytes
+            snap_b = ClusterSnapshot.capture(
+                rec_client, ns, columnar_state=rec_state,
+                traces_from=snap_b.traces,
+            )
+            extract_features(snap_b)
+            busy_ms.append((time.perf_counter() - t0) * 1e3)
+        recorder.close()
+        delta_bytes = recorder.bytes_written - bootstrap_bytes
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    sweep = float(np.median(sweep_ms))
+    quiet = float(np.median(quiet_ms))
+    return {
+        "n_pods": int(n_pods),
+        "n_services": int(n_services),
+        "world_build_s": round(build_s, 2),
+        "table_build_first_capture_s": round(first_capture_s, 2),
+        "sweep_capture_ms": round(sweep, 2),
+        "dict_sweep_capture_ms": round(dict_sweep_ms, 2),
+        "sweep_speedup_vs_dict": round(dict_sweep_ms / max(sweep, 1e-9), 1),
+        "busy_capture_ms_20dirty": round(float(np.median(busy_ms)), 2),
+        "quiet_feed_drain_ms": round(quiet, 3),
+        "sweep_vs_quiet_ratio": round(sweep / max(quiet, 1e-3), 1),
+        "record_bytes_per_tick": round(delta_bytes / busy_ticks, 1),
+        "record_bootstrap_bytes": int(bootstrap_bytes),
+        "parity_ok_100k": bool(parity_ok),
+    }
 
 
 def lint_metrics() -> dict:
@@ -1300,6 +1455,7 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
     # already measured as tick_ms_10k above)
     from rca_tpu.cluster.generator import synthetic_cascade_world
     from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
     from rca_tpu.engine import LiveStreamingSession
 
     lw = synthetic_cascade_world(10_000, n_roots=3, seed=1,
@@ -1310,13 +1466,39 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
     )
     lsess.poll()  # warm the tick executable
     quiet_caps = [lsess.poll()["capture_ms"] for _ in range(5)]
+    # sweep sessions ride the columnar tables by default since ISSUE 10;
+    # the dict twin below is the pre-columnar baseline measured in the
+    # SAME run, with bit parity of the two extraction paths asserted on
+    # this same world (a fast sweep that moved one bit measures nothing)
     sweep_sess = LiveStreamingSession(
         lclient, "live10k", k=5, use_watch=False,
         topology_check_every=10_000,
     )
     sweep_caps = [sweep_sess.poll()["capture_ms"] for _ in range(3)]
+    sweep_sess_dict = LiveStreamingSession(
+        lclient, "live10k", k=5, use_watch=False,
+        topology_check_every=10_000, engine=sweep_sess.engine,
+        use_columnar=False,
+    )
+    sweep_caps_dict = [
+        sweep_sess_dict.poll()["capture_ms"] for _ in range(3)
+    ]
+    from rca_tpu.features.extract import extract_features as _exf
+
+    _snap_c = ClusterSnapshot.capture(lclient, "live10k")
+    _snap_d = ClusterSnapshot.capture(lclient, "live10k", columnar=False)
+    _fs_c, _fs_d = _exf(_snap_c), _exf(_snap_d)
+    columnar_parity_10k = (
+        _snap_c.columnar is not None
+        and _fs_c.pod_features.tobytes() == _fs_d.pod_features.tobytes()
+        and _fs_c.service_features.tobytes()
+        == _fs_d.service_features.tobytes()
+    )
+    assert columnar_parity_10k, "columnar-vs-dict parity FAILED at 10k"
+    del _snap_c, _snap_d, _fs_c, _fs_d
     live_quiet_ms = float(np.median(quiet_caps))
     live_sweep_ms = float(np.median(sweep_caps))
+    live_sweep_dict_ms = float(np.median(sweep_caps_dict))
 
     # forced feed expiry at 10k (VERDICT r3 item 6): trim the journal past
     # the session's cursor and measure the GRACEFUL recovery capture — one
@@ -1448,6 +1630,21 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
     except Exception as exc:
         gateway_line = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- columnar world state (ISSUE 10): 100k-pod capture, columnar vs
+    # dict sweep, coldiff bytes/tick, bit parity asserted in-run
+    try:
+        columnar_line = columnar_capture_metrics()
+    except Exception as exc:
+        columnar_line = {"error": f"{type(exc).__name__}: {exc}"}
+    columnar_line.update({
+        "live_sweep_capture_ms_10k_columnar": round(live_sweep_ms, 3),
+        "live_sweep_capture_ms_10k_dict": round(live_sweep_dict_ms, 3),
+        "sweep_speedup_10k": round(
+            live_sweep_dict_ms / max(live_sweep_ms, 1e-9), 1
+        ),
+        "parity_ok_10k": bool(columnar_parity_10k),
+    })
+
     # -- accuracy under adversarial cascade modes (VERDICT round-1 item 3):
     # (skippable with --skip-accuracy when only the latency numbers are
     # wanted — this block trains a model and runs ~360 extra analyses)
@@ -1561,7 +1758,12 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         "tick_phases_10k_pipelined": phase_medians(pipe_phases),
         "tick_upload_rows_10k": tick_upload_rows,
         "live_quiet_capture_ms_10k": round(live_quiet_ms, 3),
+        # columnar since round 10 (ISSUE 10) — the dict baseline and the
+        # in-run parity gate live in the columnar_capture section
         "live_sweep_capture_ms_10k": round(live_sweep_ms, 3),
+        # columnar world state (ISSUE 10): 100k-pod capture + coldiff
+        # bytes/tick + columnar-vs-dict sweep ratio and parity bits
+        "columnar_capture": columnar_line,
         "live_recovery_capture_ms_10k": round(live_recovery_ms, 3),
         "live_recovery_graceful": live_recovered,
         "sharded_stream_tick_50k_dryrun": shard_tick,

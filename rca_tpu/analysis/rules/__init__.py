@@ -5,6 +5,7 @@ helpers).  A new invariant is a new module here with a ``@register``
 class — see ANALYSIS.md for the authoring contract.
 """
 
+from rca_tpu.analysis.rules import dictscan       # noqa: F401
 from rca_tpu.analysis.rules import env            # noqa: F401
 from rca_tpu.analysis.rules import faults         # noqa: F401
 from rca_tpu.analysis.rules import gravelock      # noqa: F401

@@ -52,6 +52,9 @@ REPLAY_SCOPE = (
     "rca_tpu/cluster/mock_client.py",
     "rca_tpu/cluster/world.py",
     "rca_tpu/cluster/snapshot.py",
+    # columnar tables (ISSUE 10): coldiff frames replay the row writes,
+    # so the whole module is clock-free by construction
+    "rca_tpu/cluster/columnar.py",
     "rca_tpu/features/extract.py",
     "rca_tpu/resilience/chaos.py",
     "rca_tpu/resilience/policy.py",

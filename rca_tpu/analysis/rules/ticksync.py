@@ -30,6 +30,9 @@ TICK_MODULES = {
     "rca_tpu/engine/live.py": set(),
     "rca_tpu/features/extract.py": set(),
     "rca_tpu/cluster/snapshot.py": set(),
+    # columnar capture (ISSUE 10) is pure host-side table work — it may
+    # never synchronize with the device
+    "rca_tpu/cluster/columnar.py": set(),
     "rca_tpu/serve/dispatcher.py": {"fetch"},
     "rca_tpu/serve/loop.py": set(),
     "rca_tpu/serve/queue.py": set(),

@@ -204,6 +204,24 @@ class MockClusterClient:
         ops = self.world.traces.get("slow_ops", {}).get(namespace, [])
         return [op for op in ops if op.get("duration_ms", 0) >= threshold_ms]
 
+    # ---- columnar capture surface (ISSUE 10) ------------------------------
+    def get_columnar(
+        self, namespace: str, cursor: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Columnar world-state feed: the full table dump on a fresh (or
+        expired) cursor, column-diff row ops after.  The journal that
+        backs ``watch_changes`` drives the row writes, so the two feeds
+        expire together and a recorded session replays both
+        deterministically.  ``supported: False`` (degenerate world —
+        duplicate object names) sends the caller back to the dict scans."""
+        from rca_tpu.cluster.columnar import ColumnarWorld
+
+        master = self.world._columnar.get(namespace)
+        if master is None:
+            master = ColumnarWorld.master(self.world, namespace)
+            self.world._columnar[namespace] = master
+        return master.payload(cursor)
+
     # ---- incremental changes (watch surface) ------------------------------
     def watch_changes(
         self, namespace: str, cursor: Optional[str]
@@ -230,16 +248,22 @@ class MockClusterClient:
         if entries is None:
             return {"supported": True, "cursor": str(w.journal_seq),
                     "expired": True, "changes": []}
-        seen = set()
+        by_key = {}
         changes = []
         for e in entries:
             if e["namespace"] != namespace:
                 continue
             key = (e["kind"], e["name"])
-            if key in seen:
-                continue
-            seen.add(key)
-            changes.append({"kind": e["kind"], "name": e["name"]})
+            rec = by_key.get(key)
+            if rec is None:
+                # seq doubles as the stamped resourceVersion (touch):
+                # row-write consumers key re-encodes on it (ISSUE 10)
+                rec = {"kind": e["kind"], "name": e["name"],
+                       "rv": str(e["seq"])}
+                by_key[key] = rec
+                changes.append(rec)
+            else:
+                rec["rv"] = str(e["seq"])  # dedupe keeps the newest rv
         return {"supported": True, "cursor": str(w.journal_seq),
                 "expired": False, "changes": changes}
 

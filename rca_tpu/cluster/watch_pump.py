@@ -171,7 +171,11 @@ class _Pump(threading.Thread):
                                     or name
                                 )
                         if name:
-                            self.owner.push(self.kind, name)
+                            # the delivered object's resourceVersion rides
+                            # along (ISSUE 10): row-write consumers key
+                            # re-encodes on it and skip already-seen
+                            # versions without a re-fetch
+                            self.owner.push(self.kind, name, rv=new_rv)
                     # normal stream end (server timeout): reopen at the
                     # tracked RV; a clean round also resets the backoff
                     attempt = 0
@@ -273,9 +277,12 @@ class WatchPumpSet:
                 self._journal.popleft()
                 self._base += 1
 
-    def push(self, kind: str, name: str) -> None:
+    def push(self, kind: str, name: str, rv: str = "") -> None:
         with self._lock:
-            self._journal.append({"kind": kind, "name": name})
+            entry = {"kind": kind, "name": name}
+            if rv:
+                entry["rv"] = rv
+            self._journal.append(entry)
             self._next += 1
             # trim what every consumer has already read
             floor = min(self._consumers.values(), default=self._next)
@@ -300,14 +307,20 @@ class WatchPumpSet:
                 # unknown token or lagged past the retained window
                 self._consumers.pop(token, None)
                 return None
-            seen = set()
+            by_key: Dict[tuple, Dict[str, str]] = {}
             out = []
             for i in range(pos - self._base, len(self._journal)):
                 c = self._journal[i]
                 key = (c["kind"], c["name"])
-                if key not in seen:
-                    seen.add(key)
-                    out.append(c)
+                prev = by_key.get(key)
+                if prev is None:
+                    rec = dict(c)
+                    by_key[key] = rec
+                    out.append(rec)
+                elif c.get("rv"):
+                    # deduped entry keeps its first-seen position but the
+                    # NEWEST resourceVersion (a row write wants the latest)
+                    prev["rv"] = c["rv"]
             self._consumers[token] = self._next
             return out
 

@@ -71,6 +71,22 @@ class World:
     journal_cap: int = 10_000  # older entries trim; stale cursors expire
     journal_floor: int = 0     # seq of the oldest retained entry
 
+    # -- derived lookup/columnar state (never part of the world's value) --
+    # per-(store, namespace) name->position index: touch() was a linear
+    # scan per mutation, which made building a 100k-pod world quadratic
+    # (~2 min at 10k pods just stamping resourceVersions).  Verified on
+    # access (list identity + length + name-at-position), rebuilt on any
+    # mismatch, so out-of-band list surgery degrades to a rebuild, never
+    # to a wrong stamp.
+    _pos_index: Dict[tuple, dict] = dataclasses.field(
+        default_factory=dict, init=False, repr=False, compare=False,
+    )
+    # namespace -> ColumnarWorld master (rca_tpu.cluster.columnar),
+    # created lazily by MockClusterClient.get_columnar
+    _columnar: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, init=False, repr=False, compare=False,
+    )
+
     def namespaces(self) -> List[str]:
         names = set()
         for store in (self.pods, self.services, self.deployments, self.events):
@@ -89,11 +105,13 @@ class World:
         (features/extract.py) keys its row cache on it — a mock whose
         mutations kept a frozen rv would make that cache untestable."""
         self.journal_seq += 1
-        store = getattr(self, self._KIND_PLURAL.get(kind, ""), None)
+        store_name = self._KIND_PLURAL.get(kind, "")
+        store = getattr(self, store_name, None)
         if isinstance(store, dict):
-            for obj in store.get(namespace, []):
+            obj = self.find(store_name, namespace, name)
+            if obj is not None:
                 md = obj.get("metadata")
-                if isinstance(md, dict) and md.get("name") == name:
+                if isinstance(md, dict):
                     md["resourceVersion"] = str(self.journal_seq)
         self.journal.append(
             {"seq": self.journal_seq, "kind": kind,
@@ -132,8 +150,68 @@ class World:
         "resource_quotas": "resourcequota", "hpas": "hpa",
     }
 
+    # -- O(1) name lookup (verified position index) -----------------------
+    def _index_for(self, store_name: str, namespace: str, lst: list) -> dict:
+        key = (store_name, namespace)
+        idx = self._pos_index.get(key)
+        if idx is None or idx["id"] != id(lst) or idx["len"] != len(lst):
+            pos: Dict[str, int] = {}
+            dup = False
+            for i, obj in enumerate(lst):
+                n = (obj.get("metadata") or {}).get("name", "")
+                if n in pos:
+                    dup = True
+                pos[n] = i
+            idx = {"id": id(lst), "len": len(lst), "pos": pos, "dup": dup}
+            self._pos_index[key] = idx
+        return idx
+
+    def find(self, store_name: str, namespace: str, name: str
+             ) -> Optional[dict]:
+        """The object named ``name`` in store ``store_name`` (the PLURAL
+        spelling, e.g. "pods"), or None.  O(1) via the position index;
+        a stale position (out-of-band list surgery) rebuilds and retries,
+        so the answer always reflects the live list."""
+        store = getattr(self, store_name, None)
+        if not isinstance(store, dict):
+            return None
+        lst = store.get(namespace, [])
+        idx = self._index_for(store_name, namespace, lst)
+        pos = idx["pos"].get(name)
+        if pos is None:
+            return None
+        obj = lst[pos] if pos < len(lst) else None
+        if obj is None or (obj.get("metadata") or {}).get("name") != name:
+            # positions shifted under a same-length rewrite: rebuild once
+            del self._pos_index[(store_name, namespace)]
+            idx = self._index_for(store_name, namespace, lst)
+            pos = idx["pos"].get(name)
+            obj = lst[pos] if pos is not None else None
+        return obj
+
+    def store_degenerate(self, store_name: str, namespace: str) -> bool:
+        """True when the store holds duplicate object names — name-keyed
+        incremental maintenance (columnar tables) must fall back to the
+        dict scans there."""
+        store = getattr(self, store_name, None)
+        if not isinstance(store, dict):
+            return False
+        lst = store.get(namespace, [])
+        return self._index_for(store_name, namespace, lst)["dup"]
+
     def add(self, kind: str, namespace: str, obj: dict) -> dict:
-        getattr(self, kind).setdefault(namespace, []).append(obj)
+        lst = getattr(self, kind).setdefault(namespace, [])
+        lst.append(obj)
+        # keep the position index warm across appends (a rebuild per add
+        # would make bulk world construction quadratic again)
+        idx = self._pos_index.get((kind, namespace))
+        if idx is not None and idx["id"] == id(lst) \
+                and idx["len"] == len(lst) - 1:
+            n = (obj.get("metadata") or {}).get("name", "")
+            if n in idx["pos"]:
+                idx["dup"] = True
+            idx["pos"][n] = len(lst) - 1
+            idx["len"] = len(lst)
         self.touch(
             self._KIND_SINGULAR.get(kind, kind), namespace,
             obj.get("metadata", {}).get("name", "")

@@ -196,6 +196,20 @@ def serve_graph_cache_cap() -> int:
     return env_int("RCA_SERVE_GRAPH_CACHE", 32, 1, 4096)
 
 
+def columnar_enabled() -> bool:
+    """``RCA_COLUMNAR``: columnar world-state capture (ISSUE 10).  When a
+    cluster client exposes ``get_columnar`` (the mock world does), snapshot
+    capture reads the incrementally-maintained columnar tables instead of
+    re-sanitizing and re-scanning every object per sweep, and feature
+    extraction becomes a vectorized assembly over the table's columns —
+    bit-identical to the per-object dict path (property-tested), ~10x
+    cheaper at 10k pods and the difference between seconds and tens of
+    milliseconds at 100k-1M.  Default on; 0 restores the dict scans."""
+    return env_str(
+        "RCA_COLUMNAR", "1", choices=("0", "1", "on", "off"), lower=True,
+    ) in ("1", "on")
+
+
 def rsan_enabled() -> bool:
     """``RCA_RSAN``: route the :mod:`rca_tpu.util.threads` constructors
     through the gravelock runtime lock sanitizer (ANALYSIS.md) so lock
@@ -410,6 +424,18 @@ def gateway_max_body() -> int:
 def canary_sample_rate() -> float:
     """``RCA_CANARY_SAMPLE_RATE``: per-round recording probability."""
     return env_float("RCA_CANARY_SAMPLE_RATE", 1.0, 0.0, 1.0)
+
+
+def gateway_tenant_rps() -> float:
+    """``RCA_GATEWAY_TENANT_RPS``: per-tenant token-bucket rate limit at
+    the gateway, requests/second ([0, 1e6]; 0 = disabled, the default).
+    Until ISSUE 10 the only admission control was the GLOBAL serve-queue
+    cap, so one hot tenant could starve every other tenant's wire
+    requests before weighted-fair queuing ever saw them; with a rate set,
+    each tenant gets an independent bucket (burst = one second's worth)
+    and excess requests are refused at the door with 429 + Retry-After
+    before touching the serve queue."""
+    return env_float("RCA_GATEWAY_TENANT_RPS", 0.0, 0.0, 1e6)
 
 
 # -- persistent compilation cache (ISSUE 2 satellite) -----------------------

@@ -63,6 +63,7 @@ import numpy as np
 
 from rca_tpu.cluster.snapshot import ClusterSnapshot
 from rca_tpu.config import (
+    columnar_enabled,
     compile_cache_status,
     enable_compile_cache,
     pipeline_depth_from_env,
@@ -108,6 +109,7 @@ class LiveStreamingSession:
         pipeline_depth: Optional[int] = None,
         recorder=None,
         clock=None,
+        use_columnar: Optional[bool] = None,
     ):
         """``topology_check_every``: do a full sweep + dependency-edge
         compare on every Nth poll — the edge build is the most expensive
@@ -152,6 +154,10 @@ class LiveStreamingSession:
                 "topology_check_every": int(max(1, topology_check_every)),
                 "use_watch": bool(use_watch),
                 "pipeline_depth": self.pipeline_depth,
+                "use_columnar": (
+                    columnar_enabled() if use_columnar is None
+                    else bool(use_columnar)
+                ),
             })
             client = recorder.wrap_client(client)
         self.client = client
@@ -160,6 +166,16 @@ class LiveStreamingSession:
         # incremental capture cache (busy polls re-derive only changed
         # rows; full sweeps refresh the cache — see features/extract.py)
         self._extractor = IncrementalExtractor()
+        # columnar capture (ISSUE 10): when the client serves the
+        # columnar feed, EVERY capture — sweep, resync, busy poll — goes
+        # through the table mirror (row writes + vectorized assembly)
+        # instead of the per-object dict scans; quiet polls still skip
+        # capture entirely.  The state carries the mirror + cursor + log
+        # text cache across polls so recordings log column DIFFS.
+        self._use_columnar = (
+            columnar_enabled() if use_columnar is None else bool(use_columnar)
+        )
+        self._colstate = None
         # persistent-compile-cache status for the health record: entries
         # counted at session start; the first post-tick health record adds
         # how many NEW entries this session compiled (0 = warm start)
@@ -207,6 +223,34 @@ class LiveStreamingSession:
         self._watch = bool(use_watch)
         self._resync()
 
+    # -- capture (columnar fast path, ISSUE 10) -----------------------------
+    def _columnar_active(self) -> bool:
+        return self._use_columnar and callable(
+            getattr(self.client, "get_columnar", None)
+        )
+
+    def _capture_full(self, traces_from=None) -> ClusterSnapshot:
+        """One namespace capture through the preferred path: the columnar
+        tables when the client serves them, else the dict sweep.  A
+        degenerate world (columnar unsupported) falls back permanently —
+        capture() already returned the dict-path snapshot in that case."""
+        if self._columnar_active():
+            if self._colstate is None:
+                from rca_tpu.cluster.columnar import ColumnarClientState
+
+                self._colstate = ColumnarClientState()
+            snap = ClusterSnapshot.capture(
+                self.client, self.namespace,
+                columnar_state=self._colstate, traces_from=traces_from,
+            )
+            if snap.columnar is None:
+                self._use_columnar = False
+                self._colstate = None
+            return snap
+        return ClusterSnapshot.capture(
+            self.client, self.namespace, columnar=False,
+        )
+
     # -- topology (re)build -------------------------------------------------
     def _resync(self, snap=None, fs=None, edges=None,
                 cause: str = "topology") -> None:
@@ -223,7 +267,7 @@ class LiveStreamingSession:
             # during the capture get re-reported next poll (a harmless
             # re-patch) instead of being lost
             self._reopen_feed()
-            snap = ClusterSnapshot.capture(self.client, self.namespace)
+            snap = self._capture_full()
         if fs is None:
             # full-mode extraction: a resync is the recovery path for
             # "we may have missed something", so it must not trust the
@@ -374,6 +418,9 @@ class LiveStreamingSession:
             # this recovery's own (clean) fetch status, not the previous
             # capture's stale error list
             errors=[],
+            # a columnar view describes exactly the capture that built
+            # it; this grafted snapshot must extract through the dict path
+            columnar=None,
         )
         self._force_topology_check = True
         # full-mode extraction: the notifications were LOST, so the drift
@@ -409,7 +456,12 @@ class LiveStreamingSession:
         metrics_touched = any(c["kind"] == "pod_metrics" for c in changes)
         traces_touched = any(c["kind"] == "traces" for c in changes)
 
-        patch: Dict[str, Any] = {"captured_at": self.client.get_current_time()}
+        patch: Dict[str, Any] = {
+            "captured_at": self.client.get_current_time(),
+            # patched snapshots extract through the dict path (a columnar
+            # view is only valid for the capture that assembled it)
+            "columnar": None,
+        }
         can_check_errors = hasattr(self.client, "collect_errors")
         if traces_touched:
             # error-rate/latency channels come straight from trace data —
@@ -778,11 +830,26 @@ class LiveStreamingSession:
                 return self._finish(
                     t0, changed=len(self._names), resynced=True, quiet=False,
                 )
-            snap = self._patch_snapshot(changes)
+            if self._columnar_active():
+                # busy-poll columnar capture: the get_columnar diff IS the
+                # patch (row writes for exactly the journaled names);
+                # trace payloads carry forward unless journaled, the same
+                # contract _patch_snapshot has
+                traces_touched = any(
+                    c["kind"] == "traces" for c in changes
+                )
+                snap = self._capture_full(
+                    traces_from=(
+                        None if traces_touched else self._snap.traces
+                    ),
+                )
+            else:
+                snap = self._patch_snapshot(changes)
             # busy-poll capture: every mutation reaching here is
             # journal-mediated (the API server — or the mock's touch —
             # bumped resourceVersion), so the incremental extractor
-            # re-derives ONLY the changed rows
+            # re-derives ONLY the changed rows (columnar snapshots skip
+            # the row cache entirely — their rows pre-assembled)
             fs = self._extractor.extract(snap)
             if list(fs.service_names) != self._names:
                 self._resync(snap=snap, fs=fs)
@@ -813,7 +880,10 @@ class LiveStreamingSession:
         new = np.asarray(fs.service_features, np.float32)
         changed = np.flatnonzero(np.any(new != self._features, axis=1))
         if len(changed):
-            self.session.update_many({int(i): new[i] for i in changed})
+            # dirty-row slice straight into the delta-scatter staging
+            # (ISSUE 10): one [U] index vector + one [U, C] block, no
+            # per-row dict hop
+            self.session.update_rows(changed, new[changed])
             self._features[changed] = new[changed]
         return int(len(changed))
 
@@ -836,9 +906,13 @@ class LiveStreamingSession:
 
     def _poll_sweep(self, check_edges: bool = False) -> Dict[str, Any]:
         """Full list + extract + diff (the only strategy without a change
-        feed; the watch path's periodic topology check also lands here)."""
+        feed; the watch path's periodic topology check also lands here).
+        With columnar capture the "full list" is the table mirror — the
+        sweep's out-of-band-drift net narrows to what the journal carries
+        plus the trace payloads it refetches, which is the same
+        journal-mediated visibility contract the watch feed already has."""
         t0 = self._clock()
-        snap = ClusterSnapshot.capture(self.client, self.namespace)
+        snap = self._capture_full()
         # full mode: sweeps exist to catch OUT-OF-BAND drift (trace-derived
         # edges, un-journaled mutations), which the rv-keyed row cache by
         # definition cannot see — recompute rows, refresh the cache

@@ -150,8 +150,10 @@ class StreamingHostState:
         # never read the clock module directly on the tick path)
         self._clock = clock or time.perf_counter
         # pending row updates, keyed by service index (last write wins, so
-        # the scatter never carries duplicate indices)
+        # the scatter never carries duplicate indices); bulk dirty-row
+        # slices stage as (idx, rows) blocks beside it (update_rows)
         self._pending: Dict[int, np.ndarray] = {}
+        self._pending_blocks: list = []
         self.ticks = 0
         self.last_upload_rows = 0  # padded rows uploaded by the last flush
         self._bulk_upload = 0      # set by set_all; reported by next tick
@@ -169,10 +171,51 @@ class StreamingHostState:
         for i, f in rows.items():
             self.update(i, f)
 
+    def update_rows(self, indices: np.ndarray, rows: np.ndarray) -> None:
+        """Bulk delta staging (ISSUE 10): a dirty-row slice — an [U] index
+        vector plus its [U, C] row block — feeds the delta scatter
+        directly, with no per-row dict insertion.  Semantically identical
+        to ``update_many`` over the same pairs (last write per index
+        wins, including against earlier ``update`` calls)."""
+        idx = np.asarray(indices, np.int64).ravel()
+        if idx.size == 0:
+            return
+        block = np.array(rows, np.float32).reshape(idx.size, -1)
+        self._pending_blocks.append((idx, block))
+        # a block supersedes earlier per-index updates for the same rows
+        if self._pending:
+            for i in idx.tolist():
+                self._pending.pop(int(i), None)
+
     def _pack_pending(self, drop_index: int):
         """Pending deltas as power-of-two-padded (count, idx, rows); pad
         slots point at ``drop_index`` (the dense session's dummy row / the
         sharded session's out-of-bounds sentinel)."""
+        blocks = self._pending_blocks
+        if blocks:
+            # merge block staging with any dict staging; later writes win
+            # per index (the scatter must never carry duplicate indices —
+            # duplicate-lane scatter order is undefined on device)
+            all_idx = np.concatenate(
+                [b[0] for b in blocks]
+                + ([np.fromiter(self._pending, np.int64, len(self._pending))]
+                   if self._pending else [])
+            )
+            all_rows = np.concatenate(
+                [b[1] for b in blocks]
+                + ([np.stack(list(self._pending.values()))]
+                   if self._pending else [])
+            )
+            rev = all_idx[::-1]
+            _uniq, first_in_rev = np.unique(rev, return_index=True)
+            keep = np.sort(len(all_idx) - 1 - first_in_rev)
+            u = int(len(keep))
+            u_pad = 1 << max(0, (u - 1).bit_length()) if u else 1
+            idx_h = np.full(u_pad, drop_index, np.int32)
+            rows_h = np.zeros((u_pad, self._num_features), np.float32)
+            idx_h[:u] = all_idx[keep]
+            rows_h[:u] = all_rows[keep]
+            return u, u_pad, idx_h, rows_h
         u = len(self._pending)
         u_pad = 1 << max(0, (u - 1).bit_length()) if u else 1
         idx_h = np.full(u_pad, drop_index, np.int32)
@@ -187,6 +230,7 @@ class StreamingHostState:
         Call only once the dispatch is accepted — a raise before this must
         leave the deltas retryable."""
         self._pending.clear()
+        self._pending_blocks.clear()
         total = uploaded_rows + self._bulk_upload
         self._bulk_upload = 0
         self.last_upload_rows = total
@@ -308,6 +352,7 @@ class StreamingSession(StreamingHostState):
         f[: len(features)] = features
         self._features = jnp.asarray(f)
         self._pending.clear()
+        self._pending_blocks.clear()
         self._bulk_upload = self._n_pad
 
     # -- tick ---------------------------------------------------------------
@@ -317,7 +362,7 @@ class StreamingSession(StreamingHostState):
         serial path) is fetch(dispatch()) back to back."""
         p = self.engine.params
         t0 = self._clock()
-        if self._pending:
+        if self._pending or self._pending_blocks:
             # fused path: scatter + propagate + top-k in a single dispatch
             _, u_pad, idx_h, rows_h = self._pack_pending(self._n_pad - 1)
             self._features, vals, idx, n_bad = _flush_propagate_ranked(

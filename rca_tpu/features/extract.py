@@ -209,6 +209,13 @@ class IncrementalExtractor:
 
     def extract(self, snapshot: ClusterSnapshot,
                 incremental: bool = True) -> FeatureSet:
+        if getattr(snapshot, "columnar", None) is not None:
+            # columnar capture (ISSUE 10): the per-pod work was already
+            # done as row writes when the world mutated; the view carries
+            # the assembled matrix + memberships, so extraction is just
+            # the (vectorized) service aggregation.  Bit-identical to the
+            # dict loop below — property-tested in tests/test_columnar.py.
+            return _extract_columnar(snapshot)
         pods = snapshot.pods
         P = len(pods)
         pod_names = [
@@ -329,6 +336,20 @@ def extract_features(snapshot: ClusterSnapshot) -> FeatureSet:
     """One-shot full extraction (a fresh :class:`IncrementalExtractor` in
     full mode — ONE row/aggregation definition for both paths)."""
     return IncrementalExtractor().extract(snapshot, incremental=False)
+
+
+def _extract_columnar(snapshot: ClusterSnapshot) -> FeatureSet:
+    """[no-dict-scan] Vectorized extraction off a columnar capture: every
+    per-pod quantity (feature rows, selector memberships, node indices)
+    was assembled from column slices at capture time
+    (:meth:`rca_tpu.cluster.columnar.ColumnarWorld.build_view`); only the
+    shared service aggregation — already numpy segment ops — runs here."""
+    v = snapshot.columnar
+    return _aggregate_services(
+        snapshot, v.pod_names, v.pod_features, v.service_names,
+        v.selectors, v.pod_service, v.memb_pod, v.memb_svc,
+        v.node_names, v.pod_node,
+    )
 
 
 def _aggregate_services(

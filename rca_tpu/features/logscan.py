@@ -9,6 +9,7 @@ feature extractor packs straight into the device array.
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import Dict, List
 
@@ -94,6 +95,24 @@ def scan_text(text: str) -> np.ndarray:
     if counts is not None:
         return counts
     return scan_text_python(text)
+
+
+@functools.lru_cache(maxsize=4096)
+def _scan_text_cached(text: str) -> bytes:
+    """Memoized scan keyed by log content (ISSUE 10: the columnar row
+    encoder re-derives a pod's counts on every journaled log touch, and
+    unchanged tails — the common case under pod-status churn — would
+    otherwise re-run all 13 regexes).  Returns immutable bytes so cached
+    entries cannot be mutated through a returned array."""
+    return scan_text(text).tobytes()
+
+
+def scan_text_cached(text: str) -> np.ndarray:
+    """Content-memoized :func:`scan_text` (same counts, enforced by the
+    parity tests); the cache is process-wide and bounded."""
+    return np.frombuffer(
+        _scan_text_cached(text), dtype=np.int32
+    ).copy()
 
 
 def scan_pod_logs(logs_by_container: Dict[str, str]) -> np.ndarray:

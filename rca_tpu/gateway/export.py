@@ -148,6 +148,11 @@ def render_metrics_text(
               "requests refused for exceeding RCA_GATEWAY_MAX_BODY")
         _line(out, "rca_gateway_body_rejections_total",
               gateway.get("body_rejections", 0))
+        _head(out, "rca_gateway_rate_limited_total", "counter",
+              "requests refused by the per-tenant token bucket "
+              "(RCA_GATEWAY_TENANT_RPS)")
+        _line(out, "rca_gateway_rate_limited_total",
+              gateway.get("rate_limited", 0))
 
     if healthy is not None:
         _head(out, "rca_gateway_up", "gauge",

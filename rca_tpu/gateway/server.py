@@ -45,7 +45,11 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
-from rca_tpu.config import gateway_max_body, gateway_port
+from rca_tpu.config import (
+    gateway_max_body,
+    gateway_port,
+    gateway_tenant_rps,
+)
 from rca_tpu.gateway.export import render_metrics_text
 from rca_tpu.gateway.wire import (
     TENANT_HEADER,
@@ -82,6 +86,7 @@ class GatewayMetrics:
         self._streams_opened = 0
         self._stream_events = 0
         self._body_rejections = 0
+        self._rate_limited = 0
 
     def response(self, route: str, code: int, ms: float) -> None:
         with self._lock:
@@ -101,6 +106,10 @@ class GatewayMetrics:
         with self._lock:
             self._body_rejections += 1
 
+    def rate_limited(self) -> None:
+        with self._lock:
+            self._rate_limited += 1
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             requests = dict(self._requests)
@@ -108,6 +117,7 @@ class GatewayMetrics:
             streams_opened = self._streams_opened
             stream_events = self._stream_events
             body_rejections = self._body_rejections
+            rate_limited = self._rate_limited
         return {
             "requests": requests,
             "latency": {
@@ -120,7 +130,67 @@ class GatewayMetrics:
             "streams_opened": streams_opened,
             "stream_events": stream_events,
             "body_rejections": body_rejections,
+            "rate_limited": rate_limited,
         }
+
+
+class TenantRateLimiter:
+    """Per-tenant token buckets at the wire (``RCA_GATEWAY_TENANT_RPS``,
+    ISSUE 10 satellite).  Until now the only admission control was the
+    GLOBAL serve-queue cap — one hot tenant could fill it before the
+    scheduler's weighted-fair queuing ever saw anyone else.  Each tenant
+    gets an independent bucket refilled at ``rps`` with one second's
+    burst; an empty bucket answers with the seconds until the next token
+    (the 429's Retry-After), and the request never touches the queue.
+
+    Time comes from the injectable ``clock`` (monotonic seconds) —
+    nondet-discipline, same seam as the rest of the gateway.  The tenant
+    map is bounded: past ``max_tenants`` the stalest bucket (a full one,
+    i.e. an idle tenant) is evicted."""
+
+    MAX_TENANTS = 4096
+
+    def __init__(self, rps: float, clock: Callable[[], float],
+                 burst: Optional[float] = None,
+                 max_tenants: int = MAX_TENANTS):
+        self.rps = float(rps)
+        self.burst = float(burst) if burst is not None else max(
+            1.0, self.rps
+        )
+        self.clock = clock
+        self.max_tenants = int(max_tenants)
+        self._lock = make_lock("TenantRateLimiter._lock")
+        # tenant -> [tokens, last_refill_ts]
+        self._buckets: Dict[str, list] = {}
+        self.rejected = 0
+
+    def admit(self, tenant: str) -> float:
+        """0.0 = admitted (one token consumed); positive = rejected, the
+        value is the seconds until a token exists (Retry-After)."""
+        now = self.clock()
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                if len(self._buckets) >= self.max_tenants:
+                    # evict the fullest (stalest) bucket; a returning
+                    # evictee simply starts with a fresh full burst
+                    victim = max(
+                        self._buckets,
+                        key=lambda t: self._buckets[t][0],
+                    )
+                    del self._buckets[victim]
+                b = [self.burst, now]
+                self._buckets[tenant] = b
+            tokens, last = b
+            tokens = min(self.burst, tokens + (now - last) * self.rps)
+            if tokens >= 1.0:
+                b[0] = tokens - 1.0
+                b[1] = now
+                return 0.0
+            b[0] = tokens
+            b[1] = now
+            self.rejected += 1
+            return (1.0 - tokens) / self.rps
 
 
 class TickHub:
@@ -324,6 +394,23 @@ class _Handler(BaseHTTPRequestHandler):
                 json.JSONDecodeError) as exc:
             self._send_json(400, {"status": "error", "detail": str(exc)})
             return 400
+        if gw.limiter is not None:
+            wait = gw.limiter.admit(kwargs.get("tenant", ""))
+            if wait > 0.0:
+                # refused at the door: the request never touches the
+                # serve queue, so one hot tenant cannot fill the global
+                # cap ahead of everyone else's fair share
+                gw.metrics.rate_limited()
+                self._send_json(429, {
+                    "status": "rate_limited",
+                    "tenant": kwargs.get("tenant", ""),
+                    "detail": (
+                        "per-tenant rate limit "
+                        f"({gw.limiter.rps:g} req/s, "
+                        "RCA_GATEWAY_TENANT_RPS) exceeded"
+                    ),
+                }, retry_after=max(1, int(wait + 0.999)))
+                return 429
         req = gw.client.submit(**kwargs)
         try:
             resp = req.result(gw.timeout_s)
@@ -428,6 +515,7 @@ class GatewayServer:
         max_body: Optional[int] = None,
         timeout_s: float = DEFAULT_TIMEOUT_S,
         clock: Callable[[], float] = time.monotonic,
+        tenant_rps: Optional[float] = None,
     ):
         self.loop = loop
         self.client = ServeClient(loop)
@@ -435,6 +523,13 @@ class GatewayServer:
         self.max_body = int(max_body) if max_body is not None \
             else gateway_max_body()
         self.timeout_s = float(timeout_s)
+        rps = gateway_tenant_rps() if tenant_rps is None else float(
+            tenant_rps
+        )
+        # per-tenant token buckets (RCA_GATEWAY_TENANT_RPS; 0 = off)
+        self.limiter = (
+            TenantRateLimiter(rps, clock) if rps > 0.0 else None
+        )
         self.metrics = GatewayMetrics()
         self.hub = TickHub()
         self.closing = threading.Event()

@@ -186,6 +186,7 @@ class ShardedStreamingSession(StreamingHostState):
             jnp.asarray(f), self._feat_sharding
         )
         self._pending.clear()
+        self._pending_blocks.clear()
         self._bulk_upload = self._n_pad
 
     # -- tick ---------------------------------------------------------------

@@ -43,9 +43,10 @@ import numpy as np
 from rca_tpu.replay.format import (
     SCHEMA_VERSION,
     RecordingWriter,
-    digest_array,
+    digest_array_crc,
     digest_obj,
     encode_array,
+    jsonify_ndarrays,
     make_call_key,
 )
 
@@ -147,12 +148,20 @@ class Recorder:
                     result: Any = None,
                     error: Optional[BaseException] = None) -> None:
         self._ensure_header()
+        # columnar feed answers are first-class COLUMN-DIFF frames
+        # (ISSUE 10): the full table dump once, then row ops — instead of
+        # re-recording whole object lists every capture.  Their numpy
+        # columns ride as tagged raw-byte encodings (bit-exact on
+        # replay); every other call records exactly as before, so
+        # pre-columnar recordings and sessions are unaffected.
+        coldiff = method == "get_columnar"
         frame: Dict[str, Any] = {
-            "kind": "call", "tick": self._tick, "method": method,
+            "kind": "coldiff" if coldiff else "call",
+            "tick": self._tick, "method": method,
             "key": key, "ok": bool(ok),
         }
         if ok:
-            frame["result"] = result
+            frame["result"] = jsonify_ndarrays(result) if coldiff else result
         else:
             frame["error_type"] = type(error).__name__
             frame["error_msg"] = str(error)
@@ -182,7 +191,10 @@ class Recorder:
         }
         if features is not None:
             f = np.asarray(features, np.float32)
-            frame["features_digest"] = digest_array(f)
+            # one vectorized CRC pass over the host mirror (ISSUE 10);
+            # old recordings carry sha1 digests — digest_algo says which
+            frame["features_digest"] = digest_array_crc(f)
+            frame["digest_algo"] = "crc32"
             frame["features_shape"] = list(f.shape)
             if f.size <= self.features_cap:
                 frame["features"] = encode_array(f)

@@ -44,9 +44,19 @@ from rca_tpu.replay.format import (
     SCHEMA_VERSION,
     decode_array,
     digest_array,
+    digest_array_crc,
     digest_obj,
     read_frames,
 )
+
+
+def _feature_digest_like(frame: Optional[dict], arr: np.ndarray) -> str:
+    """Digest ``arr`` with the SAME algorithm that sealed the recorded
+    tick frame (crc32 since ISSUE 10; sha1 before), so recorded-vs-
+    replayed digests stay comparable across recording vintages."""
+    if frame is not None and frame.get("digest_algo") == "crc32":
+        return digest_array_crc(arr)
+    return digest_array(arr)
 from rca_tpu.replay.recorder import env_fingerprint
 from rca_tpu.replay.source import ReplaySource
 
@@ -98,7 +108,9 @@ def load_recording(path: str) -> Recording:
     end = None
     for fr in frames[1:]:
         kind = fr.get("kind")
-        if kind == "call":
+        if kind in ("call", "coldiff"):
+            # coldiff = a recorded get_columnar answer (column diffs,
+            # ISSUE 10) — consumed through the same keyed call table
             calls.append(fr)
         elif kind == "tick":
             ticks[int(fr["tick"])] = fr
@@ -159,6 +171,12 @@ def _replay_session(rec: Recording, source: ReplaySource, engine: Any,
         topology_check_every=int(info.get("topology_check_every", 5)),
         use_watch=bool(info.get("use_watch", True)),
         pipeline_depth=pipeline_depth,
+        # pin the recorded capture path: a columnar recording must replay
+        # columnar even if RCA_COLUMNAR is off in the replaying process
+        # (and vice versa) — pre-columnar headers default to True, which
+        # is harmless because ReplaySource only advertises get_columnar
+        # when coldiff frames exist
+        use_columnar=bool(info.get("use_columnar", True)),
     )
 
 
@@ -279,8 +297,8 @@ def replay_stream(
         }
         feats = getattr(run.session, "_features", None)
         if feats is not None:
-            detail["replayed_features_digest"] = digest_array(
-                np.asarray(feats, np.float32)
+            detail["replayed_features_digest"] = _feature_digest_like(
+                recd, np.asarray(feats, np.float32)
             )
         report["seek"] = detail
     return report
@@ -346,7 +364,7 @@ def bisect_divergence(
     feats = getattr(run.session, "_features", None)
     if feats is not None:
         f = np.asarray(feats, np.float32)
-        dump["replayed_features_digest"] = digest_array(f)
+        dump["replayed_features_digest"] = _feature_digest_like(recd, f)
         dump["replayed_features_shape"] = list(f.shape)
         if recd.get("features") is not None:
             rf = decode_array(recd["features"])

@@ -27,7 +27,7 @@ from __future__ import annotations
 import collections
 from typing import Any, Dict, List, Tuple
 
-from rca_tpu.replay.format import make_call_key
+from rca_tpu.replay.format import make_call_key, restore_ndarrays
 from rca_tpu.resilience.chaos import InjectedTimeout
 
 
@@ -61,6 +61,16 @@ class ReplaySource:
         methods = set()
         for fr in call_frames:
             methods.add(fr["method"])
+            if fr.get("kind") == "coldiff" and fr.get("ok"):
+                # column-diff frames (ISSUE 10) carry tagged raw-byte
+                # array encodings; restore them once at load so the
+                # replayed mirror sees bit-identical numpy columns.  A
+                # recording WITHOUT these frames simply never advertises
+                # ``get_columnar`` (presence semantics below) and the
+                # replayed session runs the dict capture path — old
+                # recordings replay exactly as before.
+                fr = dict(fr)
+                fr["result"] = restore_ndarrays(fr["result"])
             bucket = by_tick.setdefault(int(fr["tick"]), {})
             bucket.setdefault(
                 (fr["method"], fr["key"]), collections.deque()

@@ -201,6 +201,27 @@ def when():
 def rng():
     return np.random.default_rng()  # unseeded constructor
 """, 4),
+    "no-dict-scan": ("rca_tpu/cluster/columnar.py", """\
+import numpy as np
+
+
+def build_view(table):
+    \"\"\"[no-dict-scan] assemble the capture view.\"\"\"
+    feat = table.base.copy()
+    for i, pod in enumerate(table.objects):   # per-pod loop crept back
+        feat[i, 0] = pod.get("x", 0.0)
+    while feat.sum() < 0:                     # and a while for good measure
+        break
+    return feat
+
+
+def encode_row(pod):
+    # unmarked helper: row-write encoders MAY loop (paid per mutation)
+    total = 0
+    for cs in pod.get("statuses", []):
+        total += cs.get("restarts", 0)
+    return total
+""", 2),
 }
 
 
@@ -345,6 +366,26 @@ def timed_fetch(run, timed):
 def full_diagnostics(self):
     return jax.device_get(self._stacked_dev)  # the deferred bulk seam
 """),
+        ("rca_tpu/cluster/columnar.py", """\
+import numpy as np
+
+
+def build_view(table):
+    \"\"\"[no-dict-scan] assemble the capture view, vectorized.\"\"\"
+    feat = table.base.copy()
+    feat[:, 0] = table.cpu
+    # comprehensions over small registries are the documented allowlist
+    lut = np.asarray([table.pos.get(n, -1) for n in table.registry])
+    return feat, lut
+
+
+def encode_row(pod):
+    # unmarked row-write encoder: loops are its job (paid per mutation)
+    total = 0
+    for cs in pod.get("statuses", []):
+        total += cs.get("restarts", 0)
+    return total
+"""),
     )
     result = run_lint(root=root, use_baseline=False)
     assert result.clean, result.findings
@@ -463,12 +504,12 @@ def test_baseline_is_empty():
     assert load_baseline(default_baseline_path(ROOT)) == []
 
 
-def test_all_twelve_rules_registered():
+def test_all_thirteen_rules_registered():
     assert set(all_rules()) == {
         "tick-sync", "swallowed-faults", "tracer-leak", "retrace-hazard",
         "rng-key-reuse", "lock-discipline", "env-discipline",
         "nondet-discipline", "resident-fetch", "race-guard",
-        "lock-order", "thread-discipline",
+        "lock-order", "thread-discipline", "no-dict-scan",
     }
     for rule in all_rules().values():
         assert rule.summary and rule.why

@@ -249,6 +249,10 @@ def test_degraded_client_yields_degraded_report():
     class RBACDeniedClient(MockClusterClient):
         """Events fetch is denied; failures land in the error channel."""
 
+        # faults are simulated at the GETTER surface, so the columnar
+        # fast path (which answers from the tables) must stay off
+        get_columnar = None
+
         def __init__(self, world):
             super().__init__(world)
             self._errs = []

@@ -211,6 +211,98 @@ def test_wire_parity_vs_inprocess_concurrency_16(engine, case):
         loop.stop()
 
 
+# -- per-tenant rate limiting (ISSUE 10 satellite) ---------------------------
+
+def test_tenant_rate_limiter_bucket_math():
+    """Token-bucket unit contract on a fake clock: one second's burst,
+    refill at rps, Retry-After = seconds until the next token, tenants
+    independent."""
+    from rca_tpu.gateway.server import TenantRateLimiter
+
+    now = [100.0]
+    lim = TenantRateLimiter(rps=2.0, clock=lambda: now[0])
+    # burst = max(1, rps) = 2 tokens
+    assert lim.admit("a") == 0.0
+    assert lim.admit("a") == 0.0
+    wait = lim.admit("a")
+    assert wait > 0.0 and wait <= 0.5 + 1e-9
+    # a different tenant has its own bucket
+    assert lim.admit("b") == 0.0
+    # refill: half a second buys one token at 2 rps
+    now[0] += 0.5
+    assert lim.admit("a") == 0.0
+    assert lim.admit("a") > 0.0
+    assert lim.rejected == 2
+
+
+def test_tenant_rate_limiter_bounded_tenant_map():
+    from rca_tpu.gateway.server import TenantRateLimiter
+
+    now = [0.0]
+    lim = TenantRateLimiter(rps=1.0, clock=lambda: now[0], max_tenants=4)
+    for i in range(10):
+        assert lim.admit(f"t{i}") == 0.0
+    assert len(lim._buckets) <= 4
+
+
+def test_gateway_tenant_rps_env_round_trip(monkeypatch):
+    from rca_tpu.config import gateway_tenant_rps
+
+    assert gateway_tenant_rps() == 0.0  # default: disabled
+    monkeypatch.setenv("RCA_GATEWAY_TENANT_RPS", "2.5")
+    assert gateway_tenant_rps() == 2.5
+    monkeypatch.setenv("RCA_GATEWAY_TENANT_RPS", "-1")
+    with pytest.raises(ValueError):
+        gateway_tenant_rps()
+    monkeypatch.setenv("RCA_GATEWAY_TENANT_RPS", "lots")
+    with pytest.raises(ValueError):
+        gateway_tenant_rps()
+
+
+def test_gateway_rate_limits_hot_tenant_not_neighbors(engine, case):
+    """A hot tenant burns its bucket and gets 429 + Retry-After WITHOUT
+    touching the serve queue; a quiet tenant on the same gateway keeps
+    getting 200s.  The /metrics exposition carries the rejection count."""
+    loop = ServeLoop(engine=engine).start()
+    frozen = [500.0]  # injectable clock: no refill mid-test
+    try:
+        with GatewayServer(loop, port=0, tenant_rps=2.0,
+                           clock=lambda: frozen[0]) as gw:
+            cl = GatewayClient(gw.host, gw.port)
+            codes = []
+            for _ in range(6):
+                code, body, headers = cl.analyze(
+                    case.features, case.dep_src, case.dep_dst,
+                    names=case.names, tenant="hot", k=3,
+                )
+                codes.append(code)
+                if code == 429:
+                    assert body["status"] == "rate_limited"
+                    assert "RCA_GATEWAY_TENANT_RPS" in body["detail"]
+                    assert int(headers.get("Retry-After", 0)) >= 1
+            assert codes.count(200) == 2      # exactly the burst
+            assert codes.count(429) == 4
+            # the quiet neighbor is unaffected
+            code, body, _ = cl.analyze(
+                case.features, case.dep_src, case.dep_dst,
+                names=case.names, tenant="quiet", k=3,
+            )
+            assert code == 200 and body["status"] == "ok"
+            # rejected requests never reached the scheduler
+            summary = loop.metrics.summary()
+            assert "hot" in summary.get("tenants", {})
+            assert summary["tenants"]["hot"]["submitted"] == 2
+            conn = http.client.HTTPConnection(gw.host, gw.port, timeout=10)
+            try:
+                conn.request("GET", "/metrics")
+                text = conn.getresponse().read().decode()
+            finally:
+                conn.close()
+            assert "rca_gateway_rate_limited_total 4" in text
+    finally:
+        loop.stop()
+
+
 # -- honest backpressure ------------------------------------------------------
 
 def test_backpressure_429_503_413_400_404(engine, case):

@@ -295,6 +295,9 @@ def test_resync_cause_split_counters():
 class _FlakyClient(MockClusterClient):
     """get_pods raises until ``heal()`` is called."""
 
+    # getter-surface fault simulation: keep the columnar fast path off
+    get_columnar = None
+
     def __init__(self, world):
         super().__init__(world)
         self.broken = False

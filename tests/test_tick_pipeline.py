@@ -366,7 +366,9 @@ def test_incremental_extractor_reuses_cached_rows():
     world = synthetic_cascade_world(25, n_roots=1, seed=4, namespace=ns)
     client = MockClusterClient(world)
     inc = IncrementalExtractor()
-    snap = ClusterSnapshot.capture(client, ns)
+    # this test pins the DICT row cache (columnar captures skip it —
+    # their rows assemble from columns; see tests/test_columnar.py)
+    snap = ClusterSnapshot.capture(client, ns, columnar=False)
     inc.extract(snap)
 
     calls = []
@@ -378,7 +380,7 @@ def test_incremental_extractor_reuses_cached_rows():
 
     ex.scan_pod_logs = counting
     try:
-        inc.extract(ClusterSnapshot.capture(client, ns))
+        inc.extract(ClusterSnapshot.capture(client, ns, columnar=False))
         assert not calls    # quiet capture: every row + log scan cached
         # mutate the logs of a pod that IS inside the snapshot's log
         # sample (capture caps healthy-pod log fetches)
@@ -386,7 +388,7 @@ def test_incremental_extractor_reuses_cached_rows():
         app = name.rsplit("-", 1)[0]
         world.logs[ns][name] = {app: "ERROR: fresh failure\n" * 4}
         world.touch("logs", ns, name)
-        inc.extract(ClusterSnapshot.capture(client, ns))
+        inc.extract(ClusterSnapshot.capture(client, ns, columnar=False))
         assert len(calls) == 1   # exactly the touched pod re-scanned
     finally:
         ex.scan_pod_logs = orig

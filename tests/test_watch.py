@@ -24,15 +24,23 @@ def test_mock_watch_cursor_and_dedup():
     head = client.watch_changes(NS, None)
     assert head["supported"] and not head["expired"]
 
+    base_seq = world.journal_seq
     world.touch("pod", NS, "p1")
     world.touch("pod", NS, "p1")        # dedups
     world.touch("pod", "other-ns", "x")  # other namespace filters out
     world.touch("event", NS, "p1")       # distinct kind survives dedup
     out = client.watch_changes(NS, head["cursor"])
-    assert out["changes"] == [
+    assert [
+        {"kind": c["kind"], "name": c["name"]} for c in out["changes"]
+    ] == [
         {"kind": "pod", "name": "p1"},
         {"kind": "event", "name": "p1"},
     ]
+    # each change carries the touched object's resourceVersion (ISSUE 10
+    # row-write key); dedupe keeps the NEWEST one — p1 was touched at
+    # base+1 then base+2, so its deduped record reports base+2
+    assert out["changes"][0]["rv"] == str(base_seq + 2)
+    assert out["changes"][1]["rv"] == str(base_seq + 4)
     # the returned cursor has consumed everything
     again = client.watch_changes(NS, out["cursor"])
     assert again["changes"] == [] and not again["expired"]
@@ -50,7 +58,10 @@ def test_mock_watch_expires_past_trim():
     # recovery: reopen at head, consume normally
     head2 = client.watch_changes(NS, None)
     world.touch("pod", NS, "fresh")
-    assert client.watch_changes(NS, head2["cursor"])["changes"] == [
+    assert [
+        {"kind": c["kind"], "name": c["name"]}
+        for c in client.watch_changes(NS, head2["cursor"])["changes"]
+    ] == [
         {"kind": "pod", "name": "fresh"}
     ]
 
@@ -99,7 +110,11 @@ def test_busy_poll_fetches_only_changed_objects():
 
     world = five_service_world()
     client = SpyClient(world)
-    live = LiveStreamingSession(client, NS, k=3, topology_check_every=100)
+    # this test pins the DICT patch path's call scoping (the live-cluster
+    # shape — no columnar surface there); columnar busy polls are covered
+    # in tests/test_columnar.py
+    live = LiveStreamingSession(client, NS, k=3, topology_check_every=100,
+                                use_columnar=False)
     client.calls = {k: 0 for k in client.calls}
 
     pod = world.pods[NS][0]
@@ -250,11 +265,29 @@ def test_use_watch_false_forces_sweep_strategy():
     client = SpyClient(world)
     live = LiveStreamingSession(
         client, NS, k=3, use_watch=False, topology_check_every=100,
+        use_columnar=False,  # pin the dict sweep's call shape
     )
     client.calls = {k: 0 for k in client.calls}
     out = live.poll()
     assert "quiet" in out and out["quiet"] is False
     assert client.calls["get_pods"] == 1  # full sweep ran
+
+
+def test_columnar_sweep_never_lists_the_namespace():
+    """The columnar twin of the sweep-strategy test (ISSUE 10): with the
+    columnar feed active, even a FULL sweep costs zero object-list calls
+    — the tables answer, the journal keeps them fresh."""
+    world = five_service_world()
+    client = SpyClient(world)
+    live = LiveStreamingSession(
+        client, NS, k=3, use_watch=False, topology_check_every=100,
+        use_columnar=True,
+    )
+    client.calls = {k: 0 for k in client.calls}
+    out = live.poll()
+    assert "quiet" in out and out["quiet"] is False
+    assert client.calls["get_pods"] == 0
+    assert client.calls["get_events"] == 0
 
 
 def test_patched_session_matches_fresh_session_property():
