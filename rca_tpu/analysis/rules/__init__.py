@@ -14,6 +14,7 @@ from rca_tpu.analysis.rules import nondet         # noqa: F401
 from rca_tpu.analysis.rules import residentfetch  # noqa: F401
 from rca_tpu.analysis.rules import retrace        # noqa: F401
 from rca_tpu.analysis.rules import rng            # noqa: F401
+from rca_tpu.analysis.rules import spans          # noqa: F401
 from rca_tpu.analysis.rules import threads        # noqa: F401
 from rca_tpu.analysis.rules import ticksync       # noqa: F401
 from rca_tpu.analysis.rules import tracer         # noqa: F401

@@ -58,6 +58,10 @@ REPLAY_SCOPE = (
     "rca_tpu/features/extract.py",
     "rca_tpu/resilience/chaos.py",
     "rca_tpu/resilience/policy.py",
+    # tracing (ISSUE 11): spans are embedded in recordings (tick health
+    # records) and must replay host-independently — the tracer times
+    # through its injectable clock, never the wall
+    "rca_tpu/observability/",
 )
 
 _TIME_FNS = frozenset({

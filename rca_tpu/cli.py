@@ -581,8 +581,8 @@ def _serve_listen(args, config) -> int:
         "listening": gw.address,
         "replicas": len(loop.replicas) if pooled else 1,
         "max_body": gw.max_body,
-        "endpoints": ["/v1/analyze", "/v1/subscribe", "/metrics",
-                      "/healthz"],
+        "endpoints": ["/v1/analyze", "/v1/subscribe", "/v1/traces",
+                      "/metrics", "/healthz"],
         **({"recording": recorder.path} if recorder is not None else {}),
     }), flush=True)
     try:
@@ -714,6 +714,22 @@ def cmd_replay(args) -> int:
         stats = mint_recording(path, args.mint)
         print(json.dumps(stats, indent=None if args.compact else 2))
         return 0
+    if args.trace_out:
+        # timeline reconstruction (ISSUE 11): the Chrome trace comes
+        # from the spans embedded in the recording's tick frames — the
+        # times the incident actually had, no re-run required
+        from rca_tpu.observability.export import (
+            recording_trace,
+            write_chrome_trace,
+        )
+
+        trace = recording_trace(path)
+        write_chrome_trace(trace, args.trace_out)
+        print(json.dumps({
+            "trace_out": args.trace_out,
+            "trace_events": len(trace["traceEvents"]),
+        }, indent=None if args.compact else 2))
+        return 0 if trace["traceEvents"] else 1
     engine = _replay_engine(args.engine)
     rec = load_recording(path)
     if rec.mode == "serve":
@@ -732,6 +748,22 @@ def cmd_replay(args) -> int:
                      default=str))
     ok = report.get("parity_ok", not report.get("divergent", False))
     return 0 if ok else 1
+
+
+def cmd_profile(args) -> int:
+    """``rca profile`` (OBSERVABILITY.md): wrap a synthetic streaming
+    session's ticks in a ``jax.profiler`` capture, with per-tick
+    ``StepTraceAnnotation`` grouping and the per-shape kernel
+    attribution stamped into the summary — the diagnosis surface for
+    ``pallas_engaged: false`` regressions."""
+    from rca_tpu.observability.profile import profile_ticks
+
+    summary = profile_ticks(
+        args.out, ticks=args.ticks, services=args.services,
+        seed=args.seed,
+    )
+    print(json.dumps(summary, indent=None if args.compact else 2))
+    return 0
 
 
 def cmd_lint(args) -> int:
@@ -1079,9 +1111,30 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--investigation", default=None, metavar="ID",
                     help="resolve the recording from this stored "
                     "investigation's recording_ref")
+    sp.add_argument("--trace-out", default=None, dest="trace_out",
+                    metavar="PATH",
+                    help="write the recording's span timeline as "
+                    "Perfetto-loadable Chrome trace JSON (from the "
+                    "spans embedded in its tick frames; needs a "
+                    "recording made with RCA_TRACE=1) and exit")
     sp.add_argument("--log-dir", default="logs")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser(
+        "profile",
+        help="opt-in jax.profiler capture around N live ticks "
+        "(OBSERVABILITY.md): TensorBoard/Perfetto-loadable device "
+        "trace + per-shape kernel attribution",
+    )
+    sp.add_argument("--out", default="logs/profile", metavar="DIR",
+                    help="profile output directory (default logs/profile)")
+    sp.add_argument("--ticks", type=int, default=20)
+    sp.add_argument("--services", type=int, default=200,
+                    help="synthetic world size the capture runs over")
+    sp.add_argument("--seed", type=int, default=7)
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser(
         "lint",

@@ -438,6 +438,44 @@ def gateway_tenant_rps() -> float:
     return env_float("RCA_GATEWAY_TENANT_RPS", 0.0, 0.0, 1e6)
 
 
+# -- tracing + SLO telemetry (ISSUE 11) --------------------------------------
+# env knobs for the span-based tracing subsystem (rca_tpu/observability,
+# OBSERVABILITY.md), each validated here so a typo'd value fails loudly:
+#
+#   RCA_TRACE         0 (default) | 1 — wire-to-device distributed tracing.
+#                     0 is the ZERO-COST path: every component holds the
+#                     shared NULL tracer, span calls are constant no-ops,
+#                     and results are bit-identical to pre-tracing builds
+#                     (property-tested).  1 records spans into the bounded
+#                     ring buffer, exports them on `GET /v1/traces`, and
+#                     stamps them into tick health records + recordings.
+#   RCA_TRACE_BUFFER  [64, 1_000_000]  spans kept in the ring buffer
+#                     (default 8192; beyond it the OLDEST spans drop and
+#                     the drop counter rises — saturation sheds history,
+#                     never blocks the serve path)
+#   RCA_SLO_MS        [1, 600_000]  per-request latency SLO target, ms
+#                     (default 500) — the burn-rate counters in /metrics
+#                     count completions slower than this (or failed)
+
+
+def trace_enabled() -> bool:
+    """``RCA_TRACE``: span-based request tracing (default off — the
+    zero-cost null-tracer path)."""
+    return env_str(
+        "RCA_TRACE", "0", choices=("0", "1", "on", "off"), lower=True,
+    ) in ("1", "on")
+
+
+def trace_buffer_cap() -> int:
+    """``RCA_TRACE_BUFFER``: ring-buffer span capacity."""
+    return env_int("RCA_TRACE_BUFFER", 8192, 64, 1_000_000)
+
+
+def slo_ms() -> float:
+    """``RCA_SLO_MS``: the per-request latency SLO target (ms)."""
+    return env_float("RCA_SLO_MS", 500.0, 1.0, 600_000.0)
+
+
 # -- persistent compilation cache (ISSUE 2 satellite) -----------------------
 # enabled at most once per process; the dict is the recorded status the
 # session health records and bench line carry

@@ -110,6 +110,7 @@ class LiveStreamingSession:
         recorder=None,
         clock=None,
         use_columnar: Optional[bool] = None,
+        tracer=None,
     ):
         """``topology_check_every``: do a full sweep + dependency-edge
         compare on every Nth poll — the edge build is the most expensive
@@ -139,6 +140,15 @@ class LiveStreamingSession:
         self.namespace = namespace
         self.k = k
         self._clock = clock or time.perf_counter
+        # tracing (ISSUE 11): one trace per session, one parentless root
+        # span per tick with capture/dispatch/fetch children — recorded
+        # into the tracer's ring buffer AND embedded in each tick's
+        # health record, which is how recordings carry the timeline
+        # (`rca replay --trace-out` rebuilds it from the tape)
+        from rca_tpu.observability.spans import default_tracer
+
+        self.tracer = tracer if tracer is not None else default_tracer()
+        self._trace_ctx = self.tracer.new_context()
         # tick pipeline (ISSUE 2 tentpole): in-flight handles, oldest first
         self.pipeline_depth = (
             pipeline_depth_from_env() if pipeline_depth is None
@@ -546,6 +556,7 @@ class LiveStreamingSession:
         output is bit-identical to the pre-resilience behavior (PARITY.md
         invariant)."""
         self._polls += 1
+        t_poll0 = self._clock()
         if self.recorder is not None:
             self.recorder.begin_tick(self._polls)
         try:
@@ -566,9 +577,51 @@ class LiveStreamingSession:
             }
         self._last_ranked = list(out.get("ranked", []))
         out["health"] = self._health_record(out)
+        self._trace_tick(out, t_poll0)
         if self.recorder is not None:
             self.recorder.end_tick(out, features=self._features)
         return out
+
+    def _trace_tick(self, out: Dict[str, Any], t0: float) -> None:
+        """Record this poll's spans and embed them in the health record.
+        The phase children are laid end to end from the measured
+        capture/dispatch/fetch durations — the same numbers PhaseStats
+        aggregates, now attributable to ONE tick with its quiet/resync/
+        degraded context and the per-shape kernel attribution attached."""
+        if not self.tracer.enabled:
+            return
+        t_end = self._clock()
+        tick_ctx = self.tracer.new_context(parent=self._trace_ctx)
+        root = self.tracer.record(
+            "tick", t0, t_end, context=tick_ctx,
+            attrs={
+                "tick": out.get("tick"),
+                "quiet": bool(out.get("quiet", False)),
+                "resynced": bool(out.get("resynced", False)),
+                "degraded": bool(out.get("degraded", False)),
+                "changed_rows": int(out.get("changed_rows", 0) or 0),
+                "upload_rows": int(out.get("upload_rows", 0) or 0),
+                "noisyor_path": getattr(
+                    self.session, "noisyor_path", None
+                ),
+                "kernel_path": getattr(
+                    self.session, "kernel_path", None
+                ),
+            },
+        )
+        spans = [root.to_dict()]
+        t = t0
+        for name, key in (("tick.capture", "capture_ms"),
+                          ("tick.dispatch", "dispatch_ms"),
+                          ("tick.fetch", "fetch_ms")):
+            dur_s = float(out.get(key, 0.0) or 0.0) / 1e3
+            child = self.tracer.record(
+                name, t, t + dur_s, parent=tick_ctx,
+                attrs={"ms": round(dur_s * 1e3, 3)},
+            )
+            t += dur_s
+            spans.append(child.to_dict())
+        out["health"]["spans"] = spans
 
     def _health_record(self, out: Dict[str, Any]) -> Dict[str, Any]:
         """Per-tick resilience health: what degraded, why, and how much
@@ -605,6 +658,10 @@ class LiveStreamingSession:
             "pipeline_flushed": self.pipeline_flushed,
             "pipeline_fill": bool(out.get("pipeline_fill", False)),
             "noisyor_path": getattr(self.session, "noisyor_path", None),
+            # the ENGAGED combine path for this session's padded shape
+            # (autotune winner AND block-divisibility — ISSUE 11): a
+            # pallas regression in a health stream names a shape
+            "kernel_path": getattr(self.session, "kernel_path", None),
             "compile_cache": dict(self._compile_cache),
             "resyncs_expired": self.resyncs_expired,
             "resyncs_topology": self.resyncs_topology,

@@ -175,6 +175,20 @@ def noisyor_path():
     return _AUTOTUNED_PATH
 
 
+def engaged_kernel(n_pad: int) -> str:
+    """The combine path a session over an ``n_pad``-padded graph
+    actually ENGAGES (ISSUE 11 satellite): the autotuner's choice is
+    per-process, but the Pallas grid additionally needs the node pad to
+    divide into blocks — so ``pallas_engaged: false`` at round level can
+    hide a per-shape story.  This is the per-shape answer, stamped into
+    streaming health records, dispatch span attributes, and bench's
+    ``kernel_by_shape``."""
+    n_pad = int(n_pad)
+    if noisyor_autotune() != "pallas":
+        return "xla"
+    return "pallas" if n_pad % min(n_pad, BLOCK_S) == 0 else "xla"
+
+
 def _time_pallas_beats_xla(s: int = 8192, reps: int = 200) -> bool:
     """One-shot timing of both combine paths on a representative [S, C]
     block: amortized in-jit loops (rep count folds a salt so no transport

@@ -334,13 +334,14 @@ class StreamingSession(StreamingHostState):
         # noisy-OR combine path picked ONCE at session start (ISSUE 2
         # satellite: BENCH_r05 had pallas_supported=true but a 4.5x-slower
         # kernel — a static flag cannot know; the autotune measures)
-        from rca_tpu.engine.pallas_kernels import BLOCK_S, noisyor_autotune
+        from rca_tpu.engine.pallas_kernels import engaged_kernel, noisyor_autotune
 
         self.noisyor_path = noisyor_autotune()
-        self._use_pallas = (
-            self.noisyor_path == "pallas"
-            and self._n_pad % min(self._n_pad, BLOCK_S) == 0
-        )
+        # the ENGAGED path for THIS padded shape (the autotune choice
+        # plus the block-divisibility gate) — health records and span
+        # attributes carry it so a pallas regression names a shape
+        self.kernel_path = engaged_kernel(self._n_pad)
+        self._use_pallas = self.kernel_path == "pallas"
         self._init_host_state(clock)
 
     def set_all(self, features: np.ndarray) -> None:

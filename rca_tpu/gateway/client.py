@@ -15,6 +15,7 @@ import json
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 from rca_tpu.gateway.wire import TENANT_HEADER, encode_analyze
+from rca_tpu.observability.spans import TRACE_HEADER
 
 
 class GatewayClient:
@@ -37,11 +38,15 @@ class GatewayClient:
         names=None, tenant: Optional[str] = None, k: int = 5,
         priority: str = "normal", deadline_ms: Optional[float] = None,
         investigation_id: Optional[str] = None,
+        trace: Optional[str] = None,
     ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         """One analyze request over the wire.  Returns ``(http_code,
         body, headers)`` — the caller maps 429/503 to its own backoff
         using the ``Retry-After`` header, exactly as an external load
-        balancer would."""
+        balancer would.  ``trace`` (an ``X-RCA-Trace`` wire value,
+        ``trace_id-span_id``) parents the gateway's spans onto the
+        caller's; absent, the gateway starts a fresh trace and echoes
+        its id in the response headers either way."""
         body = json.dumps(encode_analyze(
             features, dep_src, dep_dst, names=names, k=k,
             priority=priority, deadline_ms=deadline_ms,
@@ -50,6 +55,8 @@ class GatewayClient:
         headers = {"Content-Type": "application/json"}
         if tenant is not None:
             headers[TENANT_HEADER] = tenant
+        if trace is not None:
+            headers[TRACE_HEADER] = trace
         conn = self._conn()
         try:
             conn.request("POST", "/v1/analyze", body=body,
@@ -102,6 +109,33 @@ class GatewayClient:
             conn.request("GET", "/metrics")
             resp = conn.getresponse()
             return resp.read().decode("utf-8")
+        finally:
+            conn.close()
+
+    def traces(
+        self,
+        trace_id: Optional[str] = None,
+        max_spans: int = 1000,
+        fmt: str = "ndjson",
+    ):
+        """``GET /v1/traces``: the span buffer — a list of span dicts
+        (NDJSON decoded), or the Chrome trace object with
+        ``fmt="chrome"``."""
+        query = f"/v1/traces?max={int(max_spans)}&format={fmt}"
+        if trace_id is not None:
+            query += f"&trace_id={trace_id}"
+        conn = self._conn()
+        try:
+            conn.request("GET", query)
+            resp = conn.getresponse()
+            raw = resp.read().decode("utf-8")
+            if resp.status != 200:
+                raise RuntimeError(f"traces: HTTP {resp.status}: {raw!r}")
+            if fmt == "chrome":
+                return json.loads(raw)
+            return [
+                json.loads(line) for line in raw.splitlines() if line
+            ]
         finally:
             conn.close()
 

@@ -6,6 +6,14 @@ summary` (one lock-guarded copy of the whole serving plane: per-tenant
 counters, per-replica rows, cache events) and the gateway's own HTTP
 counters — so a scrape never interleaves with the replicas mutating the
 live accumulators (ISSUE 9's snapshot-consistency fix).
+
+ISSUE 11 brings the exposition up to proper format: gauges carry a
+millisecond timestamp (``name{labels} value ts`` — a scraped gauge
+without one is a point with no WHEN), and per-tenant request durations
+export as a real ``histogram`` family (``rca_request_duration_seconds``
+with cumulative ``le`` buckets + ``_sum``/``_count``) next to the SLO
+burn counters — burn rate is then one PromQL division away, which
+quantile gauges could never give a scraper.
 """
 
 from __future__ import annotations
@@ -24,16 +32,18 @@ def _esc(value: str) -> str:
     )
 
 
-def _line(out: List[str], name: str, value, **labels) -> None:
+def _line(out: List[str], name: str, value, ts: Optional[int] = None,
+          **labels) -> None:
     if value is None:
         return
+    suffix = f" {ts}" if ts is not None else ""
     if labels:
         lab = ",".join(
             f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
         )
-        out.append(f"{name}{{{lab}}} {value}")
+        out.append(f"{name}{{{lab}}} {value}{suffix}")
     else:
-        out.append(f"{name} {value}")
+        out.append(f"{name} {value}{suffix}")
 
 
 def _head(out: List[str], name: str, kind: str, help_: str) -> None:
@@ -45,8 +55,11 @@ def render_metrics_text(
     serve_summary: Dict[str, Any],
     gateway: Optional[Dict[str, Any]] = None,
     healthy: Optional[bool] = None,
+    now_ms: Optional[int] = None,
 ) -> str:
-    """The full exposition body (text/plain; version=0.0.4)."""
+    """The full exposition body (text/plain; version=0.0.4).
+    ``now_ms`` (ms since epoch, from the gateway's wall seam) stamps
+    every GAUGE sample; counters stay timestamp-free per convention."""
     out: List[str] = []
 
     _head(out, "rca_serve_requests_total", "counter",
@@ -61,9 +74,37 @@ def render_metrics_text(
           "per-tenant time-in-queue quantiles (ms)")
     for tenant, rec in sorted(tenants.items()):
         _line(out, "rca_serve_queue_ms", rec.get("queue_ms_p50"),
-              tenant=tenant, quantile="0.5")
+              ts=now_ms, tenant=tenant, quantile="0.5")
         _line(out, "rca_serve_queue_ms", rec.get("queue_ms_p99"),
-              tenant=tenant, quantile="0.99")
+              ts=now_ms, tenant=tenant, quantile="0.99")
+
+    # per-tenant duration histogram + SLO burn (ISSUE 11): proper
+    # cumulative le buckets so burn rate / latency SLIs are PromQL
+    duration = serve_summary.get("duration") or {}
+    if duration:
+        _head(out, "rca_request_duration_seconds", "histogram",
+              "submit-to-completion request duration per tenant")
+        for tenant, hist in sorted(duration.items()):
+            for le, n in hist.get("buckets", {}).items():
+                _line(out, "rca_request_duration_seconds_bucket", n,
+                      tenant=tenant, le=le)
+            _line(out, "rca_request_duration_seconds_bucket",
+                  hist.get("count", 0), tenant=tenant, le="+Inf")
+            _line(out, "rca_request_duration_seconds_sum",
+                  hist.get("sum_s", 0.0), tenant=tenant)
+            _line(out, "rca_request_duration_seconds_count",
+                  hist.get("count", 0), tenant=tenant)
+    breaches = serve_summary.get("slo_breaches")
+    if breaches is not None:
+        _head(out, "rca_slo_breaches_total", "counter",
+              "completions over RCA_SLO_MS (or failed) per tenant")
+        for tenant, n in sorted(breaches.items()):
+            _line(out, "rca_slo_breaches_total", n, tenant=tenant)
+    if serve_summary.get("slo_ms") is not None:
+        _head(out, "rca_slo_target_ms", "gauge",
+              "the configured per-request latency SLO (RCA_SLO_MS)")
+        _line(out, "rca_slo_target_ms", serve_summary["slo_ms"],
+              ts=now_ms)
 
     _head(out, "rca_serve_resident_delta_requests_total", "counter",
           "requests served via the resident delta path, per tenant")
@@ -81,7 +122,7 @@ def render_metrics_text(
     _head(out, "rca_serve_queue_depth_peak", "gauge",
           "peak queue depth observed at admission")
     _line(out, "rca_serve_queue_depth_peak",
-          serve_summary.get("queue_depth_peak", 0))
+          serve_summary.get("queue_depth_peak", 0), ts=now_ms)
 
     _head(out, "rca_serve_graph_cache_events_total", "counter",
           "prepared-graph cache events")
@@ -113,15 +154,17 @@ def render_metrics_text(
         _head(out, "rca_serve_replica_state", "gauge",
               "1 for the replica's current breaker/liveness state")
         for rid, rec in sorted(replicas.items()):
-            _line(out, "rca_serve_replica_state", 1, replica=rid,
+            _line(out, "rca_serve_replica_state", 1, ts=now_ms, replica=rid,
                   state=str(rec.get("state", "closed")))
         _head(out, "rca_serve_replica_occupancy", "gauge",
               "per-replica occupancy quantiles (staged + in flight)")
         for rid, rec in sorted(replicas.items()):
             _line(out, "rca_serve_replica_occupancy",
-                  rec.get("occupancy_p50"), replica=rid, quantile="0.5")
+                  rec.get("occupancy_p50"), ts=now_ms, replica=rid,
+                  quantile="0.5")
             _line(out, "rca_serve_replica_occupancy",
-                  rec.get("occupancy_max"), replica=rid, quantile="1.0")
+                  rec.get("occupancy_max"), ts=now_ms, replica=rid,
+                  quantile="1.0")
 
     if gateway is not None:
         _head(out, "rca_gateway_requests_total", "counter",
@@ -133,9 +176,9 @@ def render_metrics_text(
               "gateway request latency quantiles (ms) by route")
         for route, rec in sorted(gateway.get("latency", {}).items()):
             _line(out, "rca_gateway_request_ms", rec.get("p50"),
-                  route=route, quantile="0.5")
+                  ts=now_ms, route=route, quantile="0.5")
             _line(out, "rca_gateway_request_ms", rec.get("p99"),
-                  route=route, quantile="0.99")
+                  ts=now_ms, route=route, quantile="0.99")
         _head(out, "rca_gateway_streams_opened_total", "counter",
               "tick subscriptions opened")
         _line(out, "rca_gateway_streams_opened_total",
@@ -157,6 +200,6 @@ def render_metrics_text(
     if healthy is not None:
         _head(out, "rca_gateway_up", "gauge",
               "1 while the serving plane is routable")
-        _line(out, "rca_gateway_up", 1 if healthy else 0)
+        _line(out, "rca_gateway_up", 1 if healthy else 0, ts=now_ms)
 
     return "\n".join(out) + "\n"

@@ -58,6 +58,12 @@ from rca_tpu.gateway.wire import (
     response_body,
     status_code_for,
 )
+from rca_tpu.observability.export import chrome_trace, ndjson_spans
+from rca_tpu.observability.spans import (
+    TRACE_HEADER,
+    SpanContext,
+    default_tracer,
+)
 from rca_tpu.obslog.profiling import PhaseStats
 from rca_tpu.serve.client import ServeClient
 from rca_tpu.util.net import bound_address, make_server_socket
@@ -298,6 +304,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_json(
         self, code: int, body: Dict[str, Any],
         retry_after: Optional[int] = None,
+        trace: Optional[str] = None,
     ) -> None:
         payload = json.dumps(body).encode("utf-8")
         self.send_response(code)
@@ -305,6 +312,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         if retry_after is not None:
             self.send_header("Retry-After", str(retry_after))
+        if trace is not None:
+            # the header contract: context in, context out — the caller
+            # can stitch its own spans onto the gateway's
+            self.send_header(TRACE_HEADER, trace)
         self.end_headers()
         self.wfile.write(payload)
 
@@ -356,6 +367,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._route(self._get_healthz, "healthz")
         elif parts.path == "/metrics":
             self._route(self._get_metrics, "metrics")
+        elif parts.path == "/v1/traces":
+            self._route(
+                lambda: self._get_traces(parse_qs(parts.query)),
+                "traces",
+            )
         elif parts.path == "/v1/subscribe":
             self._route(
                 lambda: self._get_subscribe(parse_qs(parts.query)),
@@ -372,18 +388,41 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _post_analyze(self) -> int:
         gw = self.gateway
+        t0 = gw.clock()
+        # trace context enters here (ISSUE 11): parse the caller's
+        # X-RCA-Trace (malformed = absent), mint THIS request's gateway
+        # span as its child (or a fresh trace), echo the context back —
+        # even when the body is later rejected, the caller can correlate
+        wire_ctx = SpanContext.from_wire(self.headers.get(TRACE_HEADER))
+        gctx = gw.tracer.new_context(parent=wire_ctx)
+        echo = (gctx or wire_ctx).to_wire() if (gctx or wire_ctx) else None
+
+        def _finish(code: int, body: Dict[str, Any],
+                    retry_after: Optional[int] = None,
+                    status: str = "error") -> int:
+            if gctx is not None:
+                gw.tracer.record(
+                    "gateway.analyze", t0, gw.clock(),
+                    parent=wire_ctx, context=gctx,
+                    attrs={"code": code, "status": status,
+                           "tenant": body.get("tenant", "")},
+                )
+                body.setdefault("trace_id", gctx.trace_id)
+            self._send_json(code, body, retry_after=retry_after,
+                            trace=echo)
+            return code
+
         length = int(self.headers.get("Content-Length") or 0)
         if length > gw.max_body:
             # refuse BEFORE reading the flood: backpressure that only
             # engages after parsing the payload is not backpressure
             gw.metrics.body_rejected()
             self.close_connection = True
-            self._send_json(413, {
+            return _finish(413, {
                 "status": "error",
                 "detail": f"body {length} B over the "
                 f"{gw.max_body} B cap (RCA_GATEWAY_MAX_BODY)",
             })
-            return 413
         raw = self.rfile.read(length)
         try:
             body = json.loads(raw.decode("utf-8"))
@@ -392,8 +431,7 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except (WireError, UnicodeDecodeError,
                 json.JSONDecodeError) as exc:
-            self._send_json(400, {"status": "error", "detail": str(exc)})
-            return 400
+            return _finish(400, {"status": "error", "detail": str(exc)})
         if gw.limiter is not None:
             wait = gw.limiter.admit(kwargs.get("tenant", ""))
             if wait > 0.0:
@@ -401,7 +439,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # serve queue, so one hot tenant cannot fill the global
                 # cap ahead of everyone else's fair share
                 gw.metrics.rate_limited()
-                self._send_json(429, {
+                return _finish(429, {
                     "status": "rate_limited",
                     "tenant": kwargs.get("tenant", ""),
                     "detail": (
@@ -409,23 +447,24 @@ class _Handler(BaseHTTPRequestHandler):
                         f"({gw.limiter.rps:g} req/s, "
                         "RCA_GATEWAY_TENANT_RPS) exceeded"
                     ),
-                }, retry_after=max(1, int(wait + 0.999)))
-                return 429
-        req = gw.client.submit(**kwargs)
+                }, retry_after=max(1, int(wait + 0.999)),
+                    status="rate_limited")
+        req = gw.client.submit(trace_parent=gctx, **kwargs)
         try:
             resp = req.result(gw.timeout_s)
         except TimeoutError:
-            self._send_json(504, {
+            return _finish(504, {
                 "status": "error", "request_id": req.request_id,
                 "tenant": req.tenant,
                 "detail": f"not completed within {gw.timeout_s}s",
-            })
-            return 504
+            }, status="timeout")
         out = response_body(resp)
+        if req.trace is not None:
+            out["trace_id"] = req.trace.trace_id
         gw.hub.publish(out)
         code, retry_after = status_code_for(resp.status)
-        self._send_json(code, out, retry_after=retry_after)
-        return code
+        return _finish(code, out, retry_after=retry_after,
+                       status=resp.status)
 
     def _get_healthz(self) -> int:
         health = self.gateway.health()
@@ -439,9 +478,46 @@ class _Handler(BaseHTTPRequestHandler):
             gw.loop.metrics.summary(),
             gateway=gw.metrics.snapshot(),
             healthy=gw.health()["ok"],
+            # proper exposition format (ISSUE 11 satellite): gauges carry
+            # a millisecond timestamp so a scraper knows WHEN the point
+            # was true; the wall read goes through the injectable seam
+            now_ms=int(gw.wall() * 1e3),
         )
         self._send_text(200, text,
                         content_type="text/plain; version=0.0.4")
+        return 200
+
+    def _get_traces(self, query: Dict[str, list]) -> int:
+        """``GET /v1/traces`` (ISSUE 11): the tracer's span buffer on
+        the wire.  ``trace_id`` filters to one trace; ``max`` keeps the
+        newest N (default 1000); ``format=chrome`` returns one
+        Perfetto-loadable Chrome trace JSON object instead of NDJSON.
+        With ``RCA_TRACE=0`` the buffer is empty — 200 with zero lines,
+        plus an X-RCA-Trace-Enabled header saying why."""
+        gw = self.gateway
+        trace_id = (query.get("trace_id") or [None])[0]
+        fmt = (query.get("format") or ["ndjson"])[0]
+        try:
+            limit = int((query.get("max") or ["1000"])[0])
+        except ValueError:
+            self._send_json(400, {
+                "status": "error", "detail": "max must be an integer",
+            })
+            return 400
+        spans = gw.tracer.spans(trace_id=trace_id, limit=limit)
+        if fmt == "chrome":
+            payload = json.dumps(chrome_trace(spans)).encode("utf-8")
+            content_type = "application/json"
+        else:
+            payload = ndjson_spans(spans).encode("utf-8")
+            content_type = "application/x-ndjson"
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.send_header("X-RCA-Trace-Enabled",
+                         "1" if gw.tracer.enabled else "0")
+        self.end_headers()
+        self.wfile.write(payload)
         return 200
 
     def _get_subscribe(self, query: Dict[str, list]) -> int:
@@ -516,10 +592,23 @@ class GatewayServer:
         timeout_s: float = DEFAULT_TIMEOUT_S,
         clock: Callable[[], float] = time.monotonic,
         tenant_rps: Optional[float] = None,
+        tracer=None,
+        wall: Callable[[], float] = time.time,
     ):
         self.loop = loop
         self.client = ServeClient(loop)
         self.clock = clock
+        # wall-clock seam for /metrics gauge timestamps (exposition
+        # format wants ms-since-epoch; the injectable reference keeps
+        # nondet-discipline — no direct wall read on any handler path)
+        self.wall = wall
+        # the serving plane's tracer and the gateway's must be ONE
+        # tracer for a wire request to read as one connected trace;
+        # default both to the process tracer, prefer the plane's own
+        self.tracer = (
+            tracer if tracer is not None
+            else getattr(loop, "tracer", None) or default_tracer()
+        )
         self.max_body = int(max_body) if max_body is not None \
             else gateway_max_body()
         self.timeout_s = float(timeout_s)

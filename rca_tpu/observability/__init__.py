@@ -1,0 +1,42 @@
+"""Span-based distributed tracing + SLO telemetry (ISSUE 11).
+
+The serving plane's only timing signals used to be aggregate —
+``PhaseStats`` medians, Prometheus counters — so "where did THIS slow
+request spend its 40 ms" had no answer.  This package is the answer's
+substrate: :class:`Tracer` mints and collects spans (OBSERVABILITY.md),
+:mod:`export` turns them into Perfetto-loadable Chrome trace JSON and
+NDJSON wire dumps, and :mod:`profile` wraps a live session in a
+``jax.profiler`` capture so device work lines up under the host spans.
+"""
+
+from rca_tpu.observability.spans import (  # noqa: F401
+    NULL_TRACER,
+    Span,
+    SpanContext,
+    Tracer,
+    default_tracer,
+    device_annotation,
+    set_default_tracer,
+)
+from rca_tpu.observability.export import (  # noqa: F401
+    DURATION_BUCKETS_S,
+    LatencyHistogram,
+    chrome_trace,
+    ndjson_spans,
+    recording_trace,
+)
+
+__all__ = [
+    "NULL_TRACER",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "default_tracer",
+    "set_default_tracer",
+    "device_annotation",
+    "DURATION_BUCKETS_S",
+    "LatencyHistogram",
+    "chrome_trace",
+    "ndjson_spans",
+    "recording_trace",
+]

@@ -1,0 +1,93 @@
+"""``rca profile``: an opt-in jax.profiler capture around N live ticks.
+
+ROADMAP item 4's standing diagnosis gap: every bench round since r02
+reports ``pallas_engaged: false``, and nothing attributed the choice to
+a shape.  This capture makes the XLA-vs-Pallas decision visible per
+request: it runs a mock-cluster streaming session for ``ticks`` polls
+inside ``jax.profiler.trace`` (TensorBoard/Perfetto-loadable), wraps
+each poll in a ``jax.profiler.StepTraceAnnotation`` so device ops group
+under tick numbers, engages :func:`rca_tpu.observability.spans.
+device_annotation` inside the serve/tick dispatch paths, and stamps the
+autotuner's chosen combine path — plus the ENGAGED kernel per shape
+bucket, which is the part a round-level flag cannot say — into the span
+attributes and the returned summary.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from rca_tpu.observability.spans import (
+    Tracer,
+    default_tracer,
+    set_profiling,
+)
+
+
+def profile_ticks(
+    out_dir: str,
+    ticks: int = 20,
+    services: int = 200,
+    seed: int = 7,
+    tracer: Optional[Tracer] = None,
+    clock: Callable[[], float] = time.perf_counter,
+) -> Dict[str, Any]:
+    """Capture a ``jax.profiler`` trace around ``ticks`` polls of a
+    synthetic streaming session; returns the capture summary (the CLI
+    prints it as one JSON line).  The profile lands under ``out_dir``;
+    host spans for every tick land in ``tracer`` (default: the process
+    tracer) with the kernel attribution attached."""
+    import jax
+
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.engine.pallas_kernels import noisyor_autotune
+
+    if tracer is None:
+        # an explicit profile capture is its own opt-in: record spans
+        # even when RCA_TRACE is off (the process default stays null)
+        tracer = default_tracer()
+        if not tracer.enabled:
+            tracer = Tracer()
+    os.makedirs(out_dir, exist_ok=True)
+    world = synthetic_cascade_world(
+        int(services), n_roots=1, seed=int(seed), namespace="profile"
+    )
+    client = MockClusterClient(world)
+    session = LiveStreamingSession(
+        client, "profile", k=5, tracer=tracer,
+    )
+    noisyor = noisyor_autotune()
+    kernel_path = getattr(session.session, "kernel_path", None)
+    n_pad = getattr(session.session, "_n_pad", None)
+    set_profiling(True)
+    t0 = clock()
+    try:
+        with jax.profiler.trace(out_dir):
+            for i in range(int(ticks)):
+                with jax.profiler.StepTraceAnnotation("rca_tick",
+                                                      step_num=i):
+                    session.poll()
+    finally:
+        set_profiling(False)
+    wall_ms = (clock() - t0) * 1e3
+    return {
+        "ticks": int(ticks),
+        "services": int(services),
+        "trace_dir": out_dir,
+        "wall_ms": round(wall_ms, 3),
+        "ms_per_tick": round(wall_ms / max(1, int(ticks)), 3),
+        "noisyor_path": noisyor,
+        # the per-shape attribution the round-level flag cannot carry:
+        # which kernel this session's padded shape actually ENGAGED
+        "kernel_by_shape": (
+            {str(n_pad): kernel_path} if n_pad is not None else {}
+        ),
+        "spans_recorded": tracer.stats()["recorded"],
+        "profile_files": sum(
+            len(files) for _r, _d, files in os.walk(out_dir)
+        ),
+    }

@@ -1,0 +1,318 @@
+"""Span vocabulary + the ONE tracer seam (ISSUE 11 tentpole).
+
+A :class:`Span` is one named time interval inside a trace; a trace is
+every span sharing a ``trace_id``, connected by ``parent_id`` edges.
+Context enters the system at the gateway via the ``X-RCA-Trace`` header
+(``<trace_id>-<span_id>``, generated when absent, echoed in responses),
+rides :class:`rca_tpu.serve.request.ServeRequest` through the queue, the
+batcher, pool routing, replica dispatch/fetch, and the resident delta
+path, and lands in the :class:`Tracer`'s bounded ring buffer — exported
+by :mod:`rca_tpu.observability.export`.
+
+Discipline (graftlint rule ``span-discipline``, ANALYSIS.md):
+
+- spans are opened ONLY through the tracer seam — ``tracer.span(...)``
+  as a ``with`` block for synchronous scopes, or ``tracer.record(...)``
+  for phases whose start/end are known timestamps (queue wait, a device
+  round trip whose ends live in different methods).  Raw ``Span(...)``
+  construction outside this module is unlandable, so an unclosed span
+  cannot exist;
+- the tracer times through an injectable ``clock`` (nondet-discipline:
+  this module is replay-covered — spans embedded in recordings must be
+  host-independent on replay, so no wall reads outside the seam);
+- ``RCA_TRACE=0`` (the default) swaps in :data:`NULL_TRACER`: every
+  call is a constant no-op behind one ``enabled`` check, nothing
+  allocates, and results are bit-identical to a build without tracing
+  (property-tested in tests/test_observability.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from rca_tpu.config import trace_buffer_cap, trace_enabled
+from rca_tpu.util.threads import make_lock
+
+#: wire header carrying trace context across the gateway boundary
+TRACE_HEADER = "X-RCA-Trace"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    """The identity a child span parents onto: ``(trace_id, span_id)``.
+    Immutable — contexts are shared across threads freely."""
+
+    trace_id: str   # 16 hex chars
+    span_id: str    # 8 hex chars
+
+    def to_wire(self) -> str:
+        return f"{self.trace_id}-{self.span_id}"
+
+    @staticmethod
+    def from_wire(value: Optional[str]) -> Optional["SpanContext"]:
+        """Parse an ``X-RCA-Trace`` header; None for anything malformed
+        (a bad header must start a fresh trace, never 500 the wire)."""
+        if not value or not isinstance(value, str):
+            return None
+        parts = value.strip().split("-")
+        if len(parts) != 2:
+            return None
+        trace_id, span_id = parts
+        if not (1 <= len(trace_id) <= 32 and 1 <= len(span_id) <= 16):
+            return None
+        try:
+            int(trace_id, 16), int(span_id, 16)
+        except ValueError:
+            return None
+        return SpanContext(trace_id, span_id)
+
+
+@dataclasses.dataclass
+class Span:
+    """One recorded interval.  Times are seconds in the minting tracer's
+    clock domain (monotonic by default); attributes are JSON-safe."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float
+    end: float
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "trace_id": self.trace_id,
+            "span_id": self.span_id, "parent_id": self.parent_id,
+            "start": self.start, "end": self.end,
+            "attrs": dict(self.attrs),
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Span":
+        return Span(
+            name=d["name"], trace_id=d["trace_id"], span_id=d["span_id"],
+            parent_id=d.get("parent_id"), start=float(d["start"]),
+            end=float(d["end"]), attrs=dict(d.get("attrs") or {}),
+        )
+
+
+class Tracer:
+    """Span minting + the lock-disciplined bounded ring buffer.
+
+    One tracer serves a whole process (``default_tracer()``); components
+    take an injectable ``tracer=`` for tests.  IDs come from a seeded
+    ``random.Random`` so a fixed seed yields a byte-stable span stream
+    (the replay tests pin one); ``seed=None`` draws system entropy once
+    at construction — ids differ across processes, never within a trace.
+
+    The buffer drops the OLDEST spans past ``cap`` and counts the drops:
+    saturation sheds history, it never blocks or grows.  The lock is a
+    leaf (nothing is called while holding it)."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        cap: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+        seed: Optional[int] = None,
+    ):
+        self.enabled = bool(enabled)
+        self.cap = int(cap) if cap is not None else trace_buffer_cap()
+        if self.cap < 1:
+            raise ValueError(f"trace buffer cap must be >= 1, got {cap}")
+        self.clock = clock
+        self._rng = random.Random(seed)
+        self._lock = make_lock("Tracer._lock")
+        self._buffer: "deque[Span]" = deque(maxlen=self.cap)
+        self.dropped = 0
+        self.recorded = 0
+
+    # -- id minting ----------------------------------------------------------
+    def _trace_id(self) -> str:
+        with self._lock:
+            return f"{self._rng.getrandbits(64):016x}"
+
+    def _span_id(self) -> str:
+        with self._lock:
+            return f"{self._rng.getrandbits(32):08x}"
+
+    def new_context(
+        self, parent: Optional[SpanContext] = None
+    ) -> Optional[SpanContext]:
+        """Mint the identity of a span BEFORE recording it — the serve
+        path hands a request's root context to children (queue, batch,
+        dispatch) that finish before the root span itself is recorded at
+        completion.  A child keeps the parent's trace_id; no parent
+        starts a fresh trace.  None when disabled (zero-allocation)."""
+        if not self.enabled:
+            return None
+        trace_id = parent.trace_id if parent is not None else self._trace_id()
+        return SpanContext(trace_id, self._span_id())
+
+    # -- recording -----------------------------------------------------------
+    def _push(self, span: Span) -> Span:
+        with self._lock:
+            if len(self._buffer) == self.cap:
+                self.dropped += 1
+            self._buffer.append(span)
+            self.recorded += 1
+        return span
+
+    def record(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        parent: Optional[SpanContext] = None,
+        context: Optional[SpanContext] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Span]:
+        """A COMPLETE span from caller-supplied timestamps (the caller's
+        clock domain) — the form for phases that start and end in
+        different methods, where a with-block cannot exist.  ``context``
+        records under a pre-minted identity (``new_context``); otherwise
+        a fresh child of ``parent`` is minted."""
+        if not self.enabled:
+            return None
+        ctx = context if context is not None else self.new_context(parent)
+        return self._push(Span(
+            name=name, trace_id=ctx.trace_id, span_id=ctx.span_id,
+            parent_id=parent.span_id if parent is not None else None,
+            start=float(start), end=float(end), attrs=dict(attrs or {}),
+        ))
+
+    def event(
+        self,
+        name: str,
+        at: float,
+        parent: Optional[SpanContext] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Span]:
+        """A zero-duration marker (steal moves, breaker flips)."""
+        return self.record(name, at, at, parent=parent, attrs=attrs)
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        parent: Optional[SpanContext] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Iterator[Optional[Span]]:
+        """A synchronous scope, timed on the tracer's clock and recorded
+        at exit even when the body raises.  MUST be used as a ``with``
+        block (graftlint rule span-discipline) — that is what guarantees
+        every opened span closes."""
+        if not self.enabled:
+            yield None
+            return
+        ctx = self.new_context(parent)
+        span = Span(
+            name=name, trace_id=ctx.trace_id, span_id=ctx.span_id,
+            parent_id=parent.span_id if parent is not None else None,
+            start=self.clock(), end=0.0, attrs=dict(attrs or {}),
+        )
+        try:
+            yield span
+        finally:
+            span.end = self.clock()
+            self._push(span)
+
+    # -- reading -------------------------------------------------------------
+    def spans(
+        self,
+        trace_id: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """A consistent snapshot of the buffer (oldest first), optionally
+        filtered to one trace and/or capped to the NEWEST ``limit``."""
+        with self._lock:
+            out = [s.to_dict() for s in self._buffer]
+        if trace_id is not None:
+            out = [s for s in out if s["trace_id"] == trace_id]
+        if limit is not None and limit >= 0:
+            out = out[-limit:]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buffer.clear()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "recorded": self.recorded, "dropped": self.dropped,
+                "buffered": len(self._buffer), "cap": self.cap,
+            }
+
+
+class _NullTracer(Tracer):
+    """The ``RCA_TRACE=0`` path: same surface, constant no-ops.  One
+    shared instance — components hold it without allocating anything."""
+
+    def __init__(self) -> None:
+        super().__init__(enabled=False, cap=1, seed=0)
+
+
+#: the shared disabled tracer (never records; ``enabled`` is False)
+NULL_TRACER = _NullTracer()
+
+_DEFAULT: Optional[Tracer] = None
+
+
+def default_tracer() -> Tracer:
+    """The process tracer: a real one when ``RCA_TRACE=1`` (buffer sized
+    by ``RCA_TRACE_BUFFER``), else :data:`NULL_TRACER`.  Resolved once;
+    tests inject tracers explicitly (or call ``set_default_tracer``)
+    instead of mutating the environment mid-process."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = Tracer() if trace_enabled() else NULL_TRACER
+    return _DEFAULT
+
+
+def set_default_tracer(tracer: Optional[Tracer]) -> None:
+    """Override (or with None, re-resolve from env on next use) the
+    process tracer — the CLI entry points and tests use this."""
+    global _DEFAULT
+    _DEFAULT = tracer
+
+
+# -- jax.profiler hooks -------------------------------------------------------
+
+_PROFILING = False
+
+
+def profiling_active() -> bool:
+    """Is an ``rca profile`` capture in progress?  Device annotations
+    engage only then — ``jax.profiler.TraceAnnotation`` is cheap but not
+    free, and outside a capture there is no trace to annotate."""
+    return _PROFILING
+
+
+def set_profiling(active: bool) -> None:
+    global _PROFILING
+    _PROFILING = bool(active)
+
+
+def device_annotation(name: str, **kwargs):
+    """A ``jax.profiler.TraceAnnotation`` naming the host scope that
+    issues device work, so the profiler's device timeline lines up under
+    the serve/tick spans; a no-op context outside a profile capture."""
+    if not _PROFILING:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name, **kwargs)

@@ -165,6 +165,7 @@ class ShardedStreamingSession(StreamingHostState):
         # Pallas pair kernel has no shard_map twin); recorded so the tick
         # health channel shows which combine path ran, same as dense
         self.noisyor_path = "xla"
+        self.kernel_path = "xla"   # per-shape twin of the dense session's
         self._feat_sharding = NamedSharding(self.mesh, P("sp", None))
         self._features = jax.device_put(
             jnp.zeros((self._n_pad, num_features), jnp.float32),

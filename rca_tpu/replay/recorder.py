@@ -60,6 +60,10 @@ FEATURES_FULL_CAP = 65536
 _HEALTH_KEYS = (
     "sanitized_rows", "degradation", "resyncs_expired", "resyncs_topology",
     "pipeline_fill", "retries",
+    # ISSUE 11: the tick's span list (absent with RCA_TRACE=0) — what
+    # lets `rca replay --trace-out` rebuild an incident's timeline from
+    # the tape instead of re-running it
+    "spans",
 )
 
 
@@ -208,9 +212,13 @@ class Recorder:
         analysis solo and the serve parity contract (any batch width ==
         solo) makes bit-identity the expectation, not a hope."""
         self._ensure_header()
+        trace = getattr(req, "trace", None)
         self._writer.append({
             "kind": "serve", "index": self.serve_recorded,
             "request_id": req.request_id, "tenant": req.tenant,
+            # trace identity (ISSUE 11): lets a serve recording map each
+            # request onto its wire trace without re-serving anything
+            "trace_id": trace.trace_id if trace is not None else None,
             "k": int(req.k),
             "names": list(req.names) if req.names is not None else None,
             "features": encode_array(req.features),

@@ -49,6 +49,10 @@ class ShapeBucketBatcher:
 
     # -- staging -------------------------------------------------------------
     def offer(self, req: ServeRequest) -> None:
+        # batch-wait accounting (ISSUE 11): the serve.batch span runs
+        # from here to batch formation; a re-offer (work steal) restamps,
+        # so the span measures time on the replica that actually served it
+        req.staged_at = self.clock()
         self._groups.setdefault(req.graph_key, []).append(req)
         self._staged += 1
 

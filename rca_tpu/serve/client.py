@@ -64,10 +64,14 @@ class ServeClient:
         priority: int = PRIORITY_NORMAL,
         deadline_ms: Optional[float] = None,
         investigation_id: Optional[str] = None,
+        trace_parent=None,
     ) -> ServeRequest:
         """Queue one analyze request; returns immediately with the
         request future (``queue_full``/``shed`` outcomes are already
-        completed on it)."""
+        completed on it).  ``trace_parent`` (an observability
+        ``SpanContext``) parents the request's trace onto the caller's
+        span — the gateway passes its request span here so one wire call
+        reads as one connected trace."""
         deadline_s = (
             self.loop.clock() + deadline_ms / 1e3
             if deadline_ms is not None else None
@@ -76,6 +80,7 @@ class ServeClient:
             tenant=tenant, features=features, dep_src=dep_src,
             dep_dst=dep_dst, names=names, k=k, priority=priority,
             deadline_s=deadline_s, investigation_id=investigation_id,
+            trace_parent=trace_parent,
         )
         self.loop.submit(req)
         return req

@@ -70,6 +70,10 @@ class _PreparedGraph:
     base_host: object = None      # np [n_pad, C] raw mirror (diff base)
     base_dev: object = None       # device [n_pad, C]
     base_clean: bool = False
+    # the combine kernel THIS padded shape engages (ISSUE 11 satellite:
+    # xla | pallas per shape, not per round) — stamped into dispatch
+    # span attributes so a pallas regression names a shape bucket
+    kernel: str = "xla"
 
 
 @dataclasses.dataclass
@@ -90,6 +94,8 @@ class BatchHandle:
     engine_tag: str
     dispatch_ms: float
     dispatched_at: float          # scheduler-clock stamp at dispatch
+    kernel: str = "xla"           # engaged combine path for this shape
+    resident_delta: bool = False  # lanes rode the delta-scatter path
 
 
 class BatchDispatcher:
@@ -165,6 +171,9 @@ class BatchDispatcher:
                 n=n, n_pad=graph.n_pad, n_edges=len(req.dep_src),
                 sharded_graph=graph,
                 kk=min(K_CAP + 8, graph.n_pad),
+                # the sharded per-block kernel keeps XLA's fused
+                # noisy-OR (no shard_map twin of the Pallas pair)
+                kernel="xla",
             )
         else:
             import jax.numpy as jnp
@@ -182,12 +191,15 @@ class BatchDispatcher:
             down_seg, up_seg, up_ell = coo_layouts_for(
                 n_pad, e_pad, req.dep_src, req.dep_dst
             )
+            from rca_tpu.engine.pallas_kernels import engaged_kernel
+
             gs = _PreparedGraph(
                 n=n, n_pad=n_pad, n_edges=len(req.dep_src),
                 edges_j=jnp.asarray(np.stack([s, d])),
                 down_seg=down_seg, up_seg=up_seg, up_ell=up_ell,
                 n_live=jnp.asarray(n, jnp.int32),
                 kk=min(K_CAP + 8, n_pad),
+                kernel=engaged_kernel(n_pad),
             )
         evictions = 0
         with self._graphs_lock:
@@ -251,6 +263,7 @@ class BatchDispatcher:
         gs = self._prepared(batch[0])
         b = len(batch)
         b_pad = self._b_pad(b)
+        deltas = None
         if self._sharded:
             from rca_tpu.engine.runner import finite_mask_rows_np
             from rca_tpu.parallel.sharded import stage_batch_ranked
@@ -277,6 +290,7 @@ class BatchDispatcher:
                 stacked, diag, vals, idx, n_bad = self._dispatch_full(
                     gs, batch, b_pad,
                 )
+        delta_path = not self._sharded and deltas is not None
         return BatchHandle(
             requests=list(batch), stacked=stacked, diag=diag, vals=vals,
             idx=idx, n_bad=n_bad, n=gs.n, engine_tag=self.engine_tag,
@@ -284,6 +298,7 @@ class BatchDispatcher:
             # direct (loop-less) callers get a self-consistent stamp; the
             # serve loop always passes its scheduler clock's ``now``
             dispatched_at=now if now is not None else self._clock(),
+            kernel=gs.kernel, resident_delta=delta_path,
         )
 
     def _dispatch_full(

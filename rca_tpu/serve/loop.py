@@ -36,6 +36,7 @@ import time
 from typing import Callable, List, Optional
 
 from rca_tpu.config import ServeConfig
+from rca_tpu.observability.spans import default_tracer, device_annotation
 from rca_tpu.resilience.policy import (
     CircuitBreaker,
     record_fault,
@@ -66,9 +67,14 @@ class ServeLoop:
         breaker: Optional[CircuitBreaker] = None,
         dispatcher: Optional[BatchDispatcher] = None,
         recorder=None,
+        tracer=None,
     ):
         self.config = config or ServeConfig.from_env()
         self.clock = clock
+        # distributed tracing (ISSUE 11): admission mints each request's
+        # root context; the loop records queue/batch/dispatch/fetch
+        # spans; the sink closes the root at completion
+        self.tracer = tracer if tracer is not None else default_tracer()
         self.queue = RequestQueue(self.config.queue_cap, clock=clock)
         self.batcher = ShapeBucketBatcher(
             self.config.max_batch, self.config.max_wait_us, clock=clock
@@ -96,6 +102,7 @@ class ServeLoop:
         # accounting, store notes, and recorder frames
         self.sink = CompletionSink(
             self.metrics, clock, store=store, recorder=recorder,
+            tracer=self.tracer,
         )
         self._inflight: Optional[BatchHandle] = None
         self._stop = threading.Event()
@@ -146,6 +153,8 @@ class ServeLoop:
         are delivered synchronously here), so ``req.result()`` always
         terminates."""
         now = self.clock()
+        if self.tracer.enabled and req.trace is None:
+            req.trace = self.tracer.new_context(parent=req.trace_parent)
         if req.expired(now):
             # dead on arrival: shed at admission, never queued
             self._respond_shed(req, detail="expired_at_admission")
@@ -183,6 +192,13 @@ class ServeLoop:
             req = self.queue.pop()
             if req is None:
                 break
+            if self.tracer.enabled and req.trace is not None:
+                self.tracer.record(
+                    "serve.queue", req.enqueued_at, now,
+                    parent=req.trace,
+                    attrs={"tenant": req.tenant,
+                           "priority": req.priority},
+                )
             self.batcher.offer(req)
             worked = True
         drain = self._inflight is None and len(self.queue) == 0
@@ -200,6 +216,15 @@ class ServeLoop:
                 else:
                     live.append(req)
             if live:
+                if self.tracer.enabled:
+                    for req in live:
+                        if req.trace is not None:
+                            self.tracer.record(
+                                "serve.batch",
+                                req.staged_at or now, now,
+                                parent=req.trace,
+                                attrs={"width": len(live)},
+                            )
                 handle = self._dispatch_guarded(live)
         if self._inflight is not None:
             # fetch the PREVIOUS batch only after this iteration's
@@ -248,8 +273,10 @@ class ServeLoop:
             for req in batch:
                 self._respond_degraded(req, detail="circuit_open")
             return None
+        t0 = self.clock()
         try:
-            handle = self.dispatcher.dispatch(batch, now=self.clock())
+            with device_annotation("serve.dispatch"):
+                handle = self.dispatcher.dispatch(batch, now=self.clock())
         except Exception as exc:
             record_fault("serve.dispatch", exc)
             self.breaker.record_failure()
@@ -258,12 +285,33 @@ class ServeLoop:
                     req, detail=f"dispatch_failed:{type(exc).__name__}"
                 )
             return None
+        if self.tracer.enabled:
+            t1 = self.clock()
+            for req in batch:
+                if req.trace is not None:
+                    # host pack/enqueue window + the per-request kernel
+                    # attribution (which combine path THIS shape engaged)
+                    self.tracer.record(
+                        "serve.dispatch", t0, t1, parent=req.trace,
+                        attrs={
+                            "batch_size": len(batch),
+                            "engine": getattr(
+                                self.dispatcher, "engine_tag", ""
+                            ),
+                            "kernel": getattr(handle, "kernel", None),
+                            "resident_delta": bool(getattr(
+                                handle, "resident_delta", False
+                            )),
+                        },
+                    )
         self.device_batches += 1
         return handle
 
     def _fetch_guarded(self, handle: BatchHandle) -> None:
+        t0 = self.clock()
         try:
-            results = self.dispatcher.fetch(handle)
+            with device_annotation("serve.fetch"):
+                results = self.dispatcher.fetch(handle)
         except Exception as exc:
             # async dispatch errors surface at the fetch — same breaker,
             # same degraded answer
@@ -275,6 +323,19 @@ class ServeLoop:
                 )
             return
         self.breaker.record_success()
+        if self.tracer.enabled:
+            t1 = self.clock()
+            for req in handle.requests:
+                if req.trace is not None:
+                    self.tracer.record(
+                        "serve.fetch", t0, t1, parent=req.trace,
+                        attrs={
+                            "batch_size": len(handle.requests),
+                            "inflight_ms": round(max(
+                                0.0, (t0 - handle.dispatched_at) * 1e3
+                            ), 3),
+                        },
+                    )
         width = len(handle.requests)
         self.metrics.record_batch(width)
         for req, result in zip(handle.requests, results):

@@ -11,8 +11,10 @@ submit path and the serve worker record concurrently.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
+from rca_tpu.config import slo_ms
+from rca_tpu.observability.export import LatencyHistogram
 from rca_tpu.obslog.profiling import PhaseStats
 from rca_tpu.util.threads import make_lock
 
@@ -22,8 +24,18 @@ _COUNTER_KEYS = (
 
 
 class ServeMetrics:
-    def __init__(self) -> None:
+    def __init__(self, slo_ms_target: Optional[float] = None) -> None:
         self._lock = make_lock("ServeMetrics._lock")
+        # per-tenant SLO telemetry (ISSUE 11): submit→completion duration
+        # histograms in proper le-bucket exposition form (burn rate is a
+        # PromQL division, so it needs buckets, not quantile gauges) plus
+        # the burn counter itself — completions slower than RCA_SLO_MS,
+        # or terminal failures, burn budget
+        self.slo_ms_target = (
+            float(slo_ms_target) if slo_ms_target is not None else slo_ms()
+        )
+        self._duration: Dict[str, LatencyHistogram] = {}
+        self._slo_breaches: Dict[str, int] = {}
         self._counts: Dict[str, Dict[str, int]] = {}
         self._queue_ms = PhaseStats()      # one phase per tenant
         self._occupancy: List[int] = []
@@ -73,6 +85,23 @@ class ServeMetrics:
     def errors(self, tenant: str) -> None:
         with self._lock:
             self._tenant(tenant)["errors"] += 1
+
+    def request_duration(
+        self, tenant: str, seconds: float, ok: bool,
+    ) -> None:
+        """One terminal completion's submit→completion wall time.  A
+        completion burns SLO budget when it was slower than the target
+        (``RCA_SLO_MS``) or was not served (``shed``/``error`` — a
+        failure is never within SLO, however fast)."""
+        with self._lock:
+            hist = self._duration.get(tenant)
+            if hist is None:
+                hist = self._duration[tenant] = LatencyHistogram()
+            hist.record(seconds)
+            if not ok or seconds * 1e3 > self.slo_ms_target:
+                self._slo_breaches[tenant] = (
+                    self._slo_breaches.get(tenant, 0) + 1
+                )
 
     def record_batch(self, size: int) -> None:
         with self._lock:
@@ -160,6 +189,11 @@ class ServeMetrics:
                     for rid, rec in self._replicas.items()
                 },
                 "replica_occ": self._replica_occ.snapshot(),
+                "duration": {
+                    t: h.to_dict() for t, h in self._duration.items()
+                },
+                "slo_breaches": dict(self._slo_breaches),
+                "slo_ms": self.slo_ms_target,
             }
 
     def summary(self) -> Dict[str, object]:
@@ -213,6 +247,9 @@ class ServeMetrics:
             "batch_occupancy_max": max(occ) if occ else None,
             "queue_depth_peak": snap["depth_peak"],
             "graph_cache": snap["graph_cache"],
+            "duration": snap["duration"],
+            "slo_breaches": snap["slo_breaches"],
+            "slo_ms": snap["slo_ms"],
             "shed_total": sum(c["shed"] for c in counts.values()),
             "rejected_total": sum(
                 c["rejected"] for c in counts.values()

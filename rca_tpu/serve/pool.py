@@ -44,6 +44,7 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from rca_tpu.config import ServeConfig
+from rca_tpu.observability.spans import default_tracer
 from rca_tpu.serve.metrics import ServeMetrics
 from rca_tpu.serve.queue import RequestQueue
 from rca_tpu.serve.replica import (
@@ -70,6 +71,7 @@ class ServePool:
         devices=None,
         dispatchers: Optional[Sequence] = None,
         breakers: Optional[Sequence] = None,
+        tracer=None,
     ):
         """``engines``: optional replica engines — either bare engine
         objects (dense, device placement left to the engine) or
@@ -83,8 +85,13 @@ class ServePool:
         self.clock = clock
         self.queue = RequestQueue(self.config.queue_cap, clock=clock)
         self.metrics = ServeMetrics()
+        # one tracer for the whole plane (ISSUE 11): admission mints the
+        # root context, the router records queue/steal spans, replicas
+        # record batch/dispatch/fetch, the sink closes the root
+        self.tracer = tracer if tracer is not None else default_tracer()
         self.sink = CompletionSink(
             self.metrics, clock, store=store, recorder=recorder,
+            tracer=self.tracer,
         )
         self.steal = bool(self.config.steal)
         self._route_lock = make_lock("ServePool._route_lock")
@@ -113,6 +120,7 @@ class ServePool:
                     breakers[i] if breakers is not None else None
                 ),
                 pool=self,
+                tracer=self.tracer,
             ))
         self.recorder = recorder
         if recorder is not None:
@@ -176,6 +184,12 @@ class ServePool:
         (``queue_full``/``shed`` are delivered synchronously here), so
         ``req.result()`` always terminates."""
         now = self.clock()
+        if self.tracer.enabled and req.trace is None:
+            # mint the request's root-span identity at admission: every
+            # span recorded on its way through (queue, batch, dispatch,
+            # fetch, steal) parents onto it; the sink records the span
+            # itself at completion
+            req.trace = self.tracer.new_context(parent=req.trace_parent)
         if req.expired(now):
             self.sink.shed(req, detail="expired_at_admission")
             return False
@@ -214,6 +228,14 @@ class ServePool:
                 req = self.queue.pop()
                 if req is None:
                     break
+                if self.tracer.enabled and req.trace is not None:
+                    # the fair-queue wait ends here (route time)
+                    self.tracer.record(
+                        "serve.queue", req.enqueued_at, self.clock(),
+                        parent=req.trace,
+                        attrs={"tenant": req.tenant,
+                               "priority": req.priority},
+                    )
                 # with NOTHING routable the pop continues: queued
                 # requests ride the degradation ladder (in _place)
                 # instead of parking forever behind dead replicas
@@ -280,6 +302,7 @@ class ServePool:
                     self.metrics.stolen(
                         exclude.replica_id, target.replica_id, 1
                     )
+                    self._steal_span(req, exclude, target, reason)
 
     def rebalance_from(self, replica: ReplicaWorker, reason: str) -> int:
         """Steal a dead/open replica's work: staged requests re-place on
@@ -316,8 +339,27 @@ class ServePool:
                     self.metrics.stolen(
                         replica.replica_id, target.replica_id, 1
                     )
+                    self._steal_span(req, replica, target, reason)
                     moved += 1
         return moved
+
+    def _steal_span(
+        self, req: ServeRequest, victim: ReplicaWorker,
+        target: ReplicaWorker, reason: str,
+    ) -> None:
+        """A zero-duration steal marker on the request's OWN trace — a
+        stolen request keeps its trace, and the marker names both ends
+        of the move (the test asserts the trace stays connected through
+        a kill)."""
+        if self.tracer.enabled and req.trace is not None:
+            self.tracer.event(
+                "serve.steal", self.clock(), parent=req.trace,
+                attrs={
+                    "from_replica": victim.replica_id,
+                    "to_replica": target.replica_id,
+                    "reason": reason,
+                },
+            )
 
     # -- single-threaded driver (fake-clock policy tests) --------------------
     def run_once(self, now: Optional[float] = None) -> bool:

@@ -31,6 +31,7 @@ import time
 from typing import Callable, List, Optional
 
 from rca_tpu.config import ServeConfig
+from rca_tpu.observability.spans import default_tracer, device_annotation
 from rca_tpu.resilience.policy import (
     CircuitBreaker,
     record_fault,
@@ -74,11 +75,15 @@ class CompletionSink:
     """
 
     def __init__(self, metrics, clock: Callable[[], float],
-                 store=None, recorder=None):
+                 store=None, recorder=None, tracer=None):
         self.metrics = metrics
         self.clock = clock
         self.store = store
         self.recorder = recorder
+        # tracing + SLO telemetry (ISSUE 11): the sink is where every
+        # request terminates exactly once, so it is where the root
+        # ``serve.request`` span and the duration/burn sample belong
+        self.tracer = tracer if tracer is not None else default_tracer()
         self._lock = make_lock("CompletionSink._lock")
         self._last_known: "collections.OrderedDict[GraphKey, List[dict]]" = (
             collections.OrderedDict()
@@ -88,10 +93,37 @@ class CompletionSink:
     # -- exactly-once core ---------------------------------------------------
     def _complete(self, req: ServeRequest, resp: ServeResponse) -> bool:
         if req.complete(resp):
+            # exactly-once telemetry rides the exactly-once completion:
+            # a losing steal-race completion records neither a duration
+            # sample nor a (duplicate) root span
+            self._observe(req, resp.status)
             return True
         with self._lock:
             self.double_completions += 1
         return False
+
+    def _observe(self, req: ServeRequest, status: str) -> None:
+        """Terminal telemetry for one completed request: the per-tenant
+        duration histogram + SLO burn sample (``degraded`` counts as
+        served — stale by contract, not a failure; ``shed``/``error``
+        burn budget at any speed), and the request's root span, closed
+        under its pre-minted identity so every child recorded along the
+        way is already parented correctly."""
+        now = self.clock()
+        start = req.enqueued_at if req.enqueued_at > 0.0 else now
+        self.metrics.request_duration(
+            req.tenant, max(0.0, now - start),
+            ok=status in ("ok", "degraded"),
+        )
+        if self.tracer.enabled and req.trace is not None:
+            self.tracer.record(
+                "serve.request", start, now,
+                parent=req.trace_parent, context=req.trace,
+                attrs={
+                    "tenant": req.tenant, "status": status,
+                    "request_id": req.request_id,
+                },
+            )
 
     # -- last-known ladder ---------------------------------------------------
     def remember(self, key: GraphKey, ranked: List[dict]) -> None:
@@ -203,9 +235,11 @@ class ReplicaWorker:
         breaker: Optional[CircuitBreaker] = None,
         dispatcher: Optional[BatchDispatcher] = None,
         pool=None,
+        tracer=None,
     ):
         self.replica_id = int(replica_id)
         self.kind = kind
+        self.tracer = tracer if tracer is not None else default_tracer()
         #: the device this replica commits its dispatches to (dense
         #: replicas; sharded ones place through their engine's mesh)
         self.device = device
@@ -398,6 +432,19 @@ class ReplicaWorker:
                 else:
                     live.append(req)
             if live:
+                if self.tracer.enabled:
+                    for req in live:
+                        if req.trace is not None:
+                            # batcher staging wait, on the replica that
+                            # actually formed the batch (a steal restamps
+                            # staged_at, so the span never spans replicas)
+                            self.tracer.record(
+                                "serve.batch",
+                                req.staged_at or now, now,
+                                parent=req.trace,
+                                attrs={"replica": self.replica_id,
+                                       "width": len(live)},
+                            )
                 handle = self._dispatch_guarded(live)
         prev = self.take_inflight()
         if prev is not None:
@@ -467,8 +514,9 @@ class ReplicaWorker:
                 for req in batch:
                     self.sink.degraded(req, detail="circuit_open")
             return None
+        t0 = self.clock()
         try:
-            with self._device_ctx():
+            with self._device_ctx(), device_annotation("serve.dispatch"):
                 handle = self.dispatcher.dispatch(batch, now=self.clock())
         except Exception as exc:
             record_fault(f"serve.replica{self.replica_id}.dispatch", exc)
@@ -478,13 +526,44 @@ class ReplicaWorker:
                     req, detail=f"dispatch_failed:{type(exc).__name__}"
                 )
             return None
+        self._dispatch_spans(batch, handle, t0, self.clock())
         with self._lock:
             self._device_batches += 1
         return handle
 
+    def _dispatch_spans(
+        self, batch: List[ServeRequest], handle, t0: float, t1: float,
+    ) -> None:
+        """One serve.dispatch span per traced request: the host-side
+        pack/enqueue window, stamped with the engaged kernel path and
+        whether the resident delta path carried the upload — the
+        per-request answer to ``pallas_engaged: false``."""
+        if not self.tracer.enabled:
+            return
+        for req in batch:
+            if req.trace is not None:
+                self.tracer.record(
+                    "serve.dispatch", t0, t1, parent=req.trace,
+                    attrs={
+                        "batch_size": len(batch),
+                        "replica": self.replica_id,
+                        "engine": getattr(
+                            self.dispatcher, "engine_tag", ""
+                        ),
+                        "kernel": getattr(handle, "kernel", None),
+                        "noisyor_path": getattr(
+                            handle, "noisyor", None
+                        ),
+                        "resident_delta": bool(getattr(
+                            handle, "resident_delta", False
+                        )),
+                    },
+                )
+
     def _fetch_guarded(self, handle: BatchHandle) -> None:
+        t0 = self.clock()
         try:
-            with self._device_ctx():
+            with self._device_ctx(), device_annotation("serve.fetch"):
                 results = self.dispatcher.fetch(handle)
         except Exception as exc:
             record_fault(f"serve.replica{self.replica_id}.fetch", exc)
@@ -494,6 +573,22 @@ class ReplicaWorker:
                     req, detail=f"fetch_failed:{type(exc).__name__}"
                 )
             return
+        if self.tracer.enabled:
+            t1 = self.clock()
+            for req in handle.requests:
+                if req.trace is not None:
+                    # the device round-trip sync: dispatched_at→t0 is the
+                    # overlapped in-flight window, t0→t1 the actual wait
+                    self.tracer.record(
+                        "serve.fetch", t0, t1, parent=req.trace,
+                        attrs={
+                            "batch_size": len(handle.requests),
+                            "replica": self.replica_id,
+                            "inflight_ms": round(max(
+                                0.0, (t0 - handle.dispatched_at) * 1e3
+                            ), 3),
+                        },
+                    )
         self.breaker.record_success()
         width = len(handle.requests)
         if self.metrics is not None:

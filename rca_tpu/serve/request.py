@@ -96,11 +96,20 @@ class ServeRequest:
     deadline_s: Optional[float] = None  # absolute, scheduler clock domain
     cost: float = 1.0            # weighted-fair-queue charge
     investigation_id: Optional[str] = None  # optional store append target
+    # distributed tracing (ISSUE 11): ``trace_parent`` is the caller's
+    # span context (the gateway's request span, or whatever rode in on
+    # X-RCA-Trace); ``trace`` is THIS request's root-span identity,
+    # minted at admission when tracing is on — every span the scheduler
+    # records for this request (queue, batch, dispatch, fetch, steal)
+    # parents onto it, so a stolen request keeps its trace
+    trace_parent: Optional[object] = None   # observability SpanContext
+    trace: Optional[object] = None          # observability SpanContext
     request_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:12]
     )
     # filled by the scheduler
     enqueued_at: float = 0.0
+    staged_at: float = 0.0       # batcher offer time (batch-wait spans)
     vtag: float = 0.0            # WFQ virtual finish tag
     seq: int = 0                 # admission order (total tie-break)
 

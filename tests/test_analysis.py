@@ -222,6 +222,15 @@ def encode_row(pod):
         total += cs.get("restarts", 0)
     return total
 """, 2),
+    "span-discipline": ("rca_tpu/serve/bad_spans.py", """\
+from rca_tpu.observability.spans import Span
+
+
+def handle(tracer, ctx):
+    sp = tracer.span("serve.request", parent=ctx)  # never entered
+    raw = Span("x", "t", "s", None, 0.0, 1.0)      # bypasses the seam
+    return sp, raw
+""", 2),
 }
 
 
@@ -386,6 +395,14 @@ def encode_row(pod):
         total += cs.get("restarts", 0)
     return total
 """),
+        ("rca_tpu/serve/good_spans.py", """\
+def handle(tracer, ctx, t0, t1):
+    with tracer.span("serve.request", parent=ctx) as sp:
+        sp.set_attr("tenant", "t")
+    # cross-method phases use complete timestamps: cannot leak
+    tracer.record("serve.queue", t0, t1, parent=ctx)
+    tracer.event("serve.steal", t1, parent=ctx)
+"""),
     )
     result = run_lint(root=root, use_baseline=False)
     assert result.clean, result.findings
@@ -504,12 +521,13 @@ def test_baseline_is_empty():
     assert load_baseline(default_baseline_path(ROOT)) == []
 
 
-def test_all_thirteen_rules_registered():
+def test_all_fourteen_rules_registered():
     assert set(all_rules()) == {
         "tick-sync", "swallowed-faults", "tracer-leak", "retrace-hazard",
         "rng-key-reuse", "lock-discipline", "env-discipline",
         "nondet-discipline", "resident-fetch", "race-guard",
         "lock-order", "thread-discipline", "no-dict-scan",
+        "span-discipline",
     }
     for rule in all_rules().values():
         assert rule.summary and rule.why

@@ -748,7 +748,8 @@ def test_subprocess_listen_wire_parity(tmp_path, engine, case):
         info = json.loads(banner[0])
         host, port = info["listening"].rsplit(":", 1)
         assert info["endpoints"] == [
-            "/v1/analyze", "/v1/subscribe", "/metrics", "/healthz",
+            "/v1/analyze", "/v1/subscribe", "/v1/traces", "/metrics",
+            "/healthz",
         ]
         cl = GatewayClient(host, int(port), timeout_s=120.0)
         rng = np.random.default_rng(2)

@@ -1,0 +1,585 @@
+"""ISSUE 11: tracegraft — wire-to-device distributed tracing.
+
+Covers the tentpole contracts:
+
+- tracer core: seeded id determinism, with-block spans, complete-span
+  records, parent propagation, the bounded ring buffer's drop counter;
+- the ``RCA_TRACE=0`` zero-cost default: the null tracer records
+  nothing, mints nothing, and rankings are BIT-identical with tracing
+  on vs off;
+- the serve path: one request through queue → batcher → dispatch →
+  fetch yields one connected trace with correct parentage; a stolen
+  request under replica kill KEEPS its trace (steal marker + root span,
+  zero double completions);
+- the gateway: ``X-RCA-Trace`` generated when absent and echoed either
+  way, ``GET /v1/traces`` NDJSON + Perfetto-loadable Chrome export
+  (golden-shape checked), per-tenant ``rca_request_duration_seconds``
+  le-bucket histogram + SLO burn counters + gauge timestamps in
+  ``/metrics``;
+- streaming: tick spans in every health record, embedded in recorder
+  frames, and ``rca replay --trace-out`` reconstructing the SAME
+  timeline from the tape (byte parity with the live export);
+- ``rca profile``: the opt-in jax.profiler capture with per-shape
+  kernel attribution.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.generator import (
+    synthetic_cascade_arrays,
+    synthetic_cascade_world,
+)
+from rca_tpu.config import ServeConfig, slo_ms, trace_buffer_cap, trace_enabled
+from rca_tpu.engine.runner import GraphEngine
+from rca_tpu.observability import (
+    NULL_TRACER,
+    SpanContext,
+    Tracer,
+    chrome_trace,
+    ndjson_spans,
+    recording_trace,
+)
+from rca_tpu.observability.export import DURATION_BUCKETS_S, LatencyHistogram
+from rca_tpu.serve import ServeClient, ServeLoop, ServePool, ServeRequest
+from rca_tpu.serve.metrics import ServeMetrics
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return GraphEngine()
+
+
+@pytest.fixture(scope="module")
+def case():
+    return synthetic_cascade_arrays(24, n_roots=1, seed=3)
+
+
+def _by_name(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s["name"], []).append(s)
+    return out
+
+
+# -- config knobs (satellite) -------------------------------------------------
+
+def test_trace_config_env_round_trip(monkeypatch):
+    monkeypatch.setenv("RCA_TRACE", "1")
+    monkeypatch.setenv("RCA_TRACE_BUFFER", "256")
+    monkeypatch.setenv("RCA_SLO_MS", "250")
+    assert trace_enabled() is True
+    assert trace_buffer_cap() == 256
+    assert slo_ms() == 250.0
+
+
+def test_trace_config_defaults(monkeypatch):
+    for name in ("RCA_TRACE", "RCA_TRACE_BUFFER", "RCA_SLO_MS"):
+        monkeypatch.delenv(name, raising=False)
+    # RCA_TRACE=0 is the documented zero-cost DEFAULT path
+    assert trace_enabled() is False
+    assert trace_buffer_cap() == 8192
+    assert slo_ms() == 500.0
+
+
+@pytest.mark.parametrize("name,bad", [
+    ("RCA_TRACE", "maybe"),
+    ("RCA_TRACE_BUFFER", "0"),
+    ("RCA_TRACE_BUFFER", "abc"),
+    ("RCA_SLO_MS", "0"),
+    ("RCA_SLO_MS", "never"),
+])
+def test_trace_config_rejects_malformed(monkeypatch, name, bad):
+    monkeypatch.setenv(name, bad)
+    with pytest.raises(ValueError, match=name):
+        {"RCA_TRACE": trace_enabled,
+         "RCA_TRACE_BUFFER": trace_buffer_cap,
+         "RCA_SLO_MS": slo_ms}[name]()
+
+
+# -- span vocabulary ----------------------------------------------------------
+
+def test_span_context_wire_round_trip():
+    ctx = SpanContext("00ff00ff00ff00ff", "abcd1234")
+    assert SpanContext.from_wire(ctx.to_wire()) == ctx
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "nodash", "a-b-c", "xyz!-1234", "-", "zz-zz",
+])
+def test_span_context_rejects_malformed(bad):
+    # a garbage header starts a fresh trace; it must never raise
+    assert SpanContext.from_wire(bad) is None
+
+
+def test_tracer_with_block_and_record_parentage():
+    t = Tracer(seed=0)
+    root_ctx = t.new_context()
+    with t.span("parent", parent=root_ctx) as sp:
+        sp.set_attr("k", 1)
+        child_ctx = sp.context
+    t.record("child", 1.0, 2.0, parent=child_ctx)
+    spans = t.spans()
+    assert [s["name"] for s in spans] == ["parent", "child"]
+    parent, child = spans
+    assert parent["parent_id"] == root_ctx.span_id
+    assert child["parent_id"] == parent["span_id"]
+    assert child["trace_id"] == parent["trace_id"] == root_ctx.trace_id
+    assert parent["attrs"] == {"k": 1}
+    assert parent["end"] >= parent["start"]
+
+
+def test_tracer_span_records_even_when_body_raises():
+    t = Tracer(seed=0)
+    with pytest.raises(RuntimeError):
+        with t.span("boom"):
+            raise RuntimeError("x")
+    assert [s["name"] for s in t.spans()] == ["boom"]
+
+
+def test_tracer_seeded_ids_are_deterministic():
+    a, b = Tracer(seed=42), Tracer(seed=42)
+    assert a.new_context().to_wire() == b.new_context().to_wire()
+
+
+def test_ring_buffer_bounds_and_drop_counter():
+    t = Tracer(seed=0, cap=64)
+    for i in range(100):
+        t.record(f"s{i}", float(i), float(i) + 1.0)
+    stats = t.stats()
+    assert stats["buffered"] == 64
+    assert stats["recorded"] == 100
+    assert stats["dropped"] == 36
+    # oldest dropped, newest kept
+    assert t.spans()[0]["name"] == "s36"
+    assert t.spans()[-1]["name"] == "s99"
+
+
+def test_null_tracer_is_zero_op():
+    before = NULL_TRACER.stats()
+    assert NULL_TRACER.new_context() is None
+    assert NULL_TRACER.record("x", 0.0, 1.0) is None
+    with NULL_TRACER.span("y") as sp:
+        assert sp is None
+    assert NULL_TRACER.spans() == []
+    assert NULL_TRACER.stats() == before
+    assert not NULL_TRACER.enabled
+
+
+# -- export shapes ------------------------------------------------------------
+
+def test_chrome_trace_golden_shape():
+    t = Tracer(seed=0)
+    ctx = t.new_context()
+    t.record("serve.request", 10.0, 10.5, context=ctx)
+    t.record("serve.queue", 10.0, 10.1, parent=ctx)
+    trace = chrome_trace(t.spans())
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    assert trace["displayTimeUnit"] == "ms"
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    events = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(meta) == 1 and len(events) == 2       # one lane per trace
+    for e in events:
+        assert set(e) >= {"name", "cat", "ph", "pid", "tid", "ts", "dur",
+                          "args"}
+        assert e["args"]["trace_id"] == ctx.trace_id
+    # rebased to the earliest span, microseconds
+    assert events[0]["ts"] == 0.0
+    assert events[0]["dur"] == pytest.approx(0.5e6)
+    assert events[1]["args"]["parent_id"] == ctx.span_id
+    # the whole object must be JSON-serializable (Perfetto loads it)
+    json.loads(json.dumps(trace))
+
+
+def test_ndjson_spans_one_object_per_line():
+    t = Tracer(seed=0)
+    t.record("a", 0.0, 1.0)
+    t.record("b", 1.0, 2.0)
+    lines = ndjson_spans(t.spans()).splitlines()
+    assert [json.loads(line)["name"] for line in lines] == ["a", "b"]
+
+
+def test_latency_histogram_buckets_are_cumulative():
+    h = LatencyHistogram()
+    h.record(0.004)
+    h.record(0.3)
+    h.record(99.0)   # beyond the last bucket: only +Inf (count) sees it
+    d = h.to_dict()
+    assert d["count"] == 3
+    assert d["buckets"]["0.005"] == 1
+    assert d["buckets"]["0.5"] == 2
+    assert d["buckets"]["10.0"] == 2
+    assert d["sum_s"] == pytest.approx(99.304)
+    # cumulative: monotone non-decreasing along the ladder
+    vals = [d["buckets"][str(le)] for le in DURATION_BUCKETS_S]
+    assert vals == sorted(vals)
+
+
+def test_serve_metrics_slo_burn_semantics():
+    m = ServeMetrics(slo_ms_target=100.0)
+    m.request_duration("t", 0.01, ok=True)    # fast + served: no burn
+    m.request_duration("t", 0.5, ok=True)     # slow: burns
+    m.request_duration("t", 0.01, ok=False)   # failed: burns at any speed
+    snap = m.snapshot()
+    assert snap["slo_breaches"] == {"t": 2}
+    assert snap["slo_ms"] == 100.0
+    assert snap["duration"]["t"]["count"] == 3
+
+
+# -- the serve path: one connected trace --------------------------------------
+
+def test_serve_loop_trace_is_connected(engine, case):
+    tracer = Tracer(seed=1)
+    loop = ServeLoop(engine=engine, tracer=tracer)
+    with loop:
+        client = ServeClient(loop)
+        parent = tracer.new_context()
+        resp = client.submit(
+            case.features, case.dep_src, case.dep_dst, names=case.names,
+            tenant="t", trace_parent=parent,
+        ).result(300.0)
+    assert resp.ok
+    by = _by_name(tracer.spans())
+    assert set(by) >= {"serve.request", "serve.queue", "serve.batch",
+                       "serve.dispatch", "serve.fetch"}
+    root = by["serve.request"][0]
+    assert root["parent_id"] == parent.span_id
+    assert root["attrs"]["status"] == "ok"
+    for name in ("serve.queue", "serve.batch", "serve.dispatch",
+                 "serve.fetch"):
+        span = by[name][0]
+        assert span["parent_id"] == root["span_id"], name
+        assert span["trace_id"] == parent.trace_id
+    # the per-request kernel attribution (ISSUE 11 satellite)
+    assert by["serve.dispatch"][0]["attrs"]["kernel"] in ("xla", "pallas")
+    # SLO telemetry flowed from the sink
+    m = loop.metrics.summary()
+    assert m["duration"]["t"]["count"] == 1
+    assert m["slo_ms"] == slo_ms()
+
+
+def test_trace_off_is_bit_parity_and_recordless(engine, case):
+    """RCA_TRACE=0 (the null tracer) must not change a single ranking
+    bit, and must record nothing."""
+    def run(tracer):
+        loop = ServeLoop(engine=engine, tracer=tracer)
+        with loop:
+            client = ServeClient(loop)
+            reqs = [
+                client.submit(case.features, case.dep_src, case.dep_dst,
+                              names=case.names, tenant=f"t{i % 3}")
+                for i in range(6)
+            ]
+            return [r.result(300.0) for r in reqs]
+
+    off = run(NULL_TRACER)
+    on = run(Tracer(seed=9))
+    for a, b in zip(off, on):
+        assert a.status == b.status == "ok"
+        assert a.ranked == b.ranked   # exact float equality: bit parity
+    assert NULL_TRACER.spans() == []
+    assert NULL_TRACER.stats()["recorded"] == 0
+
+
+# -- pool chaos: a stolen request keeps its trace -----------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _StubHandle:
+    def __init__(self, requests, dispatched_at):
+        self.requests = requests
+        self.dispatched_at = dispatched_at
+
+
+class _StubResult:
+    def __init__(self, tag):
+        self.ranked = [{"component": f"svc-{tag}", "score": 1.0}]
+        self.engine = "stub"
+        self.score = np.ones(1, np.float32)
+
+
+class _StubDispatcher:
+    engine = None
+    engine_tag = "stub"
+
+    def __init__(self):
+        self.graphs = set()
+
+    def has_graph(self, key):
+        return key in self.graphs
+
+    def dispatch(self, batch, now=None):
+        self.graphs.add(batch[0].graph_key)
+        return _StubHandle(list(batch), now if now is not None else 0.0)
+
+    def fetch(self, handle):
+        return [_StubResult(i) for i in range(len(handle.requests))]
+
+
+def _req(tenant="t", n=8, seed=0, **kw) -> ServeRequest:
+    rng = np.random.default_rng(seed)
+    feats = rng.uniform(0, 1, (n, 4)).astype(np.float32)
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    return ServeRequest(tenant=tenant, features=feats, dep_src=src,
+                        dep_dst=dst, k=3, **kw)
+
+
+def test_stolen_request_keeps_its_trace():
+    """Kill the replica holding staged work: the steal re-places the
+    requests, the trace stays CONNECTED (same trace_id; a serve.steal
+    marker parents onto the request's root), and completion telemetry
+    records exactly once."""
+    tracer = Tracer(seed=2)
+    clock = _FakeClock()
+    stubs = [_StubDispatcher(), _StubDispatcher()]
+    pool = ServePool(
+        dispatchers=stubs,
+        config=ServeConfig(replicas=2, max_wait_us=0),
+        clock=clock, tracer=tracer,
+    )
+    reqs = [_req("a", seed=i) for i in range(5)]
+    for r in reqs:
+        pool.submit(r)
+    assert all(r.trace is not None for r in reqs)
+    pool.route_once()
+    victim = next(r for r in pool.replicas if r.occupancy())
+    victim.kill()
+    for _ in range(10):
+        pool.run_once()
+    assert all(r.result(timeout=0).status == "ok" for r in reqs)
+    assert pool.sink.double_completions == 0
+    by = _by_name(tracer.spans())
+    assert len(by["serve.steal"]) == 5
+    roots = {s["span_id"]: s for s in by["serve.request"]}
+    assert len(roots) == 5
+    for steal in by["serve.steal"]:
+        root = roots[steal["parent_id"]]
+        assert steal["trace_id"] == root["trace_id"]
+        assert steal["attrs"]["from_replica"] == victim.replica_id
+        assert steal["attrs"]["reason"] == "replica_death"
+    # each stolen request still got batch+dispatch+fetch on the survivor
+    for root in roots.values():
+        children = [
+            s for spans in by.values() for s in spans
+            if s["parent_id"] == root["span_id"]
+        ]
+        names = {s["name"] for s in children}
+        assert {"serve.queue", "serve.steal", "serve.batch",
+                "serve.dispatch", "serve.fetch"} <= names
+    # duration histogram recorded exactly once per request
+    assert pool.metrics.snapshot()["duration"]["a"]["count"] == 5
+
+
+# -- gateway: header contract, /v1/traces, /metrics ---------------------------
+
+@pytest.fixture()
+def gateway(engine, case):
+    from rca_tpu.gateway import GatewayClient, GatewayServer
+
+    tracer = Tracer(seed=3)
+    loop = ServeLoop(engine=engine, tracer=tracer).start()
+    gw = GatewayServer(loop, port=0, wall=lambda: 1700000000.0).start()
+    client = GatewayClient(gw.host, gw.port, timeout_s=300.0)
+    yield gw, client, tracer
+    gw.close()
+    loop.stop()
+
+
+def test_gateway_trace_generated_and_connected(gateway, case):
+    """The acceptance gate: one POST /v1/analyze yields ONE connected
+    trace (gateway → queue → batch → dispatch → fetch, >= 6 spans,
+    correct parentage) retrievable via /v1/traces in both formats."""
+    gw, client, tracer = gateway
+    code, body, headers = client.analyze(
+        case.features, case.dep_src, case.dep_dst, names=case.names,
+        tenant="acme",
+    )
+    assert code == 200
+    trace_id = body["trace_id"]
+    assert trace_id
+    echoed = {k.lower(): v for k, v in headers.items()}["x-rca-trace"]
+    assert echoed.split("-")[0] == trace_id
+    spans = client.traces(trace_id=trace_id)
+    assert len(spans) >= 6
+    by = _by_name(spans)
+    gw_span = by["gateway.analyze"][0]
+    root = by["serve.request"][0]
+    assert gw_span["parent_id"] is None          # fresh trace: no header
+    assert root["parent_id"] == gw_span["span_id"]
+    for name in ("serve.queue", "serve.batch", "serve.dispatch",
+                 "serve.fetch"):
+        assert by[name][0]["parent_id"] == root["span_id"], name
+    assert gw_span["attrs"]["code"] == 200
+    # Perfetto-loadable Chrome export of the same trace
+    ct = client.traces(trace_id=trace_id, fmt="chrome")
+    assert {"traceEvents", "displayTimeUnit"} <= set(ct)
+    assert sum(1 for e in ct["traceEvents"] if e["ph"] == "X") >= 6
+
+
+def test_gateway_echoes_caller_trace_context(gateway, case):
+    gw, client, tracer = gateway
+    code, body, headers = client.analyze(
+        case.features, case.dep_src, case.dep_dst, names=case.names,
+        tenant="acme", trace="feedfacefeedface-12345678",
+    )
+    assert code == 200
+    assert body["trace_id"] == "feedfacefeedface"
+    spans = client.traces(trace_id="feedfacefeedface")
+    gw_span = _by_name(spans)["gateway.analyze"][0]
+    # the gateway span parents onto the WIRE context
+    assert gw_span["parent_id"] == "12345678"
+
+
+def test_gateway_metrics_histogram_and_timestamps(gateway, case):
+    gw, client, tracer = gateway
+    client.analyze(case.features, case.dep_src, case.dep_dst,
+                   names=case.names, tenant="acme")
+    text = client.metrics_text()
+    assert "# TYPE rca_request_duration_seconds histogram" in text
+    assert ('rca_request_duration_seconds_bucket{le="+Inf",tenant="acme"}'
+            in text)
+    assert 'rca_request_duration_seconds_count{tenant="acme"}' in text
+    assert 'rca_request_duration_seconds_sum{tenant="acme"}' in text
+    assert "# TYPE rca_slo_breaches_total counter" in text
+    assert "rca_slo_target_ms" in text
+    # gauges carry the wall seam's ms timestamp (proper exposition)
+    assert "rca_gateway_up 1 1700000000000" in text
+    # cumulative bucket sanity on the scraped text
+    counts = {}
+    for line in text.splitlines():
+        if line.startswith("rca_request_duration_seconds_bucket"):
+            le = line.split('le="')[1].split('"')[0]
+            counts[le] = int(float(line.rsplit(" ", 1)[1]))
+    assert counts["+Inf"] == max(counts.values())
+
+
+def test_gateway_traces_empty_when_disabled(engine, case):
+    from rca_tpu.gateway import GatewayClient, GatewayServer
+
+    loop = ServeLoop(engine=engine, tracer=NULL_TRACER)
+    loop.start()
+    gw = GatewayServer(loop, port=0).start()
+    try:
+        client = GatewayClient(gw.host, gw.port, timeout_s=300.0)
+        code, body, headers = client.analyze(
+            case.features, case.dep_src, case.dep_dst,
+            names=case.names, tenant="t",
+        )
+        assert code == 200
+        assert "trace_id" not in body      # nothing minted, nothing faked
+        assert client.traces() == []
+        # a caller-sent context is still echoed verbatim (correlation
+        # survives even a trace-disabled hop)
+        _c, _b, h2 = client.analyze(
+            case.features, case.dep_src, case.dep_dst,
+            names=case.names, tenant="t", trace="abcd1234abcd1234-aabbccdd",
+        )
+        echoed = {k.lower(): v for k, v in h2.items()}["x-rca-trace"]
+        assert echoed == "abcd1234abcd1234-aabbccdd"
+    finally:
+        gw.close()
+        loop.stop()
+
+
+# -- streaming: spans in health records + timeline reconstruction -------------
+
+def test_streaming_spans_and_recording_timeline_parity(tmp_path):
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.replay import Recorder
+
+    tracer = Tracer(seed=4)
+    world = synthetic_cascade_world(20, n_roots=1, seed=5, namespace="ns")
+    rec_path = str(tmp_path / "rec")
+    rec = Recorder(rec_path, mode="stream")
+    live = LiveStreamingSession(MockClusterClient(world), "ns", k=3,
+                                recorder=rec, tracer=tracer)
+    for _ in range(3):
+        out = live.poll()
+    rec.close()
+    spans = out["health"]["spans"]
+    assert [s["name"] for s in spans] == [
+        "tick", "tick.capture", "tick.dispatch", "tick.fetch",
+    ]
+    tick = spans[0]
+    assert tick["attrs"]["kernel_path"] in ("xla", "pallas")
+    for child in spans[1:]:
+        assert child["parent_id"] == tick["span_id"]
+        assert child["trace_id"] == tick["trace_id"]
+    # one trace per session: every tick shares the trace id
+    assert len({s["trace_id"] for s in tracer.spans()}) == 1
+    # timeline reconstruction from the TAPE == the live export
+    assert recording_trace(rec_path) == chrome_trace(tracer.spans())
+
+
+def test_replay_trace_out_cli(tmp_path):
+    from rca_tpu.cli import main
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.replay import Recorder
+
+    world = synthetic_cascade_world(16, n_roots=1, seed=6, namespace="ns")
+    rec_path = str(tmp_path / "rec")
+    rec = Recorder(rec_path, mode="stream")
+    live = LiveStreamingSession(MockClusterClient(world), "ns", k=3,
+                                recorder=rec, tracer=Tracer(seed=5))
+    live.poll()
+    live.poll()
+    rec.close()
+    out_path = str(tmp_path / "trace.json")
+    assert main(["replay", rec_path, "--trace-out", out_path,
+                 "--compact"]) == 0
+    with open(out_path, encoding="utf-8") as f:
+        trace = json.load(f)
+    assert len([e for e in trace["traceEvents"] if e["ph"] == "X"]) == 8
+
+
+def test_recording_without_spans_yields_empty_trace(tmp_path):
+    """A pre-tracing (or RCA_TRACE=0) recording reconstructs to an empty
+    timeline — and the CLI says so with a nonzero exit."""
+    from rca_tpu.cli import main
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.replay import Recorder
+
+    world = synthetic_cascade_world(16, n_roots=1, seed=6, namespace="ns")
+    rec_path = str(tmp_path / "rec")
+    rec = Recorder(rec_path, mode="stream")
+    live = LiveStreamingSession(MockClusterClient(world), "ns", k=3,
+                                recorder=rec, tracer=NULL_TRACER)
+    live.poll()
+    rec.close()
+    assert recording_trace(rec_path)["traceEvents"] == []
+    assert main(["replay", rec_path, "--trace-out",
+                 str(tmp_path / "t.json"), "--compact"]) == 1
+
+
+# -- rca profile (opt-in capture) ---------------------------------------------
+
+def test_profile_capture(tmp_path):
+    from rca_tpu.observability.profile import profile_ticks
+
+    tracer = Tracer(seed=6)
+    summary = profile_ticks(str(tmp_path / "prof"), ticks=2, services=16,
+                            seed=7, tracer=tracer)
+    assert summary["ticks"] == 2
+    assert summary["noisyor_path"] in ("xla", "pallas")
+    assert list(summary["kernel_by_shape"].values())[0] in (
+        "xla", "pallas",
+    )
+    assert summary["profile_files"] >= 1     # the jax.profiler dump exists
+    assert summary["spans_recorded"] >= 8    # 2 ticks x 4 spans
+    from rca_tpu.observability.spans import profiling_active
+
+    assert not profiling_active()            # flag cleared after capture
