@@ -9,6 +9,7 @@ from rca_tpu.analysis.rules import dictscan       # noqa: F401
 from rca_tpu.analysis.rules import env            # noqa: F401
 from rca_tpu.analysis.rules import faults         # noqa: F401
 from rca_tpu.analysis.rules import gravelock      # noqa: F401
+from rca_tpu.analysis.rules import kerneldispatch  # noqa: F401
 from rca_tpu.analysis.rules import locks          # noqa: F401
 from rca_tpu.analysis.rules import nondet         # noqa: F401
 from rca_tpu.analysis.rules import residentfetch  # noqa: F401

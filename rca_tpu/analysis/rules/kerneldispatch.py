@@ -1,0 +1,107 @@
+"""Rule ``kernel-dispatch``: the registry is the ONLY dispatch seam.
+
+ISSUE 12 made :mod:`rca_tpu.engine.registry` the single place a
+propagation surface learns which combine kernel a padded shape engages
+(``engaged_kernel``).  The regression this rule prevents is the one the
+refactor removed: a call surface re-deriving the kernel choice locally —
+calling the Pallas/XLA evidence bodies directly, or the legacy
+process-level autotune shims — so that a new kernel (segscan, quantized;
+ROADMAP item 4) or a changed eligibility gate lands in N-1 of N
+surfaces and the cross-path bit-parity contract silently breaks.
+
+Flagged inside ``rca_tpu/``: calls to the kernel bodies
+(``noisy_or_pair_pallas`` / ``noisy_or_pair_xla``), the shared traced
+core (``propagate_core``), and the legacy shims (``noisyor_autotune`` /
+``noisyor_path``) anywhere outside the seam files — the registry itself,
+the kernel definitions, the propagation core, and the ONE traced
+evidence branch (``runner.propagate_auto``).  bench.py and tests stay
+out of scope (measurement code times the bodies on purpose)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: call targets that constitute bypassing the registry seam
+TARGETS = frozenset({
+    "noisy_or_pair_pallas",
+    "noisy_or_pair_xla",
+    "propagate_core",
+    "noisyor_autotune",
+    "noisyor_path",
+})
+
+#: files that ARE the seam (definitions + the registry's own timing/cost)
+ALLOWED_FILES = frozenset({
+    "rca_tpu/engine/registry.py",
+    "rca_tpu/engine/pallas_kernels.py",
+    "rca_tpu/engine/propagate.py",
+})
+
+MESSAGE = (
+    "{name}() called outside the kernel-dispatch seam — propagation "
+    "surfaces must ask rca_tpu/engine/registry.py (engaged_kernel) "
+    "which kernel a shape engages; calling the kernel bodies or the "
+    "legacy autotune shims directly lets kernel choices drift between "
+    "call surfaces (ISSUE 12)"
+)
+
+
+@register
+class KernelDispatchRule(Rule):
+    name = "kernel-dispatch"
+    summary = ("propagation entry points outside engine/registry.py may "
+               "not call the Pallas/XLA kernel bodies or the legacy "
+               "autotune shims — the registry is the only dispatch seam")
+    why = ("a kernel choice re-derived locally at one call surface "
+           "diverges from the registry's per-shape row the moment a new "
+           "kernel or eligibility gate lands, breaking the cross-path "
+           "bit-parity contract the serve/streaming/resident surfaces "
+           "rely on — the exact drift ISSUE 12's refactor removed")
+    # the ONE traced evidence branch every executable shares (the
+    # pallas-vs-XLA dispatch lives there by design — runner.py
+    # docstring), and the training loss's differentiable forward (it
+    # fits weights THROUGH the core; it never serves a request, so no
+    # kernel choice can drift from it)
+    allow = {
+        "rca_tpu/engine/runner.py": {"propagate_auto"},
+        "rca_tpu/engine/train.py": {"_forward"},
+    }
+
+    def applies_to(self, relpath: str) -> bool:
+        return (
+            relpath.startswith("rca_tpu/")
+            and relpath not in ALLOWED_FILES
+        )
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        hits: List[Finding] = []
+        func_stack: List[str] = []
+
+        def visit(node: ast.AST) -> None:
+            is_func = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if is_func:
+                func_stack.append(node.name)
+            if isinstance(node, ast.Call):
+                target = node.func
+                name = None
+                if isinstance(target, ast.Name):
+                    name = target.id
+                elif isinstance(target, ast.Attribute):
+                    name = target.attr
+                if name in TARGETS:
+                    hits.append(ctx.finding(
+                        self, node.lineno, MESSAGE.format(name=name),
+                        func=func_stack[-1] if func_stack else "<module>",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_func:
+                func_stack.pop()
+
+        visit(ctx.tree)
+        return hits

@@ -25,6 +25,10 @@
                 tick-for-tick bit-parity, ``--seek`` time travel,
                 ``--bisect`` first-divergent-tick search, ``--mint``
                 corpus fixtures (REPLAY.md)
+- ``kernels``   the live per-shape kernel registry table: engaged
+                kernel, autotune timings, and XLA cost analysis per
+                padded shape (engine/registry.py; OBSERVABILITY.md
+                §kernelscope)
 - ``lint``      graftlint static analysis: JAX/TPU-correctness rules +
                 recompile tracecheck (``rca lint --help``; ANALYSIS.md)
 - ``investigations``  list / show persisted investigations
@@ -419,12 +423,17 @@ def cmd_chaos(args) -> int:
         pipeline_depth=getattr(args, "pipeline_depth", None),
     )
     print(json.dumps(summary, indent=None if args.compact else 2))
+    scope = summary.get("kernelscope", {})
     ok = (
         summary["uncaught_exceptions"] == 0
         and summary["parity_ok"]
         and (summary["all_classes_observed"] or args.ticks < 100)
         # --record adds the record→replay parity leg to the contract
         and summary.get("replay", {}).get("parity_ok", True)
+        # kernelscope gates (ISSUE 12): zero post-warmup recompiles on
+        # the tick path, and device memory must not grow monotonically
+        and scope.get("recompiles_post_warm", 0) == 0
+        and scope.get("memory_gate", {}).get("ok", True)
     )
     return 0 if ok else 1
 
@@ -763,6 +772,69 @@ def cmd_profile(args) -> int:
         seed=args.seed,
     )
     print(json.dumps(summary, indent=None if args.compact else 2))
+    return 0
+
+
+def cmd_kernels(args) -> int:
+    """``rca kernels`` (ISSUE 12): the live per-shape kernel registry as
+    a table — one row per ``(variant, n_pad, backend)`` with the engaged
+    kernel, WHY it won, the autotune timings, and the winner
+    executable's XLA cost analysis (FLOPs / bytes accessed / peak temp
+    and output memory).  ``--services`` resolves rows for those graph
+    sizes first (a fresh process has only what its sessions asked
+    about); cost capture compiles the canonical executable per shape, so
+    ``--no-cost`` skips it and ``--cost-max-pad`` bounds it."""
+    from rca_tpu.config import RCAConfig, bucket_for
+    from rca_tpu.engine.registry import get_registry, kernel_table
+
+    reg = get_registry()
+    buckets = RCAConfig().shape_buckets
+    for part in (args.services or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            n = int(part)
+        except ValueError:
+            raise SystemExit(
+                f"--services expects comma-separated ints, got {part!r}"
+            )
+        reg.resolve(bucket_for(n + 1, buckets))
+    rows = kernel_table(
+        ensure_cost=not args.no_cost, cost_max_pad=args.cost_max_pad,
+    )
+    if args.json:
+        print(json.dumps({"rows": rows},
+                         indent=None if args.compact else 2))
+        return 0
+
+    def fmt(x, unit=""):
+        if x is None:
+            return "-"
+        if isinstance(x, float):
+            return f"{x:.4g}{unit}"
+        return f"{x}{unit}"
+
+    cols = ("n_pad", "variant", "backend", "winner", "source",
+            "t_xla_ms", "t_pallas_ms", "flops", "bytes", "peak_temp",
+            "output")
+    table = [cols]
+    for row in rows:
+        cost = row.get("cost") or {}
+        timings = row.get("timings_ms") or {}
+        table.append((
+            str(row["n_pad"]), row["variant"], row["backend"],
+            row["winner"], row["source"],
+            fmt(timings.get("xla")), fmt(timings.get("pallas")),
+            fmt(cost.get("flops")), fmt(cost.get("bytes_accessed")),
+            fmt(cost.get("peak_temp_bytes")),
+            fmt(cost.get("output_bytes")),
+        ))
+    widths = [max(len(r[i]) for r in table) for i in range(len(cols))]
+    for i, r in enumerate(table):
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            print("  ".join("-" * w for w in widths))
     return 0
 
 
@@ -1135,6 +1207,29 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--seed", type=int, default=7)
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser(
+        "kernels",
+        help="print the live per-shape kernel registry: engaged kernel, "
+        "autotune timings, and XLA cost analysis per padded shape "
+        "(engine/registry.py — ISSUE 12)",
+    )
+    sp.add_argument("--services", default="500,2000",
+                    help="comma-separated service counts whose shape "
+                    "buckets to resolve before printing (default "
+                    "500,2000)")
+    sp.add_argument("--no-cost", action="store_true", dest="no_cost",
+                    help="skip XLA cost analysis (cost capture compiles "
+                    "the canonical executable once per shape)")
+    sp.add_argument("--cost-max-pad", type=int, default=4096,
+                    dest="cost_max_pad",
+                    help="largest padded shape cost capture may compile "
+                    "(default 4096; bigger rows still show winner + "
+                    "timings)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable rows instead of the table")
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_kernels)
 
     sp = sub.add_parser(
         "lint",

@@ -476,6 +476,56 @@ def slo_ms() -> float:
     return env_float("RCA_SLO_MS", 500.0, 1.0, 600_000.0)
 
 
+# -- kernel registry + kernelscope (ISSUE 12) --------------------------------
+# env knobs for the per-shape kernel registry (rca_tpu/engine/registry.py)
+# and the kernelscope runtime watchdogs (rca_tpu/observability/kernelscope),
+# each validated here so a typo'd value fails loudly:
+#
+#   RCA_KERNEL_CACHE   file the registry persists timed autotune winners +
+#                      cost rows to (keyed by jax version + kernel-set
+#                      hash, so upgrades re-time); default
+#                      ~/.cache/rca_tpu/kernel_cache.json; 0|off|none
+#                      disables persistence entirely
+#   RCA_KERNELSCOPE    1 (default) | 0 — the runtime recompile watchdog
+#                      (a jax_log_compiles-fed monitor counting any
+#                      compilation whose signature was already compiled —
+#                      the dynamic complement of tracecheck, running
+#                      continuously on tick/serve paths) and the
+#                      device-memory accountant in health records and
+#                      ServeMetrics
+#   RCA_MEM_SAMPLE_EVERY [1, 100000]  ticks between device-memory samples
+#                      in streaming health records (default 10 — the
+#                      live-buffer walk is cheap but not free)
+
+
+def kernel_cache_path() -> Optional[str]:
+    """``RCA_KERNEL_CACHE``: the registry's autotune/cost cache file.
+    Unset/empty = the default under ``~/.cache``; ``0``/``off``/``none``
+    = disabled (returns None)."""
+    raw = (env_raw("RCA_KERNEL_CACHE") or "").strip()
+    if not raw:
+        return os.path.join(
+            os.path.expanduser("~"), ".cache", "rca_tpu",
+            "kernel_cache.json",
+        )
+    if raw.lower() in ("0", "off", "none"):
+        return None
+    return raw
+
+
+def kernelscope_enabled() -> bool:
+    """``RCA_KERNELSCOPE``: recompile watchdog + memory accountant."""
+    return env_str(
+        "RCA_KERNELSCOPE", "1", choices=("0", "1", "on", "off"),
+        lower=True,
+    ) in ("1", "on")
+
+
+def memory_sample_every() -> int:
+    """``RCA_MEM_SAMPLE_EVERY``: ticks between device-memory samples."""
+    return env_int("RCA_MEM_SAMPLE_EVERY", 10, 1, 100_000)
+
+
 # -- persistent compilation cache (ISSUE 2 satellite) -----------------------
 # enabled at most once per process; the dict is the recorded status the
 # session health records and bench line carry

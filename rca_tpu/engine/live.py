@@ -149,6 +149,20 @@ class LiveStreamingSession:
 
         self.tracer = tracer if tracer is not None else default_tracer()
         self._trace_ctx = self.tracer.new_context()
+        # kernelscope watchdogs (ISSUE 12): the recompile monitor runs
+        # for the session's whole life (a post-warmup compilation of an
+        # already-compiled signature on the tick path is a regression
+        # tracecheck's 2-call probe cannot see), and the device-memory
+        # accountant samples every RCA_MEM_SAMPLE_EVERY-th tick into the
+        # health record.  RCA_KERNELSCOPE=0 turns both into no-ops.
+        from rca_tpu.observability.kernelscope import (
+            DeviceMemoryAccountant,
+            RecompileMonitor,
+        )
+
+        self.recompile_monitor = RecompileMonitor().start()
+        self.memory_accountant = DeviceMemoryAccountant()
+        self._warm_marked = False
         # tick pipeline (ISSUE 2 tentpole): in-flight handles, oldest first
         self.pipeline_depth = (
             pipeline_depth_from_env() if pipeline_depth is None
@@ -576,6 +590,11 @@ class LiveStreamingSession:
                 "degraded": True,
             }
         self._last_ranked = list(out.get("ranked", []))
+        if not self._warm_marked:
+            # warmup ends after the first completed poll: the steady
+            # state is what the zero-post-warmup-recompiles gate covers
+            self.recompile_monitor.mark_warm()
+            self._warm_marked = True
         out["health"] = self._health_record(out)
         self._trace_tick(out, t_poll0)
         if self.recorder is not None:
@@ -647,8 +666,24 @@ class LiveStreamingSession:
             self._compile_cache["warm"] = (
                 self._compile_cache["new_entries"] == 0
             )
+        # kernelscope channel (ISSUE 12): cumulative recompile counts +
+        # the periodic device-memory sample.  NOT in the recorder's
+        # _HEALTH_KEYS — compile/memory state is host-of-the-day, not
+        # replayable incident state.
+        scope = self.recompile_monitor.snapshot()
+        kernelscope = {
+            "recompiles": scope["recompiles"],
+            "recompiles_post_warm": scope["recompiles_post_warm"],
+            "compiles": scope["compiles"],
+        }
+        if scope["recompiled"]:
+            kernelscope["recompiled"] = scope["recompiled"]
+        mem = self.memory_accountant.maybe_sample(self._polls)
+        if mem is not None:
+            kernelscope["device_memory"] = mem
         return {
             "sanitized_rows": int(out.get("sanitized_rows", 0)),
+            "kernelscope": kernelscope,
             "pipeline_depth": self.pipeline_depth,
             "result_lag": (
                 0 if self.pipeline_depth == 1 or out.get("pipeline_fill")

@@ -129,107 +129,21 @@ def pallas_enabled() -> bool:
     )
 
 
-_AUTOTUNED_PATH = None
-
-
 def noisyor_autotune(refresh: bool = False) -> str:
-    """The noisy-OR combine path sessions should run: ``"xla"`` or
-    ``"pallas"``, decided ONCE per process (ISSUE 2 satellite).
+    """Back-compat shim over the per-shape kernel registry (ISSUE 12):
+    the process-level combine path — the registry's winner at the
+    canonical shape.  The one-shot timing, the ``RCA_PALLAS`` force
+    semantics, and the CPU short-circuit all live in
+    :mod:`rca_tpu.engine.registry` now; sessions ask the registry
+    per-shape via :func:`rca_tpu.engine.registry.engaged_kernel` and
+    stamp this process-level answer only as ``noisyor_path``."""
+    from rca_tpu.engine.registry import autotune_path
 
-    BENCH_r05 showed why a static flag is wrong in both directions:
-    ``pallas_supported: true`` yet XLA 4.5x faster on that backend
-    (0.0091 vs 0.0414 ms) — so instead of trusting a capability probe, an
-    ``RCA_PALLAS=auto`` session TIMES both paths once at first session
-    start (two small amortized in-jit loops, fetch-synced per the PERF.md
-    methodology) and takes the measured winner.  ``RCA_PALLAS=1`` still
-    forces the kernel, ``RCA_PALLAS=0`` forces XLA, and non-accelerator
-    backends (CPU tests) short-circuit to XLA without timing — the kernel
-    only ever runs interpreted there, and timing an interpreter would
-    burn seconds to confirm the obvious.  The choice is recorded by
-    bench.py and every streaming tick health record as ``noisyor_path``.
-    """
-    global _AUTOTUNED_PATH
-    if _AUTOTUNED_PATH is not None and not refresh:
-        return _AUTOTUNED_PATH
-    flag = env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1"))
-    if flag == "1":
-        # forced: pallas_supported raises loudly if the compile fails
-        pallas_supported()
-        _AUTOTUNED_PATH = "pallas"
-        return _AUTOTUNED_PATH
-    if (
-        flag == "0"
-        or jax.default_backend() == "cpu"
-        or not pallas_supported()
-    ):
-        _AUTOTUNED_PATH = "xla"
-        return _AUTOTUNED_PATH
-    _AUTOTUNED_PATH = (
-        "pallas" if _time_pallas_beats_xla() else "xla"
-    )
-    return _AUTOTUNED_PATH
+    return autotune_path(refresh=refresh)
 
 
 def noisyor_path():
     """The autotuned choice, or None when no session has autotuned yet."""
-    return _AUTOTUNED_PATH
+    from rca_tpu.engine.registry import autotuned_path
 
-
-def engaged_kernel(n_pad: int) -> str:
-    """The combine path a session over an ``n_pad``-padded graph
-    actually ENGAGES (ISSUE 11 satellite): the autotuner's choice is
-    per-process, but the Pallas grid additionally needs the node pad to
-    divide into blocks — so ``pallas_engaged: false`` at round level can
-    hide a per-shape story.  This is the per-shape answer, stamped into
-    streaming health records, dispatch span attributes, and bench's
-    ``kernel_by_shape``."""
-    n_pad = int(n_pad)
-    if noisyor_autotune() != "pallas":
-        return "xla"
-    return "pallas" if n_pad % min(n_pad, BLOCK_S) == 0 else "xla"
-
-
-def _time_pallas_beats_xla(s: int = 8192, reps: int = 200) -> bool:
-    """One-shot timing of both combine paths on a representative [S, C]
-    block: amortized in-jit loops (rep count folds a salt so no transport
-    cache can replay), synced by FETCHING a slice — never
-    block_until_ready (PERF.md round-1 correction).  Returns whether the
-    fused kernel measurably beats XLA's fusion; ties go to XLA (the
-    simpler, default-tested path)."""
-    import time
-
-    import numpy as np
-
-    from rca_tpu.features.schema import NUM_SERVICE_FEATURES
-
-    rng = np.random.default_rng(0)
-    f = jnp.asarray(
-        rng.uniform(0, 1, (s, NUM_SERVICE_FEATURES)).astype(np.float32)
-    )
-    ft = f.T
-    w = jnp.asarray(
-        rng.uniform(0.2, 0.9, NUM_SERVICE_FEATURES).astype(np.float32)
-    )
-
-    def timed(fn, arg):
-        @jax.jit
-        def many(x, salt):
-            def body(i, acc):
-                a, h = fn(x * (1.0 + salt + i * 1e-7), w, w)
-                return acc + a + h
-            return jax.lax.fori_loop(0, reps, body, jnp.zeros(s))
-
-        jax.device_get(many(arg, jnp.float32(1e-7))[:4])  # compile
-        outs = []
-        for j in range(3):
-            t0 = time.perf_counter()
-            jax.device_get(many(arg, jnp.float32((j + 2) * 1e-7))[:4])
-            outs.append(time.perf_counter() - t0)
-        return min(outs)
-
-    try:
-        t_pallas = timed(noisy_or_pair_pallas, ft)
-        t_xla = timed(noisy_or_pair_xla, f)
-    except Exception:
-        return False  # a path that cannot even time cannot win
-    return t_pallas < 0.95 * t_xla
+    return autotuned_path()

@@ -1,0 +1,507 @@
+"""KernelRegistry: the per-shape kernel table and THE dispatch seam.
+
+ROADMAP item 4's refactor unlock (ISSUE 12 tentpole): every bench round
+since r02 reported ``pallas_engaged: false`` as one process-wide bit,
+decided by a one-shot autotune at a single canonical shape and then
+re-derived ad hoc at four call surfaces (one-shot analyze, streaming
+flush, resident delta, serve dispatch) — so adding a kernel meant
+editing every surface, and a per-shape regression had nowhere to show
+up.  This module replaces that with a declarative registry:
+
+- a **row per ``(variant, n_pad, backend)``** records the engaged
+  combine kernel (``xla | pallas`` today; a segscan or quantized kernel
+  is a new :data:`KERNELS` candidate + an eligibility/timing hook, not a
+  rewrite), WHY it won (``forced``/``cpu-default``/``ineligible``/
+  ``timed``/``cache``/``sharded``), the per-candidate autotune timings,
+  and the winner executable's XLA cost analysis (FLOPs, bytes accessed,
+  peak temp/output memory) captured at compile time;
+- :func:`engaged_kernel` is the ONE place a propagation surface asks
+  "which kernel does this padded shape run" — graftlint rule
+  ``kernel-dispatch`` makes calling the kernel bodies (or the legacy
+  autotune shims) outside this seam unlandable;
+- timed winners persist to a **file cache** (``RCA_KERNEL_CACHE``,
+  keyed by jax version + a kernel-set source hash) so restarts don't
+  re-time; corrupt or stale entries re-time instead of crashing, and
+  ``RCA_KERNEL_CACHE=0`` disables the cache entirely;
+- the same rows feed bench's ``kernel_registry`` section AND its
+  ``kernel_by_shape`` map (agreement by construction — ISSUE 12
+  satellite), the ``/metrics`` per-shape gauges, dispatch-span
+  attributes, tick health records, and the ``rca kernels`` CLI table.
+
+CPU hosts (tests) short-circuit to XLA without timing, exactly like the
+old autotune: the Pallas kernel only runs interpreted there, and timing
+an interpreter proves nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from rca_tpu.config import kernel_cache_path
+from rca_tpu.util.threads import make_lock
+
+#: candidate combine kernels, in table order.  Adding a kernel =
+#: appending here + teaching :func:`_eligible` / :func:`_time_candidates`
+#: about it (ROADMAP item 4 names ``segscan`` and ``quantized`` next).
+KERNELS = ("xla", "pallas")
+
+#: the canonical shape the process-level compat path times at (the old
+#: ``noisyor_autotune`` measured one [8192, C] block and applied the
+#: verdict everywhere; per-shape rows supersede it, the constant remains
+#: for the back-compat shim)
+CANONICAL_PAD = 8192
+
+_CACHE_VERSION = 1
+
+
+def _flag() -> str:
+    from rca_tpu.config import env_str
+
+    return env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1"))
+
+
+def _backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def kernel_set_hash() -> str:
+    """Source hash of the kernel set: the cache invalidation key.  A
+    change to any kernel body, the propagation core, or this registry
+    re-times every shape — a stale winner must never outlive the code
+    that earned it (ISSUE 12 satellite)."""
+    global _KERNEL_SET_HASH
+    if _KERNEL_SET_HASH is None:
+        h = hashlib.sha1(repr(KERNELS).encode())
+        base = os.path.dirname(os.path.abspath(__file__))
+        for fname in ("propagate.py", "pallas_kernels.py", "registry.py"):
+            try:
+                with open(os.path.join(base, fname), "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(fname.encode())
+        _KERNEL_SET_HASH = h.hexdigest()[:16]
+    return _KERNEL_SET_HASH
+
+
+_KERNEL_SET_HASH: Optional[str] = None
+
+
+@dataclasses.dataclass
+class KernelRow:
+    """One registry row: the engaged kernel for one padded shape."""
+
+    variant: str                  # dense | sharded
+    n_pad: int
+    backend: str                  # jax.default_backend() at resolve time
+    winner: str                   # the engaged kernel (a KERNELS member)
+    source: str                   # forced|cpu-default|unsupported|
+    #                               ineligible|timed|cache|sharded
+    eligible: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    timings_ms: Dict[str, Optional[float]] = dataclasses.field(
+        default_factory=dict
+    )
+    cost: Optional[Dict[str, Any]] = None   # winner-executable XLA cost
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "variant": self.variant,
+            "n_pad": self.n_pad,
+            "backend": self.backend,
+            "winner": self.winner,
+            "source": self.source,
+            "eligible": dict(self.eligible),
+            "timings_ms": dict(self.timings_ms),
+            "cost": dict(self.cost) if self.cost else None,
+        }
+
+
+class KernelRegistry:
+    """The per-shape kernel table (one per process via
+    :func:`get_registry`).  Rows resolve lazily the first time a surface
+    asks about a shape; the lock is a leaf (the timing/cost work runs
+    outside it — two threads may race to time the same shape, last
+    write wins with identical results)."""
+
+    def __init__(self, cache_path: Optional[str] = "unset"):
+        # "unset" sentinel: resolve RCA_KERNEL_CACHE lazily per lookup so
+        # tests can monkeypatch the env without rebuilding the registry
+        self._cache_path_override = cache_path
+        self._lock = make_lock("KernelRegistry._lock")
+        self._rows: Dict[Tuple[str, int, str, str], KernelRow] = {}
+
+    # -- cache file ----------------------------------------------------------
+    def _cache_file(self) -> Optional[str]:
+        if self._cache_path_override != "unset":
+            return self._cache_path_override
+        return kernel_cache_path()
+
+    def _load_cached(self, key: str) -> Optional[Dict[str, Any]]:
+        path = self._cache_file()
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (json.JSONDecodeError, OSError, UnicodeDecodeError):
+            return None  # corrupt cache: re-time, never crash
+        import jax
+
+        if (
+            not isinstance(data, dict)
+            or data.get("version") != _CACHE_VERSION
+            or data.get("jax") != jax.__version__
+            or data.get("kernel_set") != kernel_set_hash()
+        ):
+            return None  # stale header (jax upgrade / kernel edit): re-time
+        row = (data.get("rows") or {}).get(key)
+        if not isinstance(row, dict) or row.get("winner") not in KERNELS:
+            return None
+        return row
+
+    def _store_cached(self, key: str, row: KernelRow) -> None:
+        path = self._cache_file()
+        if not path:
+            return
+        import jax
+
+        header = {
+            "version": _CACHE_VERSION,
+            "jax": jax.__version__,
+            "kernel_set": kernel_set_hash(),
+        }
+        try:
+            existing: Dict[str, Any] = {}
+            if os.path.exists(path):
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        data = json.load(f)
+                    if (
+                        isinstance(data, dict)
+                        and data.get("version") == _CACHE_VERSION
+                        and data.get("jax") == header["jax"]
+                        and data.get("kernel_set") == header["kernel_set"]
+                    ):
+                        existing = data.get("rows") or {}
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    existing = {}  # corrupt file: rewrite from scratch
+            existing[key] = {
+                "winner": row.winner,
+                "timings_ms": dict(row.timings_ms),
+                "cost": dict(row.cost) if row.cost else None,
+            }
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({**header, "rows": existing}, f, indent=1)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError:
+            pass  # an unwritable cache must not fail the dispatch
+
+    # -- resolution ----------------------------------------------------------
+    def resolve(self, n_pad: int, sharded: bool = False) -> KernelRow:
+        """The row for one padded shape, created on first ask.  Rows are
+        keyed by the ``RCA_PALLAS`` flag too, so a test flipping the env
+        mid-process re-decides instead of serving a stale verdict."""
+        n_pad = int(n_pad)
+        variant = "sharded" if sharded else "dense"
+        flag = _flag()
+        backend = _backend()
+        key = (variant, n_pad, backend, flag)
+        with self._lock:
+            row = self._rows.get(key)
+        if row is not None:
+            return row
+        row = self._decide(variant, n_pad, backend, flag)
+        with self._lock:
+            self._rows[key] = row
+        return row
+
+    def _decide(self, variant: str, n_pad: int, backend: str,
+                flag: str) -> KernelRow:
+        from rca_tpu.engine.pallas_kernels import (
+            BLOCK_S,
+            pallas_supported,
+        )
+
+        divisible = n_pad % min(n_pad, BLOCK_S) == 0
+        eligible: Dict[str, Any] = {
+            "xla": True,
+            "pallas": (
+                True if divisible
+                else f"n_pad {n_pad} not divisible into {BLOCK_S} blocks"
+            ),
+        }
+        row = KernelRow(
+            variant=variant, n_pad=n_pad, backend=backend,
+            winner="xla", source="default", eligible=eligible,
+        )
+        if variant == "sharded":
+            # the sharded per-block kernel keeps XLA's fused noisy-OR —
+            # the Pallas pair has no shard_map twin (SERVING.md)
+            row.source = "sharded"
+            row.eligible["pallas"] = "no shard_map twin"
+            return row
+        if flag == "1":
+            # forced: pallas_supported raises loudly if the compile fails
+            pallas_supported()
+            row.winner = "pallas" if divisible else "xla"
+            row.source = "forced" if divisible else "ineligible"
+            return row
+        if flag == "0":
+            row.source = "forced"
+            return row
+        if backend == "cpu":
+            # non-accelerator: the kernel only runs interpreted here —
+            # timing an interpreter burns seconds to confirm the obvious
+            row.source = "cpu-default"
+            return row
+        if not pallas_supported():
+            row.source = "unsupported"
+            return row
+        if not divisible:
+            row.source = "ineligible"
+            return row
+        cache_key = f"{variant}:{n_pad}:{backend}"
+        cached = self._load_cached(cache_key)
+        if cached is not None:
+            row.winner = cached["winner"]
+            row.source = "cache"
+            row.timings_ms = dict(cached.get("timings_ms") or {})
+            if cached.get("cost"):
+                row.cost = dict(cached["cost"])
+            return row
+        row.timings_ms = _time_candidates(n_pad)
+        t_xla = row.timings_ms.get("xla")
+        t_pallas = row.timings_ms.get("pallas")
+        # ties (and unmeasurable candidates) go to XLA — the simpler,
+        # default-tested path, same policy the one-shot autotune had
+        row.winner = (
+            "pallas"
+            if t_xla is not None and t_pallas is not None
+            and t_pallas < 0.95 * t_xla
+            else "xla"
+        )
+        row.source = "timed"
+        self._store_cached(cache_key, row)
+        return row
+
+    # -- cost analysis -------------------------------------------------------
+    def ensure_cost(self, row: KernelRow) -> KernelRow:
+        """Capture the winner executable's XLA cost analysis for a row
+        that lacks it (one AOT compile of the canonical propagation body
+        at this shape).  Explicit, not automatic: a ``/metrics`` scrape
+        must never trigger a compile — ``rca kernels`` and bench call
+        this, serve surfaces export whatever is already captured."""
+        if row.cost is None:
+            row.cost = _capture_cost(row.n_pad, row.winner)
+            if row.source in ("timed", "cache"):
+                cache_key = f"{row.variant}:{row.n_pad}:{row.backend}"
+                self._store_cached(cache_key, row)
+        return row
+
+    # -- reading -------------------------------------------------------------
+    def table(self, ensure_cost: bool = False,
+              cost_max_pad: int = 4096) -> List[Dict[str, Any]]:
+        """Every resolved row, smallest shape first.  ``ensure_cost``
+        captures missing cost analysis for rows with ``n_pad <=
+        cost_max_pad`` (the cap bounds how much compile time a table
+        dump may spend — a 50k-pad canonical compile is tens of seconds
+        on a CPU host; its row still shows winner + timings)."""
+        with self._lock:
+            rows = sorted(
+                self._rows.values(),
+                key=lambda r: (r.variant, r.n_pad, r.backend),
+            )
+        out = []
+        for row in rows:
+            if ensure_cost and row.cost is None and row.n_pad <= cost_max_pad:
+                self.ensure_cost(row)
+            out.append(row.to_dict())
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+
+
+def _time_candidates(n_pad: int, reps: int = 200) -> Dict[str, Optional[float]]:
+    """Amortized in-jit timing of each candidate's evidence pass at THIS
+    padded shape: rep count folds a salt so no transport cache can
+    replay, sync is by FETCHING a slice — never ``block_until_ready``
+    (PERF.md round-1 correction).  A candidate that cannot even time
+    records ``None`` (and cannot win)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rca_tpu.engine.pallas_kernels import (
+        noisy_or_pair_pallas,
+        noisy_or_pair_xla,
+    )
+    from rca_tpu.features.schema import NUM_SERVICE_FEATURES
+
+    rng = np.random.default_rng(0)
+    f = jnp.asarray(
+        rng.uniform(0, 1, (n_pad, NUM_SERVICE_FEATURES)).astype(np.float32)
+    )
+    ft = f.T
+    w = jnp.asarray(
+        rng.uniform(0.2, 0.9, NUM_SERVICE_FEATURES).astype(np.float32)
+    )
+
+    def timed(fn, arg) -> Optional[float]:
+        @jax.jit
+        def many(x, salt):
+            def body(i, acc):
+                a, h = fn(x * (1.0 + salt + i * 1e-7), w, w)
+                return acc + a + h
+            return jax.lax.fori_loop(0, reps, body, jnp.zeros(n_pad))
+
+        try:
+            jax.device_get(many(arg, jnp.float32(1e-7))[:4])  # compile
+            outs = []
+            for j in range(3):
+                t0 = time.perf_counter()
+                jax.device_get(many(arg, jnp.float32((j + 2) * 1e-7))[:4])
+                outs.append(time.perf_counter() - t0)
+            return float(min(outs)) * 1e3 / reps
+        except Exception:
+            return None  # a path that cannot even time cannot win
+
+    return {
+        "xla": timed(noisy_or_pair_xla, f),
+        "pallas": timed(noisy_or_pair_pallas, ft),
+    }
+
+
+def _capture_cost(n_pad: int, winner: str) -> Dict[str, Any]:
+    """XLA cost + memory analysis of the canonical propagation
+    executable at this padded shape: the one-shot fused body
+    (``_propagate_ranked`` — sanitize + evidence + propagation + top-k)
+    AOT-lowered over a ring graph with ``n_pad`` padded edges in pure
+    COO form.  Canonical, not per-session: the figures scale with the
+    shape (the registry key), not with one graph's edge list, so rows
+    stay comparable across rounds.  Backends without cost analysis
+    record why instead of crashing."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rca_tpu.engine.propagate import default_params
+    from rca_tpu.engine.runner import _propagate_ranked
+    from rca_tpu.features.schema import NUM_SERVICE_FEATURES
+
+    n_pad = int(n_pad)
+    n = max(1, n_pad - 1)  # slot n_pad-1 is the engine's dummy row
+    dummy = n_pad - 1
+    src = np.full(n_pad, dummy, np.int32)
+    dst = np.full(n_pad, dummy, np.int32)
+    ring = np.arange(n, dtype=np.int32)
+    src[:n] = ring
+    dst[:n] = (ring + 1) % n
+    p = default_params()
+    aw, hw = p.weight_arrays()
+    features = jnp.zeros((n_pad, NUM_SERVICE_FEATURES), jnp.float32)
+    edges = jnp.asarray(np.stack([src, dst]))
+    kk = min(13, n_pad)
+    try:
+        compiled = _propagate_ranked.lower(
+            features, edges, aw, hw,
+            p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
+            winner == "pallas", jnp.asarray(n, jnp.int32), None, None,
+            None, error_contrast=p.error_contrast,
+        ).compile()
+    except Exception as exc:
+        return {"unavailable": f"compile: {type(exc).__name__}: {exc}"}
+    out: Dict[str, Any] = {"kernel": winner, "k": kk}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out["flops"] = float(ca.get("flops", 0.0))
+        out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+        if "transcendentals" in ca:
+            out["transcendentals"] = float(ca["transcendentals"])
+    except Exception as exc:
+        out["cost_unavailable"] = f"{type(exc).__name__}: {exc}"
+    try:
+        ma = compiled.memory_analysis()
+        for attr, key in (
+            ("temp_size_in_bytes", "peak_temp_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("generated_code_size_in_bytes", "code_bytes"),
+        ):
+            value = getattr(ma, attr, None)
+            if value is not None:
+                out[key] = int(value)
+    except Exception as exc:
+        out["memory_unavailable"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
+# -- the process registry + module-level seam ---------------------------------
+
+_REGISTRY: Optional[KernelRegistry] = None
+_REGISTRY_LOCK = make_lock("registry._REGISTRY_LOCK")
+
+
+def get_registry() -> KernelRegistry:
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = KernelRegistry()
+        return _REGISTRY
+
+
+def reset_registry() -> None:
+    """Drop every resolved row (tests flipping env knobs)."""
+    get_registry().clear()
+
+
+def engaged_kernel(n_pad: int, sharded: bool = False) -> str:
+    """THE dispatch seam: which combine kernel a propagation over an
+    ``n_pad``-padded graph engages.  Every call surface (one-shot
+    analyze, streaming flush, resident delta, serve dispatch, sharded
+    tick) asks HERE — graftlint rule ``kernel-dispatch`` keeps it that
+    way."""
+    return get_registry().resolve(n_pad, sharded=sharded).winner
+
+
+def autotune_path(refresh: bool = False) -> str:
+    """Process-level compat for the retired one-shot autotune: the
+    winner at the canonical shape (``xla``/``pallas``).  Sessions stamp
+    this as ``noisyor_path`` next to the per-shape ``kernel_path``."""
+    global _PROCESS_PATH
+    if refresh:
+        reset_registry()
+        _PROCESS_PATH = None
+    if _PROCESS_PATH is None:
+        _PROCESS_PATH = get_registry().resolve(CANONICAL_PAD).winner
+    return _PROCESS_PATH
+
+
+def autotuned_path() -> Optional[str]:
+    """The cached process-level choice, or None when nothing autotuned
+    yet (the old ``noisyor_path()`` contract)."""
+    return _PROCESS_PATH
+
+
+_PROCESS_PATH: Optional[str] = None
+
+
+def kernel_table(ensure_cost: bool = False,
+                 cost_max_pad: int = 4096) -> List[Dict[str, Any]]:
+    """Every resolved row as dicts — bench ``kernel_registry``,
+    ``/metrics`` gauges, and ``rca kernels`` all read THIS, so they
+    agree by construction."""
+    return get_registry().table(
+        ensure_cost=ensure_cost, cost_max_pad=cost_max_pad,
+    )

@@ -113,7 +113,7 @@ class ResidentSession:
         dep_src: np.ndarray,
         dep_dst: np.ndarray,
     ):
-        from rca_tpu.engine.pallas_kernels import BLOCK_S, noisyor_autotune
+        from rca_tpu.engine.registry import engaged_kernel
         from rca_tpu.engine.runner import coo_layouts_for
 
         self.engine = engine
@@ -137,10 +137,10 @@ class ResidentSession:
             self._n_pad, e_pad, dep_src, dep_dst
         )
         self._n_live = jnp.asarray(n, jnp.int32)
-        self._use_pallas = (
-            noisyor_autotune() == "pallas"
-            and self._n_pad % min(self._n_pad, BLOCK_S) == 0
-        )
+        # per-shape registry row (ISSUE 12): the same dispatch seam the
+        # one-shot and streaming surfaces ask, so the resident delta
+        # path cannot drift to a different combine kernel
+        self._use_pallas = engaged_kernel(self._n_pad) == "pallas"
         # raw host mirror of the resident buffer's live rows (the diff
         # base); None until the first request stages the buffer
         self._mirror: Optional[np.ndarray] = None

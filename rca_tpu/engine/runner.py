@@ -677,20 +677,13 @@ class GraphEngine(EngineAPI):
             down_seg, up_seg, up_ell = coo_layouts_for(
                 f.shape[0], len(s), dep_src, dep_dst
             )
-            from rca_tpu.engine.pallas_kernels import (
-                BLOCK_S,
-                noisyor_autotune,
-            )
+            from rca_tpu.engine.registry import engaged_kernel
 
-            # Pallas evidence pass engages only when the one-shot autotune
-            # MEASURED it faster on this backend (RCA_PALLAS=1 forces it,
-            # =0 forces XLA; see pallas_kernels.noisyor_autotune).  Kernel
-            # grid also needs the node pad to divide into blocks (true for
-            # every power-of-two shape bucket).
-            use_pallas = (
-                f.shape[0] % min(f.shape[0], BLOCK_S) == 0
-                and noisyor_autotune() == "pallas"
-            )
+            # combine-kernel choice comes from the per-shape registry
+            # (ISSUE 12): the ONE dispatch seam shared with streaming,
+            # resident, and serve staging — RCA_PALLAS forcing, the
+            # autotune, and the block-divisibility gate all live there
+            use_pallas = engaged_kernel(f.shape[0]) == "pallas"
 
             # AOT compile warming (ISSUE 6 satellite): the timed path's
             # old warmup dispatched the executable and fetched its results

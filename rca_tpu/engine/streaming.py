@@ -331,12 +331,12 @@ class StreamingSession(StreamingHostState):
         )
         self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
         self._kk = min(k + 8, self._n_pad)
-        # noisy-OR combine path picked ONCE at session start (ISSUE 2
-        # satellite: BENCH_r05 had pallas_supported=true but a 4.5x-slower
-        # kernel — a static flag cannot know; the autotune measures)
-        from rca_tpu.engine.pallas_kernels import engaged_kernel, noisyor_autotune
+        # combine path from the per-shape kernel registry (ISSUE 12 —
+        # the ONE dispatch seam): per-shape winner for THIS padded shape
+        # plus the process-level compat stamp health records carry
+        from rca_tpu.engine.registry import autotune_path, engaged_kernel
 
-        self.noisyor_path = noisyor_autotune()
+        self.noisyor_path = autotune_path()
         # the ENGAGED path for THIS padded shape (the autotune choice
         # plus the block-divisibility gate) — health records and span
         # attributes carry it so a pallas regression names a shape

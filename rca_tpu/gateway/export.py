@@ -56,11 +56,70 @@ def render_metrics_text(
     gateway: Optional[Dict[str, Any]] = None,
     healthy: Optional[bool] = None,
     now_ms: Optional[int] = None,
+    kernelscope: Optional[Dict[str, Any]] = None,
 ) -> str:
     """The full exposition body (text/plain; version=0.0.4).
     ``now_ms`` (ms since epoch, from the gateway's wall seam) stamps
-    every GAUGE sample; counters stay timestamp-free per convention."""
+    every GAUGE sample; counters stay timestamp-free per convention.
+    ``kernelscope`` (ISSUE 12) is the plane's
+    ``kernelscope_summary()``: recompile counters, the device-memory
+    sample, and the per-shape kernel-registry rows."""
     out: List[str] = []
+
+    if kernelscope is not None:
+        _head(out, "rca_recompiles_total", "counter",
+              "post-warmup XLA compilations of already-compiled "
+              "signatures on the serve path (kernelscope watchdog)")
+        _line(out, "rca_recompiles_total",
+              kernelscope.get("recompiles", 0))
+        _head(out, "rca_compiles_total", "counter",
+              "XLA compilations observed since the plane started")
+        _line(out, "rca_compiles_total", kernelscope.get("compiles", 0))
+        mem = kernelscope.get("device_memory") or {}
+        if mem:
+            _head(out, "rca_device_bytes_in_use", "gauge",
+                  "device memory in use (allocator stats where the "
+                  "backend reports them, else the live-buffer total)")
+            _line(out, "rca_device_bytes_in_use",
+                  mem.get("bytes_in_use"), ts=now_ms)
+            for dev, rec in sorted((mem.get("devices") or {}).items()):
+                _line(out, "rca_device_bytes_in_use",
+                      rec.get("bytes_in_use"), ts=now_ms, device=dev)
+            _head(out, "rca_device_live_buffers", "gauge",
+                  "live jax.Array buffers in the process")
+            _line(out, "rca_device_live_buffers",
+                  mem.get("live_buffers"), ts=now_ms)
+        rows = kernelscope.get("kernel_registry") or []
+        if rows:
+            _head(out, "rca_kernel_winner_info", "gauge",
+                  "1 for the engaged kernel per padded shape "
+                  "(engine/registry.py — the dispatch seam)")
+            for row in rows:
+                _line(out, "rca_kernel_winner_info", 1, ts=now_ms,
+                      n_pad=str(row["n_pad"]), variant=row["variant"],
+                      kernel=row["winner"], source=row["source"])
+            _head(out, "rca_kernel_cost_flops", "gauge",
+                  "XLA cost analysis of the winner executable per shape "
+                  "(captured at compile time; absent until captured)")
+            for row in rows:
+                cost = row.get("cost") or {}
+                _line(out, "rca_kernel_cost_flops", cost.get("flops"),
+                      ts=now_ms, n_pad=str(row["n_pad"]),
+                      variant=row["variant"])
+            _head(out, "rca_kernel_cost_bytes_accessed", "gauge",
+                  "bytes accessed per winner executable per shape")
+            for row in rows:
+                cost = row.get("cost") or {}
+                _line(out, "rca_kernel_cost_bytes_accessed",
+                      cost.get("bytes_accessed"), ts=now_ms,
+                      n_pad=str(row["n_pad"]), variant=row["variant"])
+            _head(out, "rca_kernel_peak_temp_bytes", "gauge",
+                  "peak temp memory of the winner executable per shape")
+            for row in rows:
+                cost = row.get("cost") or {}
+                _line(out, "rca_kernel_peak_temp_bytes",
+                      cost.get("peak_temp_bytes"), ts=now_ms,
+                      n_pad=str(row["n_pad"]), variant=row["variant"])
 
     _head(out, "rca_serve_requests_total", "counter",
           "serve outcomes per tenant")

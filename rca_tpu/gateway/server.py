@@ -474,9 +474,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _get_metrics(self) -> int:
         gw = self.gateway
+        scope_fn = getattr(gw.loop, "kernelscope_summary", None)
         text = render_metrics_text(
             gw.loop.metrics.summary(),
             gateway=gw.metrics.snapshot(),
+            # kernelscope rows (ISSUE 12): recompiles, device memory,
+            # per-shape kernel registry — planes without the surface
+            # (stub loops in tests) simply omit the families
+            kernelscope=scope_fn() if callable(scope_fn) else None,
             healthy=gw.health()["ok"],
             # proper exposition format (ISSUE 11 satellite): gauges carry
             # a millisecond timestamp so a scraper knows WHEN the point

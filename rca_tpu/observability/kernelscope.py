@@ -1,0 +1,370 @@
+"""kernelscope: the two runtime watchdogs the compiler/device layer lacked.
+
+ISSUE 12 tentpole, sitting on top of :mod:`rca_tpu.engine.registry`:
+
+- **RecompileMonitor** — a ``jax_log_compiles``-fed hook that watches
+  every XLA compilation for the life of a session.  tracecheck (PR 4)
+  proves each entry point compiles once in a 2-call probe; this is the
+  dynamic complement, running CONTINUOUSLY on hot tick/serve paths.  A
+  compilation whose log signature (function + abstract shapes) was
+  ALREADY compiled in this process is a **recompile**: the jit cache
+  should have served it, so some cache key changed between bit-identical
+  calls — a fresh ``jnp`` constant, an unhashable static, a donation
+  mismatch.  First-seen signatures are ``fresh`` compiles (new shape
+  tiers, new batch widths, resync rebuilds) and are expected; repeats
+  are the regression class that lands green and shows up weeks later as
+  a 30 s stall per production tick.  Counts flow into tick health
+  records, serve summaries, and ``/metrics`` (``rca_recompiles_total``).
+- **Device-memory accountant** — periodic ``live_buffers``/
+  ``memory_stats`` sampling (tick health + ServeMetrics surfaces, gauge
+  ``rca_device_bytes_in_use``) with a monotonic-growth **leak gate**
+  over soak runs: a session whose device footprint only ever grows is
+  leaking buffers even if no single tick looks wrong.
+
+Both watchdogs are on by default (``RCA_KERNELSCOPE=0`` disables) and
+cost nothing measurable: the monitor is a passive logging handler (XLA
+compiles are rare by construction), and memory samples run every
+``RCA_MEM_SAMPLE_EVERY`` ticks (or per ``/metrics`` scrape).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import re
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from rca_tpu.config import kernelscope_enabled, memory_sample_every
+from rca_tpu.util.threads import make_lock
+
+# a compile event whose arguments are ALL scalars (``float32[]``) is an
+# eager constant-creation compile (``jnp.ones(n)`` → broadcast_in_dim):
+# the log message elides static args — including the output SHAPE — so
+# two different constants alias to one signature and dedupe would call
+# the second a recompile.  Hot-path executables always carry real array
+# arguments, so scalar-only events are excluded from recompile
+# accounting (still counted as compiles).
+_HAS_ARRAY_ARG = re.compile(r"\w\[[0-9]")
+
+# eager single-op dispatches compile under the PRIMITIVE's name
+# (``x[idx]`` outside jit → "Compiling gather ...") with the op's static
+# configuration (gather dimension numbers, reduce axes, pad config)
+# elided from the message — two different eager gathers over same-shaped
+# inputs alias to one signature.  The watchdog's contract is the
+# JIT-COMPILED hot-path executables (python-function names like
+# ``_propagate_ranked``); eager primitive names are excluded from
+# recompile accounting.  Curated from the lax primitives the engine's
+# host paths eagerly dispatch; an entry here only mutes the repeat
+# heuristic, the compile still counts.
+_EAGER_PRIMITIVES = frozenset({
+    "abs", "add", "all", "any", "argmax", "argmin", "asarray", "and",
+    "broadcast_in_dim", "clamp", "clip", "concatenate",
+    "convert_element_type", "copy", "cumsum", "div", "dot_general",
+    "dynamic_slice", "dynamic_update_slice", "eq", "exp", "expand_dims",
+    "floor_divide", "gather", "ge", "gt", "integer_pow", "iota",
+    "isfinite", "isinf", "isnan", "le", "log", "logistic", "lt",
+    "matmul", "max", "min", "mul", "ne", "neg", "not", "or", "pad",
+    "pow", "reduce_and", "reduce_max", "reduce_min", "reduce_or",
+    "reduce_prod", "reduce_sum", "rem", "reshape", "rev", "rsqrt",
+    "scatter", "scatter-add", "scatter_add", "select_n", "sign",
+    "slice", "sort", "split", "sqrt", "squeeze", "stack", "sub",
+    "take", "tanh", "top_k", "transpose", "true_divide", "where",
+    "_where", "xor",
+})
+
+
+class _CompileLog:
+    """Process-wide compile-event collector (one instance, refcounted).
+
+    Mirrors :func:`rca_tpu.analysis.tracecheck.compile_log_capture`'s
+    logger handling — ``jax_log_compiles`` promotes compile logs to
+    WARNING, our handler becomes the jax logger's only one so the
+    chatter never reaches stderr — but stays installed for the life of
+    the monitored session instead of a 2-call probe.  tracecheck's
+    save/restore nests cleanly inside an installed monitor (it stashes
+    and restores our handler with the rest)."""
+
+    #: compile events kept for monitor windows; far above any real
+    #: process's compile count — a trim only loses ancient history
+    EVENT_CAP = 100_000
+
+    def __init__(self) -> None:
+        self._lock = make_lock("kernelscope._CompileLog._lock")
+        self._refs = 0
+        self._seen: Dict[str, int] = {}   # signature -> last event seq
+        self._seq = 0
+        self._events: List[Dict[str, Any]] = []
+        self._handler: Optional[logging.Handler] = None
+        self._saved: Optional[tuple] = None
+
+    # -- the handler ---------------------------------------------------------
+    def _emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if not msg.startswith("Compiling "):
+            return
+        sig = hashlib.sha1(msg.encode("utf-8", "replace")).hexdigest()[:16]
+        parts = msg.split()
+        name = parts[1] if len(parts) > 1 else "?"
+        relevant = (
+            _HAS_ARRAY_ARG.search(msg) is not None
+            and name not in _EAGER_PRIMITIVES
+        )
+        with self._lock:
+            self._seq += 1
+            self._events.append({
+                "seq": self._seq, "name": name, "sig": sig,
+                # the log message elides STATIC args, so an identical
+                # signature may be a different executable; monitors only
+                # call a pair a recompile when both compiles fall inside
+                # one monitored window (see RecompileMonitor.snapshot)
+                "prev_seq": self._seen.get(sig),
+                "relevant": relevant,
+            })
+            self._seen[sig] = self._seq
+            if len(self._events) > self.EVENT_CAP:
+                del self._events[: self.EVENT_CAP // 2]
+
+    def install(self) -> None:
+        with self._lock:
+            self._refs += 1
+            if self._refs > 1:
+                return
+        import jax
+
+        logger = logging.getLogger("jax")
+
+        outer = self
+
+        class _Handler(logging.Handler):
+            def emit(self, record: logging.LogRecord) -> None:
+                outer._emit(record)
+
+        self._handler = _Handler(level=logging.WARNING)
+        self._saved = (
+            list(logger.handlers), logger.level, logger.propagate,
+            jax.config.jax_log_compiles,
+        )
+        logger.handlers = [self._handler]
+        if logger.level > logging.WARNING or logger.level == logging.NOTSET:
+            logger.setLevel(logging.WARNING)
+        logger.propagate = False
+        jax.config.update("jax_log_compiles", True)
+
+    def uninstall(self) -> None:
+        with self._lock:
+            if self._refs == 0:
+                return
+            self._refs -= 1
+            if self._refs > 0:
+                return
+            saved = self._saved
+            self._saved = None
+            self._handler = None
+        if saved is None:
+            return
+        import jax
+
+        logger = logging.getLogger("jax")
+        handlers, level, propagate, flag = saved
+        logger.handlers = handlers
+        logger.setLevel(level)
+        logger.propagate = propagate
+        jax.config.update("jax_log_compiles", flag)
+
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def events_since(self, seq: int) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events if e["seq"] > seq]
+
+
+_LOG = _CompileLog()
+
+
+class RecompileMonitor:
+    """One session's view over the shared compile log: counts since this
+    monitor's ``start()`` (and since ``mark_warm()``), so concurrent
+    sessions each read their own deltas.  Use as a context manager or
+    explicit ``start()``/``stop()``; disabled monitors are free no-ops
+    with the same surface (``RCA_KERNELSCOPE=0``)."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        self.enabled = (
+            kernelscope_enabled() if enabled is None else bool(enabled)
+        )
+        self._started = False
+        self._start_seq = 0
+        self._warm_seq: Optional[int] = None
+
+    def start(self) -> "RecompileMonitor":
+        if self.enabled and not self._started:
+            _LOG.install()
+            self._started = True
+            self._start_seq = _LOG.seq()
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            _LOG.uninstall()
+            self._started = False
+
+    def __enter__(self) -> "RecompileMonitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def mark_warm(self) -> None:
+        """Stamp the end of warmup: ``recompiles_post_warm`` counts from
+        here.  (Repeat-signature compiles are anomalous whenever they
+        happen; the warm mark exists so soaks can assert a hard ZERO on
+        the steady state without caring how warmup interleaved.)"""
+        if self._started:
+            self._warm_seq = _LOG.seq()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Counts over THIS monitor's window.  A recompile = an
+        array-argument compile whose signature was ALREADY compiled
+        inside the same window — the log message elides static args, so
+        pairing across windows (another session's executable with
+        different statics) would alias distinct executables; within one
+        session's window the statics are fixed and a repeat means a
+        cache key drifted between bit-identical calls."""
+        if not self._started:
+            return {"enabled": False, "compiles": 0, "recompiles": 0,
+                    "recompiles_post_warm": 0, "recompiled": []}
+        events = _LOG.events_since(self._start_seq)
+        warm_seq = (
+            self._warm_seq if self._warm_seq is not None
+            else _LOG.seq()
+        )
+        repeats = [
+            e for e in events
+            if e["relevant"] and e["prev_seq"] is not None
+            and e["prev_seq"] > self._start_seq
+        ]
+        return {
+            "enabled": True,
+            "compiles": len(events),
+            "recompiles": len(repeats),
+            "recompiles_post_warm": sum(
+                1 for e in repeats if e["seq"] > warm_seq
+            ),
+            "recompiled": [e["name"] for e in repeats][-8:],
+        }
+
+
+# -- device memory ------------------------------------------------------------
+
+def sample_device_memory() -> Dict[str, Any]:
+    """One sample of the process's device footprint: per-device
+    allocator stats where the backend reports them (TPU/GPU
+    ``memory_stats``), plus the live-buffer census (count and summed
+    bytes of every live ``jax.Array``) — the portable signal CPU test
+    hosts gate on.  ``bytes_in_use`` is the allocator total when
+    available, else the live-buffer total."""
+    import jax
+
+    devices: Dict[str, Dict[str, Any]] = {}
+    allocator_total: Optional[int] = None
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except (RuntimeError, NotImplementedError, AttributeError,
+                TypeError):
+            stats = None
+        if not stats:
+            continue
+        rec = {
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        }
+        devices[str(getattr(d, "id", d))] = rec
+        if rec["bytes_in_use"] is not None:
+            allocator_total = (
+                (allocator_total or 0) + int(rec["bytes_in_use"])
+            )
+    try:
+        live = jax.live_arrays()
+    except (RuntimeError, AttributeError):
+        live = []
+    live_bytes = int(sum(int(getattr(a, "nbytes", 0) or 0) for a in live))
+    return {
+        "devices": devices,
+        "live_buffers": len(live),
+        "live_bytes": live_bytes,
+        "bytes_in_use": (
+            allocator_total if allocator_total is not None else live_bytes
+        ),
+    }
+
+
+def leak_gate(byte_samples: List[int], warmup: int = 1,
+              slack_bytes: int = 1 << 20) -> Dict[str, Any]:
+    """The monotonic-growth leak gate over a soak's memory samples:
+    FAILS only when the post-warmup series never goes down AND ends more
+    than ``slack_bytes`` above where it started — steady-state sessions
+    plateau (scatter reuses the donated buffer), and legitimate churn
+    (resyncs, cache evictions) shows dips.  A series that only climbs is
+    a buffer leak even if no single sample looks alarming."""
+    series = [int(b) for b in byte_samples][warmup:]
+    if len(series) < 3:
+        return {"ok": True, "samples": len(series),
+                "reason": "too few samples to gate"}
+    monotonic = all(b >= a for a, b in zip(series, series[1:]))
+    growth = series[-1] - series[0]
+    ok = not (monotonic and growth > slack_bytes)
+    return {
+        "ok": bool(ok),
+        "samples": len(series),
+        "first_bytes": series[0],
+        "last_bytes": series[-1],
+        "growth_bytes": int(growth),
+        "monotonic_growth": bool(monotonic),
+        "slack_bytes": int(slack_bytes),
+    }
+
+
+class DeviceMemoryAccountant:
+    """Periodic device-memory sampling for tick/serve health surfaces.
+    ``maybe_sample(tick)`` samples every ``sample_every``-th call (the
+    live-buffer walk is cheap, not free); the recorded byte series feeds
+    :func:`leak_gate`.  Disabled accountants sample nothing."""
+
+    def __init__(self, sample_every: Optional[int] = None,
+                 enabled: Optional[bool] = None, cap: int = 1024):
+        self.enabled = (
+            kernelscope_enabled() if enabled is None else bool(enabled)
+        )
+        self.sample_every = (
+            memory_sample_every() if sample_every is None
+            else max(1, int(sample_every))
+        )
+        self._bytes: "deque[int]" = deque(maxlen=cap)
+        self.samples_taken = 0
+
+    def maybe_sample(self, tick: int) -> Optional[Dict[str, Any]]:
+        if not self.enabled or int(tick) % self.sample_every != 0:
+            return None
+        return self.sample()
+
+    def sample(self) -> Optional[Dict[str, Any]]:
+        if not self.enabled:
+            return None
+        out = sample_device_memory()
+        self._bytes.append(int(out["bytes_in_use"]))
+        self.samples_taken += 1
+        return out
+
+    def byte_series(self) -> List[int]:
+        return list(self._bytes)
+
+    def gate(self, warmup: int = 1,
+             slack_bytes: int = 1 << 20) -> Dict[str, Any]:
+        if not self.enabled:
+            return {"ok": True, "samples": 0, "reason": "disabled"}
+        return leak_gate(self.byte_series(), warmup=warmup,
+                         slack_bytes=slack_bytes)

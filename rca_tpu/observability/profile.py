@@ -44,7 +44,7 @@ def profile_ticks(
     from rca_tpu.cluster.generator import synthetic_cascade_world
     from rca_tpu.cluster.mock_client import MockClusterClient
     from rca_tpu.engine.live import LiveStreamingSession
-    from rca_tpu.engine.pallas_kernels import noisyor_autotune
+    from rca_tpu.engine.registry import autotune_path
 
     if tracer is None:
         # an explicit profile capture is its own opt-in: record spans
@@ -60,7 +60,7 @@ def profile_ticks(
     session = LiveStreamingSession(
         client, "profile", k=5, tracer=tracer,
     )
-    noisyor = noisyor_autotune()
+    noisyor = autotune_path()
     kernel_path = getattr(session.session, "kernel_path", None)
     n_pad = getattr(session.session, "_n_pad", None)
     set_profiling(True)

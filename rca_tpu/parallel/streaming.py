@@ -162,10 +162,12 @@ class ShardedStreamingSession(StreamingHostState):
             error_contrast=p.error_contrast,
         )
         # the sharded per-block kernel keeps XLA's fused noisy-OR (the
-        # Pallas pair kernel has no shard_map twin); recorded so the tick
-        # health channel shows which combine path ran, same as dense
+        # Pallas pair kernel has no shard_map twin); the registry's
+        # sharded row records it so the kernel table shows the shape ran
+        from rca_tpu.engine.registry import engaged_kernel
+
         self.noisyor_path = "xla"
-        self.kernel_path = "xla"   # per-shape twin of the dense session's
+        self.kernel_path = engaged_kernel(self._n_pad, sharded=True)
         self._feat_sharding = NamedSharding(self.mesh, P("sp", None))
         self._features = jax.device_put(
             jnp.zeros((self._n_pad, num_features), jnp.float32),

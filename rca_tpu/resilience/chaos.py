@@ -280,7 +280,15 @@ def run_chaos_soak(
     parity_checked = 0
     parity_ok = True
     dirty = False
+    # kernelscope soak gates (ISSUE 12): the session's own recompile
+    # monitor covers the tick path; a dedicated accountant samples
+    # device memory every few ticks so the monotonic-growth leak gate
+    # has a series to judge
+    from rca_tpu.observability.kernelscope import DeviceMemoryAccountant
+
+    soak_memory = DeviceMemoryAccountant(sample_every=5)
     for _ in range(ticks):
+        soak_memory.maybe_sample(live._polls)
         try:
             out = live.poll()
         except Exception as exc:  # contract violation — poll must not raise
@@ -329,9 +337,21 @@ def run_chaos_soak(
                 "ticks_replayed": report["ticks_replayed"],
                 "unconsumed_calls": report["unconsumed_calls"],
             })
+    soak_memory.sample()  # closing sample so short soaks still gate
+    scope = live.recompile_monitor.snapshot()
+    kernelscope_summary = {
+        "enabled": scope["enabled"],
+        "compiles": scope["compiles"],
+        "recompiles_post_warm": scope["recompiles_post_warm"],
+        **({"recompiled": scope["recompiled"]}
+           if scope["recompiled"] else {}),
+        "memory_samples": soak_memory.samples_taken,
+        "memory_gate": soak_memory.gate(),
+    }
     return {
         "ticks": ticks,
         "seed": seed,
+        "kernelscope": kernelscope_summary,
         **({"replay": replay_summary} if replay_summary else {}),
         "uncaught_exceptions": uncaught,
         "faults_injected": counts,

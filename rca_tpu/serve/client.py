@@ -314,6 +314,13 @@ def serve_selftest(
             delta_specs.append({"case": case, "features": feats})
             delta_responses.append(req.result(timeout_s))
 
+        # kernelscope (ISSUE 12): snapshot BEFORE the loop stops (the
+        # monitor disarms with it).  Every serve-path compile is a fresh
+        # shape/width here — a repeat-signature compile means a cache
+        # key drifted between bit-identical calls, and fails the
+        # selftest like a parity break would.
+        scope = loop.recompile_monitor.snapshot()
+
     by_status: Dict[str, int] = {}
     for resp in responses:
         by_status[resp.status] = by_status.get(resp.status, 0) + 1
@@ -362,11 +369,22 @@ def serve_selftest(
             and delta_wave_ok
             and resident_delta_requests >= 1
         ))
+        # recompile watchdog: zero repeat-signature compiles across the
+        # whole selftest (fresh widths/shapes are legitimate and not
+        # counted — see kernelscope)
+        and scope["recompiles"] == 0
     )
     out = {
         "ok": bool(ok),
         "requests": n_requests,
         "chaos": bool(chaos),
+        "kernelscope": {
+            "enabled": scope["enabled"],
+            "compiles": scope["compiles"],
+            "recompiles": scope["recompiles"],
+            **({"recompiled": scope["recompiled"]}
+               if scope["recompiled"] else {}),
+        },
         "by_status": by_status,
         "expected_shed_min": expected_shed,
         "all_resolved": bool(all_resolved),

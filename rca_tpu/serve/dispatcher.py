@@ -166,14 +166,17 @@ class BatchDispatcher:
             self.metrics.graph_cache("miss")
         n = req.features.shape[0]
         if self._sharded:
+            from rca_tpu.engine.registry import engaged_kernel
+
             graph = self.engine._shard(n, req.dep_src, req.dep_dst)
             gs = _PreparedGraph(
                 n=n, n_pad=graph.n_pad, n_edges=len(req.dep_src),
                 sharded_graph=graph,
                 kk=min(K_CAP + 8, graph.n_pad),
-                # the sharded per-block kernel keeps XLA's fused
-                # noisy-OR (no shard_map twin of the Pallas pair)
-                kernel="xla",
+                # the registry's sharded row: always XLA (no shard_map
+                # twin of the Pallas pair), recorded so the table shows
+                # the shape was served
+                kernel=engaged_kernel(graph.n_pad, sharded=True),
             )
         else:
             import jax.numpy as jnp
@@ -191,7 +194,7 @@ class BatchDispatcher:
             down_seg, up_seg, up_ell = coo_layouts_for(
                 n_pad, e_pad, req.dep_src, req.dep_dst
             )
-            from rca_tpu.engine.pallas_kernels import engaged_kernel
+            from rca_tpu.engine.registry import engaged_kernel
 
             gs = _PreparedGraph(
                 n=n, n_pad=n_pad, n_edges=len(req.dep_src),

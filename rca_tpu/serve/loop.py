@@ -68,9 +68,17 @@ class ServeLoop:
         dispatcher: Optional[BatchDispatcher] = None,
         recorder=None,
         tracer=None,
+        kernelscope: Optional[bool] = None,
     ):
         self.config = config or ServeConfig.from_env()
         self.clock = clock
+        # kernelscope (ISSUE 12): the serve plane's recompile watchdog —
+        # armed for the loop's lifetime (start→stop); a post-warmup
+        # compilation of an already-compiled signature on the serve path
+        # is a regression.  ``kernelscope=None`` follows RCA_KERNELSCOPE.
+        from rca_tpu.observability.kernelscope import RecompileMonitor
+
+        self.recompile_monitor = RecompileMonitor(enabled=kernelscope)
         # distributed tracing (ISSUE 11): admission mints each request's
         # root context; the loop records queue/batch/dispatch/fetch
         # spans; the sink closes the root at completion
@@ -122,6 +130,7 @@ class ServeLoop:
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServeLoop":
         if self._thread is None or not self._thread.is_alive():
+            self.recompile_monitor.start()
             self._stop.clear()
             self._thread = make_thread(
                 self._run, name="rca-serve", daemon=True
@@ -135,6 +144,23 @@ class ServeLoop:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        self.recompile_monitor.stop()
+
+    def kernelscope_summary(self) -> dict:
+        """The plane's compiler/device telemetry (ISSUE 12): recompile
+        counts, one device-memory sample, and the live kernel-registry
+        rows — rendered by ``/metrics`` and the selftest summary.  Cost
+        analysis is exported only where already captured; a metrics
+        scrape never triggers a compile."""
+        from rca_tpu.engine.registry import kernel_table
+        from rca_tpu.observability.kernelscope import sample_device_memory
+
+        out = dict(self.recompile_monitor.snapshot())
+        out["device_memory"] = (
+            sample_device_memory() if out["enabled"] else None
+        )
+        out["kernel_registry"] = kernel_table()
+        return out
 
     def __enter__(self) -> "ServeLoop":
         return self.start()

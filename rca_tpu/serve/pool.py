@@ -72,6 +72,7 @@ class ServePool:
         dispatchers: Optional[Sequence] = None,
         breakers: Optional[Sequence] = None,
         tracer=None,
+        kernelscope: Optional[bool] = None,
     ):
         """``engines``: optional replica engines — either bare engine
         objects (dense, device placement left to the engine) or
@@ -85,6 +86,12 @@ class ServePool:
         self.clock = clock
         self.queue = RequestQueue(self.config.queue_cap, clock=clock)
         self.metrics = ServeMetrics()
+        # kernelscope (ISSUE 12): ONE pool-wide recompile watchdog (the
+        # compile log is process-global; per-replica monitors would
+        # double count); armed start→stop, RCA_KERNELSCOPE=0 disables
+        from rca_tpu.observability.kernelscope import RecompileMonitor
+
+        self.recompile_monitor = RecompileMonitor(enabled=kernelscope)
         # one tracer for the whole plane (ISSUE 11): admission mints the
         # root context, the router records queue/steal spans, replicas
         # record batch/dispatch/fetch, the sink closes the root
@@ -134,11 +141,27 @@ class ServePool:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServePool":
+        self.recompile_monitor.start()
         for r in self.replicas:
             r.start()
         return self
 
+    def kernelscope_summary(self) -> dict:
+        """Pool twin of :meth:`rca_tpu.serve.loop.ServeLoop.
+        kernelscope_summary`: recompile counts + a device-memory sample
+        + the live kernel-registry rows."""
+        from rca_tpu.engine.registry import kernel_table
+        from rca_tpu.observability.kernelscope import sample_device_memory
+
+        out = dict(self.recompile_monitor.snapshot())
+        out["device_memory"] = (
+            sample_device_memory() if out["enabled"] else None
+        )
+        out["kernel_registry"] = kernel_table()
+        return out
+
     def stop(self, timeout: float = 10.0) -> None:
+        self.recompile_monitor.stop()
         for r in self.replicas:
             r.request_stop()
         self.queue.kick()

@@ -231,6 +231,19 @@ def handle(tracer, ctx):
     raw = Span("x", "t", "s", None, 0.0, 1.0)      # bypasses the seam
     return sp, raw
 """, 2),
+    "kernel-dispatch": ("rca_tpu/engine/bad_dispatch.py", """\
+from rca_tpu.engine.pallas_kernels import (
+    noisy_or_pair_pallas,
+    noisyor_autotune,
+)
+
+
+def tick(ft, w):
+    # re-deriving the kernel choice locally bypasses the registry seam
+    if noisyor_autotune() == "pallas":
+        return noisy_or_pair_pallas(ft, w, w)
+    return None
+""", 2),
 }
 
 
@@ -403,6 +416,15 @@ def handle(tracer, ctx, t0, t1):
     tracer.record("serve.queue", t0, t1, parent=ctx)
     tracer.event("serve.steal", t1, parent=ctx)
 """),
+        ("rca_tpu/engine/good_dispatch.py", """\
+from rca_tpu.engine.registry import autotune_path, engaged_kernel
+
+
+def tick(n_pad):
+    # the registry IS the seam: asking it is how a surface dispatches
+    use_pallas = engaged_kernel(n_pad) == "pallas"
+    return use_pallas, autotune_path()
+"""),
     )
     result = run_lint(root=root, use_baseline=False)
     assert result.clean, result.findings
@@ -521,13 +543,13 @@ def test_baseline_is_empty():
     assert load_baseline(default_baseline_path(ROOT)) == []
 
 
-def test_all_fourteen_rules_registered():
+def test_all_fifteen_rules_registered():
     assert set(all_rules()) == {
         "tick-sync", "swallowed-faults", "tracer-leak", "retrace-hazard",
         "rng-key-reuse", "lock-discipline", "env-discipline",
         "nondet-discipline", "resident-fetch", "race-guard",
         "lock-order", "thread-discipline", "no-dict-scan",
-        "span-discipline",
+        "span-discipline", "kernel-dispatch",
     }
     for rule in all_rules().values():
         assert rule.summary and rule.why

@@ -1,0 +1,502 @@
+"""kernelscope + kernel registry (ISSUE 12): the per-shape kernel table
+is the one dispatch seam (rows, autotune cache round trip, cost
+analysis), the recompile watchdog flags repeat-signature compiles and
+stays silent on clean paths (60-tick chaos soak at depth 2 included),
+the device-memory leak gate judges monotonic growth, and the telemetry
+reaches /metrics, tick health, the serve summary, and `rca kernels`."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rca_tpu.engine import registry as reg_mod
+from rca_tpu.engine.registry import (
+    KernelRegistry,
+    autotune_path,
+    engaged_kernel,
+    kernel_set_hash,
+    kernel_table,
+    reset_registry,
+)
+from rca_tpu.observability.kernelscope import (
+    DeviceMemoryAccountant,
+    RecompileMonitor,
+    leak_gate,
+    sample_device_memory,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    # rows are keyed by the RCA_PALLAS flag, but tests still start from
+    # a clean table so ordering cannot leak between them; the default
+    # file cache is disabled so no test writes under ~/.cache
+    monkeypatch.setenv("RCA_KERNEL_CACHE", "0")
+    reset_registry()
+    yield
+    reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# registry rows
+# ---------------------------------------------------------------------------
+
+def test_cpu_rows_default_to_xla_without_timing():
+    row = reg_mod.get_registry().resolve(128)
+    assert row.winner == "xla"
+    assert row.source == "cpu-default"
+    assert row.timings_ms == {}  # no interpreter timing on CPU hosts
+
+
+def test_forced_flag_and_sharded_rows(monkeypatch):
+    monkeypatch.setenv("RCA_PALLAS", "0")
+    row = reg_mod.get_registry().resolve(1024)
+    assert (row.winner, row.source) == ("xla", "forced")
+    sharded = reg_mod.get_registry().resolve(2048, sharded=True)
+    assert (sharded.winner, sharded.source) == ("xla", "sharded")
+    assert "shard_map" in sharded.eligible["pallas"]
+
+
+def test_engaged_kernel_matches_table_by_construction():
+    for n_pad in (64, 256, 2048):
+        engaged_kernel(n_pad)
+    rows = {r["n_pad"]: r["winner"] for r in kernel_table()
+            if r["variant"] == "dense"}
+    for n_pad, winner in rows.items():
+        assert engaged_kernel(n_pad) == winner
+
+
+def test_autotune_shims_delegate_to_registry():
+    from rca_tpu.engine import pallas_kernels as pk
+
+    assert autotune_path() == "xla"            # CPU short-circuit
+    assert pk.noisyor_autotune() == "xla"      # legacy shim
+    assert pk.noisyor_path() == "xla"
+
+
+def test_cost_analysis_captured_at_compile_time():
+    reg = reg_mod.get_registry()
+    row = reg.ensure_cost(reg.resolve(64))
+    assert row.cost is not None
+    assert row.cost["flops"] > 0
+    assert row.cost["bytes_accessed"] > 0
+    assert row.cost["peak_temp_bytes"] > 0
+    assert row.cost["output_bytes"] > 0
+
+
+def test_table_cost_cap_bounds_compiles():
+    reg = reg_mod.get_registry()
+    reg.resolve(64)
+    reg.resolve(8192)
+    rows = {r["n_pad"]: r for r in reg.table(ensure_cost=True,
+                                             cost_max_pad=128)}
+    assert rows[64]["cost"] is not None
+    assert rows[8192]["cost"] is None  # above the cap: winner only
+
+
+# ---------------------------------------------------------------------------
+# autotune file cache: round trip, corrupt, stale, disabled
+# ---------------------------------------------------------------------------
+
+def _accelerated(monkeypatch, timings):
+    """Pretend this host is an accelerator so the timed path runs."""
+    from rca_tpu.engine import pallas_kernels as pk
+
+    monkeypatch.setattr(reg_mod, "_backend", lambda: "tpu")
+    monkeypatch.setattr(pk, "pallas_supported", lambda: True)
+    calls = {"n": 0}
+
+    def fake_time(n_pad, reps=200):
+        calls["n"] += 1
+        return dict(timings)
+
+    monkeypatch.setattr(reg_mod, "_time_candidates", fake_time)
+    return calls
+
+
+def test_timed_winner_persists_and_reloads(tmp_path, monkeypatch):
+    cache = str(tmp_path / "kernels.json")
+    calls = _accelerated(monkeypatch, {"xla": 1.0, "pallas": 0.5})
+    reg = KernelRegistry(cache_path=cache)
+    row = reg.resolve(1024)
+    assert (row.winner, row.source) == ("pallas", "timed")
+    assert calls["n"] == 1
+    assert os.path.exists(cache)
+    # a fresh registry (a restart) reads the cache instead of re-timing
+    reg2 = KernelRegistry(cache_path=cache)
+    row2 = reg2.resolve(1024)
+    assert (row2.winner, row2.source) == ("pallas", "cache")
+    assert row2.timings_ms == {"xla": 1.0, "pallas": 0.5}
+    assert calls["n"] == 1  # no second timing
+
+
+def test_ties_and_unmeasurable_candidates_go_to_xla(tmp_path, monkeypatch):
+    _accelerated(monkeypatch, {"xla": 1.0, "pallas": 0.99})
+    reg = KernelRegistry(cache_path=str(tmp_path / "k.json"))
+    assert reg.resolve(1024).winner == "xla"   # within 5%: tie → xla
+    _accelerated(monkeypatch, {"xla": 1.0, "pallas": None})
+    reg2 = KernelRegistry(cache_path=None)
+    assert reg2.resolve(2048).winner == "xla"  # cannot time → cannot win
+
+
+def test_corrupt_cache_retimes_instead_of_crashing(tmp_path, monkeypatch):
+    cache = tmp_path / "kernels.json"
+    cache.write_text("{not json at all")
+    calls = _accelerated(monkeypatch, {"xla": 1.0, "pallas": 0.5})
+    reg = KernelRegistry(cache_path=str(cache))
+    row = reg.resolve(1024)
+    assert (row.winner, row.source) == ("pallas", "timed")
+    assert calls["n"] == 1
+    # and the rewrite leaves a VALID cache behind
+    data = json.loads(cache.read_text())
+    assert data["kernel_set"] == kernel_set_hash()
+
+
+def test_stale_cache_header_retimes(tmp_path, monkeypatch):
+    import jax
+
+    cache = tmp_path / "kernels.json"
+    cache.write_text(json.dumps({
+        "version": 1, "jax": jax.__version__,
+        "kernel_set": "deadbeef00000000",   # a different kernel set
+        "rows": {"dense:1024:tpu": {"winner": "pallas",
+                                    "timings_ms": {}}},
+    }))
+    calls = _accelerated(monkeypatch, {"xla": 0.4, "pallas": 1.0})
+    reg = KernelRegistry(cache_path=str(cache))
+    row = reg.resolve(1024)
+    # the stale pallas verdict was ignored; fresh timing picked xla
+    assert (row.winner, row.source) == ("xla", "timed")
+    assert calls["n"] == 1
+
+
+def test_cache_disabled_writes_nothing(tmp_path, monkeypatch):
+    _accelerated(monkeypatch, {"xla": 1.0, "pallas": 0.5})
+    reg = KernelRegistry(cache_path=None)
+    assert reg.resolve(1024).source == "timed"
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_kernel_cache_path_accessor(monkeypatch):
+    from rca_tpu.config import kernel_cache_path
+
+    monkeypatch.setenv("RCA_KERNEL_CACHE", "0")
+    assert kernel_cache_path() is None
+    monkeypatch.setenv("RCA_KERNEL_CACHE", "off")
+    assert kernel_cache_path() is None
+    monkeypatch.setenv("RCA_KERNEL_CACHE", "/tmp/x.json")
+    assert kernel_cache_path() == "/tmp/x.json"
+    monkeypatch.delenv("RCA_KERNEL_CACHE")
+    assert kernel_cache_path().endswith("kernel_cache.json")
+
+
+# ---------------------------------------------------------------------------
+# recompile watchdog
+# ---------------------------------------------------------------------------
+
+def test_monitor_clean_path_counts_zero_recompiles():
+    import jax
+    import jax.numpy as jnp
+
+    with RecompileMonitor(enabled=True) as mon:
+        @jax.jit
+        def f(x):
+            return x * 2.0
+
+        f(jnp.ones(4))
+        f(jnp.ones(4))          # jit cache hit: no compile event
+        mon.mark_warm()
+        f(jnp.ones(16))         # fresh shape tier: fresh, NOT a recompile
+        snap = mon.snapshot()
+    assert snap["recompiles"] == 0
+    assert snap["recompiles_post_warm"] == 0
+    assert snap["compiles"] >= 1
+
+
+def test_monitor_flags_retrace_hazardous_fixture():
+    import jax
+    import jax.numpy as jnp
+
+    def hazardous(x):
+        # a fresh jit wrapper per call: same signature compiled twice —
+        # the cache-key-drift class tracecheck's 2-call probe models
+        return jax.jit(lambda v: v * 3.0)(x)
+
+    with RecompileMonitor(enabled=True) as mon:
+        hazardous(jnp.ones(4))
+        mon.mark_warm()
+        hazardous(jnp.ones(4))
+        hazardous(jnp.ones(4))
+        snap = mon.snapshot()
+    assert snap["recompiles"] >= 2
+    assert snap["recompiles_post_warm"] >= 2
+    assert "<lambda>" in snap["recompiled"]
+
+
+def test_monitor_ignores_scalar_constant_compiles():
+    import jax.numpy as jnp
+
+    with RecompileMonitor(enabled=True) as mon:
+        # eager constant creation logs identical scalar-only signatures
+        # for DIFFERENT output shapes (statics are elided from the log);
+        # they must not read as recompiles
+        jnp.ones(3)
+        jnp.ones(5)
+        jnp.ones(7)
+        snap = mon.snapshot()
+    assert snap["recompiles"] == 0
+
+
+def test_monitor_disabled_is_a_noop(monkeypatch):
+    monkeypatch.setenv("RCA_KERNELSCOPE", "0")
+    mon = RecompileMonitor().start()
+    assert mon.snapshot() == {
+        "enabled": False, "compiles": 0, "recompiles": 0,
+        "recompiles_post_warm": 0, "recompiled": [],
+    }
+    mon.stop()
+
+
+# ---------------------------------------------------------------------------
+# device-memory accountant + leak gate
+# ---------------------------------------------------------------------------
+
+def test_sample_device_memory_sees_live_buffers():
+    import jax.numpy as jnp
+
+    held = jnp.ones((4096,), jnp.float32) * 2.0
+    sample = sample_device_memory()
+    assert sample["live_buffers"] >= 1
+    assert sample["live_bytes"] >= held.nbytes
+    assert sample["bytes_in_use"] >= 0
+
+
+def test_leak_gate_semantics():
+    assert leak_gate([100, 200, 150, 150])["ok"]          # dips: fine
+    assert leak_gate([100, 100, 100, 100])["ok"]          # flat: fine
+    bad = leak_gate([0, 1 << 21, 1 << 22, 1 << 23])
+    assert not bad["ok"] and bad["monotonic_growth"]
+    # monotonic but within slack: a plateau with rounding noise passes
+    assert leak_gate([100, 101, 102, 103])["ok"]
+    assert leak_gate([5, 6])["ok"]                        # too few samples
+
+
+def test_accountant_cadence_and_gate():
+    acc = DeviceMemoryAccountant(sample_every=3, enabled=True)
+    for tick in range(1, 10):
+        acc.maybe_sample(tick)
+    assert acc.samples_taken == 3          # ticks 3, 6, 9
+    assert acc.gate()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# integration: chaos soak, serve plane, /metrics, health records, CLI
+# ---------------------------------------------------------------------------
+
+def test_chaos_soak_60_ticks_depth2_zero_recompiles_and_memory_gate():
+    """ISSUE 12 acceptance: the watchdog reports ZERO post-warmup
+    recompiles across a 60-tick chaos soak at pipeline depth 2 (the
+    drift tracecheck's 2-call probe cannot see), and the device-memory
+    leak gate passes."""
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.resilience.chaos import ChaosConfig, run_chaos_soak
+
+    summary = run_chaos_soak(
+        lambda: synthetic_cascade_world(20, n_roots=1, seed=11),
+        "synthetic", seed=11, ticks=60, k=5,
+        config=ChaosConfig(seed=11), pipeline_depth=2,
+    )
+    assert summary["uncaught_exceptions"] == 0
+    scope = summary["kernelscope"]
+    assert scope["enabled"]
+    assert scope["recompiles_post_warm"] == 0, scope
+    assert scope["memory_samples"] >= 3
+    assert scope["memory_gate"]["ok"], scope["memory_gate"]
+
+
+def test_tick_health_carries_kernelscope(monkeypatch):
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+
+    monkeypatch.setenv("RCA_MEM_SAMPLE_EVERY", "1")
+    live = LiveStreamingSession(
+        MockClusterClient(synthetic_cascade_world(10, n_roots=1, seed=3)),
+        "synthetic", k=3,
+    )
+    out = live.poll()
+    scope = out["health"]["kernelscope"]
+    assert scope["recompiles"] == 0
+    assert scope["compiles"] >= 0
+    assert scope["device_memory"]["live_buffers"] >= 1
+
+
+def test_serve_loop_kernelscope_summary():
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.config import ServeConfig
+    from rca_tpu.engine.runner import GraphEngine
+    from rca_tpu.serve import ServeClient, ServeLoop
+
+    case = synthetic_cascade_arrays(24, n_roots=1, seed=0)
+    loop = ServeLoop(engine=GraphEngine(),
+                     config=ServeConfig(max_batch=4), kernelscope=True)
+    with loop:
+        client = ServeClient(loop)
+        resp = client.submit(case.features, case.dep_src, case.dep_dst,
+                             names=case.names, k=3).result(120.0)
+        assert resp.ok
+        scope = loop.kernelscope_summary()
+    assert scope["enabled"]
+    assert scope["recompiles"] == 0
+    assert scope["device_memory"]["bytes_in_use"] >= 0
+    # the served shape's registry row is in the table the summary exports
+    pads = {r["n_pad"] for r in scope["kernel_registry"]}
+    assert any(p >= 24 for p in pads)
+
+
+def test_serve_loop_kernelscope_disabled():
+    from rca_tpu.engine.runner import GraphEngine
+    from rca_tpu.serve import ServeLoop
+
+    loop = ServeLoop(engine=GraphEngine(), kernelscope=False)
+    with loop:
+        scope = loop.kernelscope_summary()
+    assert not scope["enabled"]
+    assert scope["device_memory"] is None
+
+
+def test_metrics_exposition_renders_kernelscope():
+    from rca_tpu.gateway.export import render_metrics_text
+
+    text = render_metrics_text(
+        {"tenants": {}},
+        kernelscope={
+            "enabled": True, "compiles": 7, "recompiles": 1,
+            "device_memory": {
+                "bytes_in_use": 4096, "live_buffers": 3,
+                "devices": {"0": {"bytes_in_use": 4096,
+                                  "peak_bytes_in_use": 8192}},
+            },
+            "kernel_registry": [{
+                "variant": "dense", "n_pad": 128, "backend": "cpu",
+                "winner": "xla", "source": "cpu-default",
+                "cost": {"flops": 38750.0, "bytes_accessed": 92510.0,
+                         "peak_temp_bytes": 5168},
+            }],
+        },
+        now_ms=1234,
+    )
+    assert "rca_recompiles_total 1" in text
+    assert "rca_compiles_total 7" in text
+    assert 'rca_device_bytes_in_use{device="0"} 4096 1234' in text
+    assert ('rca_kernel_winner_info{kernel="xla",n_pad="128",'
+            'source="cpu-default",variant="dense"} 1 1234') in text
+    assert ('rca_kernel_cost_flops{n_pad="128",variant="dense"} '
+            "38750.0 1234") in text
+    assert ('rca_kernel_peak_temp_bytes{n_pad="128",variant="dense"} '
+            "5168 1234") in text
+
+
+def test_kernels_cli_table_and_json(capsys):
+    from rca_tpu.cli import main as cli_main
+
+    rc = cli_main(["kernels", "--services", "30", "--no-cost"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "n_pad" in out and "winner" in out and "xla" in out
+    rc = cli_main(["kernels", "--services", "30", "--json", "--compact"])
+    assert rc == 0
+    rows = json.loads(capsys.readouterr().out)["rows"]
+    assert any(r["n_pad"] >= 30 and r["winner"] == "xla" for r in rows)
+
+
+def test_kernels_cli_cost_capture(capsys):
+    from rca_tpu.cli import main as cli_main
+
+    rc = cli_main(["kernels", "--services", "20", "--json", "--compact"])
+    assert rc == 0
+    rows = json.loads(capsys.readouterr().out)["rows"]
+    small = [r for r in rows if r["n_pad"] <= 4096 and r["cost"]]
+    assert small and small[0]["cost"]["flops"] > 0
+
+
+# ---------------------------------------------------------------------------
+# bench_guard (CI/tooling satellite)
+# ---------------------------------------------------------------------------
+
+def _guard():
+    import importlib
+    import sys
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        return importlib.import_module("bench_guard")
+    finally:
+        sys.path.remove(tools)
+
+
+GOOD_LINE = {
+    "tick_ms_10k": 10.0,
+    "serve_throughput_2k": {"request_ms_p50": 70.0},
+    "live_sweep_capture_ms_10k": 80.0,
+}
+
+
+def test_bench_guard_passes_within_threshold(tmp_path):
+    bg = _guard()
+    current = {**GOOD_LINE, "tick_ms_10k": 11.0}   # +10% < 15%
+    report = bg.compare(current, GOOD_LINE)
+    assert report["ok"]
+    assert report["metrics"]["tick_ms_10k"]["status"] == "ok"
+
+
+def test_bench_guard_fails_on_regression():
+    bg = _guard()
+    current = {**GOOD_LINE,
+               "serve_throughput_2k": {"request_ms_p50": 90.0}}  # +28%
+    report = bg.compare(current, GOOD_LINE)
+    assert not report["ok"]
+    rec = report["metrics"]["serve_request_ms_p50"]
+    assert rec["status"] == "regressed" and rec["change_pct"] > 15
+
+
+def test_bench_guard_skips_missing_metrics():
+    bg = _guard()
+    report = bg.compare({"tick_ms_10k": 10.0}, {"tick_ms_10k": 10.0})
+    assert report["ok"]
+    assert (report["metrics"]["serve_request_ms_p50"]["status"]
+            == "skipped")
+
+
+def test_bench_guard_picks_highest_round_and_unwraps(tmp_path):
+    bg = _guard()
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(GOOD_LINE))
+    (tmp_path / "BENCH_r11.json").write_text(json.dumps(
+        {"cmd": "x", "rc": 0,
+         "parsed": {**GOOD_LINE, "tick_ms_10k": 20.0}}
+    ))
+    (tmp_path / "BENCH_r12.json").write_text("{corrupt")  # skipped
+    name, baseline = bg.latest_baseline(str(tmp_path))
+    assert name == "BENCH_r11.json"
+    assert baseline["tick_ms_10k"] == 20.0
+
+
+def test_bench_guard_main_exit_codes(tmp_path):
+    bg = _guard()
+    cur = tmp_path / "line.json"
+    cur.write_text(json.dumps({**GOOD_LINE, "tick_ms_10k": 30.0}))
+    base = tmp_path / "BENCH_r01.json"
+    base.write_text(json.dumps(GOOD_LINE))
+    assert bg.main([str(cur), "--baseline", str(base)]) == 1  # 3x tick
+    cur.write_text(json.dumps(GOOD_LINE))
+    assert bg.main([str(cur), "--baseline", str(base)]) == 0
+    # no baseline found: informational pass, never a failure
+    assert bg.main([str(cur), "--root", str(tmp_path / "empty")]) == 0
+    # unreadable current line: usage error
+    assert bg.main([str(tmp_path / "missing.json")]) == 2

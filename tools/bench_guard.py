@@ -1,0 +1,192 @@
+"""bench_guard: fail the bench post-step on headline regressions.
+
+ISSUE 12 satellite (CI/tooling): every PR re-runs ``bench.py``, but
+nothing compared the new line against the repo's recorded history — a
+15% tick-latency regression ships silently as long as the line still
+prints.  This tool is the gate: it takes the CURRENT bench line (a file,
+or ``-`` for stdin) and the LAST ``BENCH_r*.json`` committed to the repo
+root, and exits nonzero when any named headline metric regressed by more
+than ``--threshold`` (default 15%).
+
+Headline metrics (all lower-is-better milliseconds):
+
+- ``tick_ms_10k``                       — streaming tick p50 at 10k
+- ``serve_throughput_2k.request_ms_p50`` — closed-loop serve p50
+- ``live_sweep_capture_ms_10k``         — the capture sweep
+
+Metrics missing on either side are reported and SKIPPED, never failed:
+older rounds predate newer sections, and a bench run on different
+hardware is the operator's judgment call (the report prints both
+values so the call is informed).  Baseline files may be a raw bench
+line or the driver's wrapper (``{"parsed": <line>, ...}``).
+
+Usage::
+
+    python bench.py --skip-accuracy > line.json
+    python tools/bench_guard.py line.json            # exit 1 on regression
+    python bench.py --skip-accuracy --guard          # same, as one step
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+#: metric name -> key path into the bench line (all lower-is-better ms)
+HEADLINE_METRICS = {
+    "tick_ms_10k": ("tick_ms_10k",),
+    "serve_request_ms_p50": ("serve_throughput_2k", "request_ms_p50"),
+    "live_sweep_capture_ms_10k": ("live_sweep_capture_ms_10k",),
+}
+
+DEFAULT_THRESHOLD = 0.15
+
+_BENCH_FILE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _dig(line: Dict[str, Any], path: Tuple[str, ...]):
+    node: Any = line
+    for key in path:
+        if not isinstance(node, dict):
+            return None
+        node = node.get(key)
+    return node if isinstance(node, (int, float)) else None
+
+
+def _as_line(data: Any) -> Optional[Dict[str, Any]]:
+    """A bench line from either a raw line or a driver wrapper."""
+    if not isinstance(data, dict):
+        return None
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    if any(_dig(data, p) is not None for p in HEADLINE_METRICS.values()):
+        return data
+    return None
+
+
+def latest_baseline(root: str) -> Tuple[Optional[str], Optional[Dict]]:
+    """The newest parseable ``BENCH_r*.json`` under ``root`` (highest
+    round number wins; unparseable or metric-free files are skipped —
+    the guard compares against history, it does not validate it)."""
+    candidates = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _BENCH_FILE.search(os.path.basename(path))
+        if m:
+            candidates.append((int(m.group(1)), path))
+    for _, path in sorted(candidates, reverse=True):
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        line = _as_line(data)
+        if line is not None:
+            return os.path.basename(path), line
+    return None, None
+
+
+def compare(current: Dict[str, Any], baseline: Dict[str, Any],
+            threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
+    """Per-metric regression report.  ``ok`` is False iff any headline
+    metric is more than ``threshold`` WORSE (higher) than baseline."""
+    metrics: Dict[str, Dict[str, Any]] = {}
+    ok = True
+    for name, path in HEADLINE_METRICS.items():
+        cur = _dig(current, path)
+        base = _dig(baseline, path)
+        if cur is None or base is None or base <= 0:
+            metrics[name] = {
+                "status": "skipped",
+                "current": cur,
+                "baseline": base,
+                "reason": "metric missing on one side",
+            }
+            continue
+        change = (float(cur) - float(base)) / float(base)
+        regressed = change > threshold
+        if regressed:
+            ok = False
+        metrics[name] = {
+            "status": "regressed" if regressed else "ok",
+            "current": round(float(cur), 3),
+            "baseline": round(float(base), 3),
+            "change_pct": round(change * 100.0, 1),
+        }
+    return {
+        "ok": ok,
+        "threshold_pct": round(threshold * 100.0, 1),
+        "metrics": metrics,
+    }
+
+
+def check_line(current: Dict[str, Any], root: str,
+               threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
+    """The full post-step: find the last committed round and compare.
+    No parseable baseline = an informational pass (first round on a
+    fresh repo must not fail its own gate)."""
+    name, baseline = latest_baseline(root)
+    if baseline is None:
+        return {"ok": True, "baseline": None,
+                "reason": "no parseable BENCH_r*.json baseline"}
+    report = compare(current, baseline, threshold=threshold)
+    report["baseline"] = name
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_guard",
+        description="compare a bench line against the last BENCH_r*.json"
+    )
+    parser.add_argument("current",
+                        help="path to the current bench line JSON, or - "
+                        "for stdin")
+    parser.add_argument("--baseline", default=None,
+                        help="explicit baseline file (default: highest "
+                        "BENCH_r*.json under --root)")
+    parser.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    parser.add_argument("--threshold", type=float,
+                        default=DEFAULT_THRESHOLD,
+                        help="fractional regression gate (default 0.15)")
+    args = parser.parse_args(argv)
+    try:
+        if args.current == "-":
+            data = json.load(sys.stdin)
+        else:
+            with open(args.current, encoding="utf-8") as f:
+                data = json.load(f)
+    except (json.JSONDecodeError, OSError) as exc:
+        print(json.dumps({"error": f"cannot read current line: {exc}"}))
+        return 2
+    current = _as_line(data)
+    if current is None:
+        print(json.dumps({"error": "current file carries no headline "
+                          "metrics"}))
+        return 2
+    if args.baseline:
+        try:
+            with open(args.baseline, encoding="utf-8") as f:
+                baseline = _as_line(json.load(f))
+        except (json.JSONDecodeError, OSError) as exc:
+            print(json.dumps({"error": f"cannot read baseline: {exc}"}))
+            return 2
+        if baseline is None:
+            print(json.dumps({"error": "baseline carries no headline "
+                              "metrics"}))
+            return 2
+        report = compare(current, baseline, threshold=args.threshold)
+        report["baseline"] = args.baseline
+    else:
+        report = check_line(current, args.root, threshold=args.threshold)
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
