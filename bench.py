@@ -1864,24 +1864,116 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
             "adversarial", fault_mix="mixed"
         )
 
+    # -- quantized rank-parity gate (ISSUE 13): the landing gate for the
+    # int8-message kernel is RANK parity, not bit parity — hit@1/hit@3
+    # must MATCH the f32 path across the accuracy modes and the top-k
+    # order must hold Kendall-tau >= 0.99.  Runs with the accuracy suite
+    # (same --skip-accuracy economics).
+    if skip_accuracy:
+        quant_parity = None
+    else:
+        from rca_tpu.engine.quantized import topk_score_tau
+
+        parity_modes = ("standard", "crashing_victims", "missing_signals",
+                        "correlated_noise", "overlapping_roots",
+                        "adversarial")
+        q_trials, q_n = 8, 300
+        f32_orders = {}
+        for mode in parity_modes:
+            for seed in range(q_trials):
+                c = synthetic_cascade_arrays(
+                    q_n, n_roots=1, seed=2000 + seed, mode=mode,
+                )
+                res = engine.analyze_case(c, k=5)
+                f32_orders[(mode, seed)] = (
+                    res.score, set(c.roots.tolist())
+                )
+        prev_kernel = os.environ.get("RCA_KERNEL")
+        os.environ["RCA_KERNEL"] = "quantized"
+        try:
+            # rows are keyed by the env flag, so the fresh engine's
+            # sessions resolve quantized; `engine`'s pinned sessions
+            # keep their f32 plans
+            q_engine = GraphEngine()
+            quant_parity = {"kernel": "quantized", "modes": {}, "ok": True}
+            taus_all = []
+            for mode in parity_modes:
+                h1 = [0, 0]
+                h3 = [0, 0]
+                taus = []
+                for seed in range(q_trials):
+                    c = synthetic_cascade_arrays(
+                        q_n, n_roots=1, seed=2000 + seed, mode=mode,
+                    )
+                    q_score = q_engine.analyze_case(c, k=5).score
+                    q_order = np.argsort(-q_score)[:3].tolist()
+                    f_score, roots = f32_orders[(mode, seed)]
+                    f_order = np.argsort(-f_score)[:3].tolist()
+                    h1[0] += f_order[0] in roots
+                    h1[1] += q_order[0] in roots
+                    h3[0] += bool(roots & set(f_order))
+                    h3[1] += bool(roots & set(q_order))
+                    # tie-aware tau over the top-25 (engine/quantized.py:
+                    # sub-int8-step background near-ties carry no rank
+                    # signal; separated pairs must keep their order)
+                    taus.append(topk_score_tau(f_score, q_score))
+                taus_all.extend(taus)
+                quant_parity["modes"][mode] = {
+                    "hit1_f32": round(h1[0] / q_trials, 3),
+                    "hit1_quantized": round(h1[1] / q_trials, 3),
+                    "hit3_f32": round(h3[0] / q_trials, 3),
+                    "hit3_quantized": round(h3[1] / q_trials, 3),
+                    "kendall_tau_min": round(min(taus), 4),
+                }
+                if h1[0] != h1[1] or h3[0] != h3[1]:
+                    quant_parity["ok"] = False
+            quant_parity["kendall_tau_min"] = round(min(taus_all), 4)
+            quant_parity["kendall_tau_floor"] = 0.99
+            if quant_parity["kendall_tau_min"] < 0.99:
+                quant_parity["ok"] = False
+        finally:
+            if prev_kernel is None:
+                os.environ.pop("RCA_KERNEL", None)
+            else:
+                os.environ["RCA_KERNEL"] = prev_kernel
+
     def r(x, nd=4):
         """Round, passing through None (= honestly unmeasured)."""
         return round(x, nd) if x is not None else None
 
-    # per-shape kernel registry (ISSUE 12): resolve the rows this round
-    # exercised, capture the winner executables' XLA cost analysis for
-    # the shapes under the compile cap, and derive BOTH kernel_by_shape
-    # and the kernel_registry section from the one table — agreement by
+    # per-shape kernel registry (ISSUE 12/13): resolve the rows this
+    # round exercised — WITH their edge tiers, so the edge-layout
+    # kernels (segscan/quantized/doubling) show eligibility per row —
+    # capture the winner executables' XLA cost analysis for the shapes
+    # under the compile cap, and derive BOTH kernel_by_shape and the
+    # kernel_registry section from the one table — agreement by
     # construction (the old parallel engaged_kernel bookkeeping is gone)
     from rca_tpu.engine.registry import kernel_table
 
-    for _n in (n_services, 10_000, 50_000):
-        engaged_kernel(bucket_for(_n + 1, RCAConfig().shape_buckets))
+    _buckets = RCAConfig().shape_buckets
+    for _n, _e in ((n_services, result.n_edges),
+                   (10_000, len(sk.dep_src)),
+                   (50_000, len(big.dep_src))):
+        engaged_kernel(bucket_for(_n + 1, _buckets),
+                       bucket_for(max(_e, 1), _buckets))
     kernel_rows = kernel_table(ensure_cost=True, cost_max_pad=4096)
     kernel_by_shape = {
         str(row["n_pad"]): row["winner"]
         for row in kernel_rows if row["variant"] == "dense"
     }
+
+    # registry kernel A/B (ISSUE 13 satellite): the full chain under
+    # every KERNELS member at the 2k tier — interpret-honest (the
+    # section stamps backend + whether Pallas ran interpreted; CPU-host
+    # numbers prove mechanics, the real-TPU round stamps speed)
+    _tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    sys.path.insert(0, _tools_dir)
+    try:
+        from downscan_bench import registry_kernel_ab
+    finally:
+        sys.path.remove(_tools_dir)
+    kernel_ab = registry_kernel_ab(tiers=(2_000,))
 
     target_ms = 150.0
     line = {
@@ -1961,6 +2053,9 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         # eligibility, FLOPs/bytes/peak-memory from XLA cost analysis)
         "kernel_by_shape": kernel_by_shape,
         "kernel_registry": kernel_rows,
+        # full-chain A/B of every registry kernel at the 2k tier
+        # (ISSUE 13; tools/downscan_bench.py --ab prints bigger tiers)
+        "kernel_ab": kernel_ab,
         "xla_noisyor_50k_ms": r(xla_nor_ms),
         "pallas_noisyor_50k_ms": r(pallas_nor_ms),
         # flight recorder: record overhead, log size, replay throughput
@@ -1972,6 +2067,10 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
     }
     if accuracy is not None:
         line["accuracy_by_mode"] = accuracy
+    if quant_parity is not None:
+        # the quantized kernel's landing gate (ISSUE 13): rank parity
+        # vs f32 — hit@1/hit@3 equal, Kendall-tau >= 0.99 on top-k
+        line["quantized_rank_parity"] = quant_parity
     if with_chaos:
         line["chaos_soak_50svc"] = chaos_metrics(
             seed=int(os.environ.get("RCA_CHAOS_SEED", "7"))

@@ -24,13 +24,28 @@ from typing import List
 
 from rca_tpu.analysis.core import FileContext, Finding, Rule, register
 
-#: call targets that constitute bypassing the registry seam
+#: call targets that constitute bypassing the registry seam (ISSUE 13
+#: satellite: the quantized and doubling kernel bodies, and segscan's
+#: layout gate, are seam-guarded exactly like the Pallas/XLA pair —
+#: bypassing the seam in a NEW module is as unlandable as in an old one)
 TARGETS = frozenset({
     "noisy_or_pair_pallas",
     "noisy_or_pair_xla",
     "propagate_core",
     "noisyor_autotune",
     "noisyor_path",
+    # segscan engagement + assembly (registry-resident since ISSUE 13)
+    "seg_layouts_for",
+    "build_seg_layouts",
+    # quantized kernel bodies (engine/quantized.py)
+    "noisy_or_pair_bf16",
+    "quant_up_step",
+    "quant_imp_step",
+    # doubling kernel bodies + layout gate (engine/doubling.py)
+    "doubling_up",
+    "doubling_down",
+    "doubling_layouts_for",
+    "build_doubling",
 })
 
 #: files that ARE the seam (definitions + the registry's own timing/cost)
@@ -38,6 +53,9 @@ ALLOWED_FILES = frozenset({
     "rca_tpu/engine/registry.py",
     "rca_tpu/engine/pallas_kernels.py",
     "rca_tpu/engine/propagate.py",
+    "rca_tpu/engine/segscan.py",
+    "rca_tpu/engine/quantized.py",
+    "rca_tpu/engine/doubling.py",
 })
 
 MESSAGE = (
@@ -61,12 +79,14 @@ class KernelDispatchRule(Rule):
            "bit-parity contract the serve/streaming/resident surfaces "
            "rely on — the exact drift ISSUE 12's refactor removed")
     # the ONE traced evidence branch every executable shares (the
-    # pallas-vs-XLA dispatch lives there by design — runner.py
-    # docstring), and the training loss's differentiable forward (it
-    # fits weights THROUGH the core; it never serves a request, so no
-    # kernel choice can drift from it)
+    # per-kernel dispatch lives there by design — runner.py docstring),
+    # the one per-graph layout-assembly step beside it (kernel_plan asks
+    # the registry FIRST, then builds the winner's layouts), and the
+    # training loss's differentiable forward (it fits weights THROUGH
+    # the core; it never serves a request, so no kernel choice can
+    # drift from it)
     allow = {
-        "rca_tpu/engine/runner.py": {"propagate_auto"},
+        "rca_tpu/engine/runner.py": {"propagate_auto", "kernel_plan"},
         "rca_tpu/engine/train.py": {"_forward"},
     }
 

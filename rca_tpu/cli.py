@@ -752,6 +752,8 @@ def cmd_replay(args) -> int:
         report = replay_stream(
             path, engine=engine, pipeline_depth=args.pipeline_depth,
             seek=args.seek, ticks=args.ticks,
+            parity="rank" if getattr(args, "rank_parity", False)
+            else "exact",
         )
     print(json.dumps(report, indent=None if args.compact else 2,
                      default=str))
@@ -776,30 +778,43 @@ def cmd_profile(args) -> int:
 
 
 def cmd_kernels(args) -> int:
-    """``rca kernels`` (ISSUE 12): the live per-shape kernel registry as
-    a table — one row per ``(variant, n_pad, backend)`` with the engaged
-    kernel, WHY it won, the autotune timings, and the winner
+    """``rca kernels`` (ISSUE 12/13): the live per-shape kernel registry
+    as a table — one row per ``(variant, n_pad, e_pad, backend)`` with
+    the engaged kernel, WHY it won, the autotune timings, and the winner
     executable's XLA cost analysis (FLOPs / bytes accessed / peak temp
-    and output memory).  ``--services`` resolves rows for those graph
-    sizes first (a fresh process has only what its sessions asked
-    about); cost capture compiles the canonical executable per shape, so
-    ``--no-cost`` skips it and ``--cost-max-pad`` bounds it."""
+    and output memory).  ``--services`` (paired with ``--edges``)
+    resolves rows for those graph sizes first (a fresh process has only
+    what its sessions asked about); ``--explain`` prints the full
+    candidate set per shape — the eligibility reason each declined
+    kernel never raced with, or the timing it lost with; cost capture
+    compiles the canonical executable per shape, so ``--no-cost`` skips
+    it and ``--cost-max-pad`` bounds it."""
     from rca_tpu.config import RCAConfig, bucket_for
-    from rca_tpu.engine.registry import get_registry, kernel_table
+    from rca_tpu.engine.registry import KERNELS, get_registry, kernel_table
 
     reg = get_registry()
     buckets = RCAConfig().shape_buckets
-    for part in (args.services or "").split(","):
-        part = part.strip()
-        if not part:
-            continue
-        try:
-            n = int(part)
-        except ValueError:
-            raise SystemExit(
-                f"--services expects comma-separated ints, got {part!r}"
-            )
-        reg.resolve(bucket_for(n + 1, buckets))
+
+    def ints(raw, flag):
+        out = []
+        for part in (raw or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                out.append(int(part))
+            except ValueError:
+                raise SystemExit(
+                    f"{flag} expects comma-separated ints, got {part!r}"
+                )
+        return out
+
+    services = ints(args.services, "--services")
+    edges = ints(getattr(args, "edges", ""), "--edges")
+    for i, n in enumerate(services):
+        e = edges[i] if i < len(edges) else max(1, int(n * 2.5))
+        reg.resolve(bucket_for(n + 1, buckets),
+                    e_pad=bucket_for(e, buckets))
     rows = kernel_table(
         ensure_cost=not args.no_cost, cost_max_pad=args.cost_max_pad,
     )
@@ -815,17 +830,17 @@ def cmd_kernels(args) -> int:
             return f"{x:.4g}{unit}"
         return f"{x}{unit}"
 
-    cols = ("n_pad", "variant", "backend", "winner", "source",
-            "t_xla_ms", "t_pallas_ms", "flops", "bytes", "peak_temp",
+    cols = ("n_pad", "e_pad", "variant", "backend", "winner", "source",
+            "t_xla_ms", "t_win_ms", "flops", "bytes", "peak_temp",
             "output")
     table = [cols]
     for row in rows:
         cost = row.get("cost") or {}
         timings = row.get("timings_ms") or {}
         table.append((
-            str(row["n_pad"]), row["variant"], row["backend"],
-            row["winner"], row["source"],
-            fmt(timings.get("xla")), fmt(timings.get("pallas")),
+            str(row["n_pad"]), fmt(row.get("e_pad")), row["variant"],
+            row["backend"], row["winner"], row["source"],
+            fmt(timings.get("xla")), fmt(timings.get(row["winner"])),
             fmt(cost.get("flops")), fmt(cost.get("bytes_accessed")),
             fmt(cost.get("peak_temp_bytes")),
             fmt(cost.get("output_bytes")),
@@ -835,6 +850,39 @@ def cmd_kernels(args) -> int:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
         if i == 0:
             print("  ".join("-" * w for w in widths))
+    if getattr(args, "explain", False):
+        # the full candidate set per shape (ISSUE 13 satellite): the
+        # registry records every decision — ineligible candidates name
+        # their gate, timed losers show both timings
+        for row in rows:
+            shape = (f"{row['variant']} n_pad={row['n_pad']} "
+                     f"e_pad={fmt(row.get('e_pad'))}")
+            print(f"\n{shape}: winner={row['winner']} "
+                  f"({row['source']})")
+            timings = row.get("timings_ms") or {}
+            t_win = timings.get(row["winner"])
+            for k in KERNELS:
+                if k == row["winner"]:
+                    detail = "engaged"
+                    if t_win is not None:
+                        detail += f" ({t_win:.4g} ms)"
+                    print(f"  {k:10s} {detail}")
+                    continue
+                elig = (row.get("eligible") or {}).get(k)
+                if elig is not True and elig is not None:
+                    print(f"  {k:10s} ineligible: {elig}")
+                elif k in timings:
+                    t = timings[k]
+                    if t is None:
+                        print(f"  {k:10s} failed to time (cannot win)")
+                    elif t_win is not None:
+                        print(f"  {k:10s} lost the timing: {t:.4g} ms "
+                              f"vs {t_win:.4g} ms")
+                    else:
+                        print(f"  {k:10s} timed {t:.4g} ms")
+                else:
+                    print(f"  {k:10s} not raced "
+                          f"(decision source: {row['source']})")
     return 0
 
 
@@ -1180,6 +1228,11 @@ def build_parser() -> argparse.ArgumentParser:
                     "like-for-like)")
     sp.add_argument("--ticks", type=int, default=None,
                     help="replay only the first N ticks")
+    sp.add_argument("--rank-parity", action="store_true",
+                    dest="rank_parity",
+                    help="judge ticks by hit@1/hit@3 + Kendall-tau "
+                    "instead of bitwise digests (ISSUE 13: the gate "
+                    "mode that makes the quantized kernel replayable)")
     sp.add_argument("--investigation", default=None, metavar="ID",
                     help="resolve the recording from this stored "
                     "investigation's recording_ref")
@@ -1218,6 +1271,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated service counts whose shape "
                     "buckets to resolve before printing (default "
                     "500,2000)")
+    sp.add_argument("--edges", default="",
+                    help="comma-separated edge counts paired with "
+                    "--services (default: ~2.5 edges/service) — the "
+                    "edge tier gates the segscan/quantized/doubling "
+                    "candidates")
+    sp.add_argument("--explain", action="store_true",
+                    help="per shape, print WHY each non-winning kernel "
+                    "was declined: the eligibility reason, or the "
+                    "timing it lost with (ISSUE 13)")
     sp.add_argument("--no-cost", action="store_true", dest="no_cost",
                     help="skip XLA cost analysis (cost capture compiles "
                     "the canonical executable once per shape)")

@@ -1,0 +1,240 @@
+"""Log-depth operator doubling: the 8-step serial chain in ~3 applications.
+
+ROADMAP item 4(b), SNIPPETS.md retrieval goal ("cut the 50k
+scatter-bound propagation via log-depth operator doubling").  The
+propagation's two scans are SERIAL recursions of depth ``steps`` (8 by
+default): every step pays one E-sized gather and one E-sized scatter,
+and on tunneled TPUs the chain's latency is 8 round trips of exactly the
+traffic the edge-layout study measured as the bottleneck.  Both
+recursions admit doubling:
+
+- **up-scan (max semiring)** — ``u_K[s] = max over paths s->..->d of
+  length l<=K of y^(l-1) h[d]``.  With the EXACT-k-hop frontier ``A^k``
+  precomputed host-side, ``u_2k[s] = max(u_k[s], (y*)^k max over
+  A^k(s) of u_k)``, where ``(y*)^k`` is k SEQUENTIAL multiplies by the
+  decay.  Because fp32 max is order-invariant and every candidate value
+  is ``h`` left-multiplied by y exactly (l-1) times — the same operation
+  sequence the serial chain performs — the doubled up-scan is
+  **bit-identical** to the serial scan for ANY decay (property-tested).
+- **down-scan (affine map)** — one impact step is ``f(m) = y*W m + W
+  a_ex`` with ``W = D^-1 A^T``.  Doubling the affine map needs the
+  operator POWERS: with host-precomputed weighted frontier layouts for
+  ``W^(2^k)`` (edge lists whose weights aggregate the inv-degree
+  products over parallel paths), ``v_{k+1} = y^(2^k) * (W^(2^k) v_k) +
+  v_k`` reaches ``m_8`` in base + 3 applications.  Sums reassociate, so
+  this direction is allclose (~1e-6, same class as the segscan layout),
+  not bitwise — the parity tests assert exact up, tight-tolerance down,
+  and identical ranking.
+
+Cost model (why this is an eligibility hook, not a default): reaching
+depth 8 needs the 2/4-hop frontiers, whose size is graph-dependent —
+13.9x the edges at the 50k generator tier (tools/downscan_bench.py
+measured), but near-E on deep sparse chains, which is exactly where 8
+serial round trips hurt most.  The builder enforces ``MAX_FRONTIER_MULT``
+and declines (returns None) past it; the registry row records the
+reason, and the dispatch seam falls back to the serial path.
+
+Interpret/hermetic path: pure jax.numpy (gathers + scatters), so the
+CPU-host parity tests run the exact production math; forcing is
+``RCA_KERNEL=doubling``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+#: frontier blowup cap: a doubling layout whose total edge count exceeds
+#: this multiple of the padded edge tier is declined (hub-heavy graphs
+#: square into dense frontiers; the serial chain is cheaper there)
+MAX_FRONTIER_MULT = 16
+
+
+class DoublingLayout(NamedTuple):
+    """Device arrays for one padded graph's doubled operators.  Tuples of
+    per-level arrays (a static pytree structure, so the executable is
+    cached per level count like any other shape-bucket static):
+
+    - ``up_src/up_dst[k]``: the exact ``2^k``-hop dependency frontier
+      (pairs (s, e): e reachable from s in exactly ``2^k`` hops);
+    - ``dn_src/dn_dst/dn_w[k]``: the weighted edge list of ``W^(2^k)``
+      (down-scan operator power; weights aggregate inv-degree products
+      over parallel paths).
+
+    Level arrays are padded to power-of-two tiers with dummy self-loops
+    (weight 0), the same stable-shape discipline as every other layout.
+    """
+
+    up_src: Tuple[jnp.ndarray, ...]
+    up_dst: Tuple[jnp.ndarray, ...]
+    dn_src: Tuple[jnp.ndarray, ...]
+    dn_dst: Tuple[jnp.ndarray, ...]
+    dn_w: Tuple[jnp.ndarray, ...]
+
+
+def doubling_eligible(steps: int) -> bool:
+    """Structural gate: the doubled ladder reaches exactly ``steps``
+    only when it is a power of two (>= 2)."""
+    return steps >= 2 and (steps & (steps - 1)) == 0
+
+
+def _compose_pairs(src1, dst1, src2, dst2, n_pad: int, cap: int,
+                   w1=None, w2=None):
+    """Relational composition of two edge lists: pairs (s, e) with
+    s->x in (src1, dst1) and x->e in (src2, dst2), deduplicated; with
+    weights, parallel paths aggregate by sum (operator product).
+    Returns None when the pre-dedup join exceeds ``cap``."""
+    order = np.argsort(src2, kind="stable")
+    s2, d2 = src2[order], dst2[order]
+    w2s = w2[order] if w2 is not None else None
+    left = np.searchsorted(s2, dst1, "left")
+    right = np.searchsorted(s2, dst1, "right")
+    counts = right - left
+    total = int(counts.sum())
+    if total > cap:
+        return None
+    if total == 0:
+        empty = np.zeros(0, np.int32)
+        return (empty, empty, np.zeros(0, np.float32)) \
+            if w1 is not None else (empty, empty, None)
+    rep = np.repeat(np.arange(len(src1)), counts)
+    offs = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
+    idx2 = np.repeat(left, counts) + offs
+    out_s = src1[rep].astype(np.int64)
+    out_e = d2[idx2].astype(np.int64)
+    key = out_s * n_pad + out_e
+    if w1 is None:
+        uniq = np.unique(key)
+        return ((uniq // n_pad).astype(np.int32),
+                (uniq % n_pad).astype(np.int32), None)
+    uniq, inv = np.unique(key, return_inverse=True)
+    agg = np.zeros(len(uniq), np.float64)
+    np.add.at(agg, inv, (w1[rep] * w2s[idx2]).astype(np.float64))
+    return ((uniq // n_pad).astype(np.int32),
+            (uniq % n_pad).astype(np.int32), agg.astype(np.float32))
+
+
+def _pad_level(src, dst, n_pad: int, w=None):
+    """Pad one level to a power-of-two tier with dummy self-loops
+    (weight 0): stable shapes per tier, harmless contributions (max of a
+    zeroed row / add of 0)."""
+    e = max(1, len(src))
+    e_pad = 1 << (e - 1).bit_length()
+    dummy = n_pad - 1
+    s = np.full(e_pad, dummy, np.int32)
+    d = np.full(e_pad, dummy, np.int32)
+    s[: len(src)] = src
+    d[: len(dst)] = dst
+    if w is None:
+        return jnp.asarray(s), jnp.asarray(d), None
+    wv = np.zeros(e_pad, np.float32)
+    wv[: len(w)] = w
+    return jnp.asarray(s), jnp.asarray(d), jnp.asarray(wv)
+
+
+def build_doubling(n_pad: int, e_pad: int, dep_src, dep_dst,
+                   steps: int) -> Optional[DoublingLayout]:
+    """Host-side frontier construction for one padded graph, or None
+    when ineligible (non-power-of-two depth) or past the frontier cap.
+    Operates on the RAW edges; padded slots would only add dummy
+    self-loops that dedup away."""
+    if not doubling_eligible(steps):
+        return None
+    src = np.asarray(dep_src, np.int64)
+    dst = np.asarray(dep_dst, np.int64)
+    cap = MAX_FRONTIER_MULT * max(int(e_pad), 1)
+    # down-scan base weights: W[d, s] = inv_deg[d] per edge (s, d), with
+    # the degree counted exactly like the device path (real edges only —
+    # padded slots land on the dummy row the scoring ignores)
+    deg = np.bincount(dst, minlength=n_pad).astype(np.float32)
+    inv_deg = 1.0 / np.maximum(deg, 1.0)
+    levels = steps.bit_length() - 1        # steps = 2 ** levels
+    up_s, up_d = [src.astype(np.int32)], [dst.astype(np.int32)]
+    dn_s = [src.astype(np.int32)]
+    dn_d = [dst.astype(np.int32)]
+    dn_w = [inv_deg[dst].astype(np.float32)]
+    total = len(src)
+    for _ in range(1, levels):
+        nxt = _compose_pairs(up_s[-1], up_d[-1], up_s[-1], up_d[-1],
+                             n_pad, cap)
+        if nxt is None:
+            return None
+        up_s.append(nxt[0])
+        up_d.append(nxt[1])
+        wnxt = _compose_pairs(dn_s[-1], dn_d[-1], dn_s[-1], dn_d[-1],
+                              n_pad, cap, w1=dn_w[-1], w2=dn_w[-1])
+        if wnxt is None:
+            return None
+        dn_s.append(wnxt[0])
+        dn_d.append(wnxt[1])
+        dn_w.append(wnxt[2])
+        total += len(nxt[0]) + len(wnxt[0])
+        if total > cap:
+            return None
+    ups, upd = [], []
+    dns, dnd, dnw = [], [], []
+    for k in range(levels):
+        s, d, _ = _pad_level(up_s[k], up_d[k], n_pad)
+        ups.append(s)
+        upd.append(d)
+        s, d, w = _pad_level(dn_s[k], dn_d[k], n_pad, dn_w[k])
+        dns.append(s)
+        dnd.append(d)
+        dnw.append(w)
+    return DoublingLayout(tuple(ups), tuple(upd),
+                          tuple(dns), tuple(dnd), tuple(dnw))
+
+
+def doubling_up(h, decay: float, dbl: DoublingLayout):
+    """The full up-scan in log depth.  Base: one scatter-max of ``h``
+    over the 1-hop edges (= serial step 1 from u=0).  Level k doubles
+    the horizon over the exact ``2^k``-hop frontier with ``2^k``
+    sequential decay multiplies — bit-identical to the serial chain
+    (module docstring)."""
+    u = jnp.zeros_like(h).at[dbl.up_src[0]].max(h[dbl.up_dst[0]])
+    for k in range(len(dbl.up_src)):
+        vals = u[dbl.up_dst[k]]
+        for _ in range(1 << k):
+            vals = decay * vals
+        u = jnp.maximum(u, jnp.zeros_like(u).at[dbl.up_src[k]].max(vals))
+    return u
+
+
+def doubling_down(a_ex, decay: float, dbl: DoublingLayout, inv_deg):
+    """The full impact scan in log depth: base ``v_0 = W a_ex`` (the
+    serial step from m=0, same scatter-then-normalize association), then
+    ``v_{k+1} = decay^(2^k) * (W^(2^k) v_k) + v_k`` per level."""
+    v = jnp.zeros_like(a_ex).at[dbl.dn_dst[0]].add(
+        a_ex[dbl.dn_src[0]]
+    ) * inv_deg
+    for k in range(len(dbl.dn_src)):
+        applied = jnp.zeros_like(v).at[dbl.dn_dst[k]].add(
+            dbl.dn_w[k] * v[dbl.dn_src[k]]
+        )
+        v = (decay ** (1 << k)) * applied + v
+    return v
+
+
+# -- per-graph layout cache (same digest discipline as segscan's) -------------
+
+_DOUBLING_CACHE: dict = {}
+
+
+def doubling_layouts_for(n_pad: int, e_pad: int, dep_src, dep_dst,
+                         steps: int) -> Optional[DoublingLayout]:
+    """Cached frontier build for one edge set (host argsort/join costs
+    milliseconds at the 50k tier — paid once per pinned graph).  A
+    cached None records "declined: frontier cap" so hub graphs don't
+    re-pay the join on every request."""
+    from rca_tpu.engine.segscan import arrays_digest, cache_insert
+
+    src = np.asarray(dep_src)
+    dst = np.asarray(dep_dst)
+    key = arrays_digest((n_pad, e_pad, steps), (src, dst))
+    if key in _DOUBLING_CACHE:
+        return _DOUBLING_CACHE[key]
+    layout = build_doubling(n_pad, e_pad, src, dst, steps)
+    cache_insert(_DOUBLING_CACHE, key, layout)
+    return layout

@@ -130,20 +130,39 @@ def pallas_enabled() -> bool:
 
 
 def noisyor_autotune(refresh: bool = False) -> str:
-    """Back-compat shim over the per-shape kernel registry (ISSUE 12):
-    the process-level combine path — the registry's winner at the
-    canonical shape.  The one-shot timing, the ``RCA_PALLAS`` force
-    semantics, and the CPU short-circuit all live in
-    :mod:`rca_tpu.engine.registry` now; sessions ask the registry
-    per-shape via :func:`rca_tpu.engine.registry.engaged_kernel` and
-    stamp this process-level answer only as ``noisyor_path``."""
+    """DEPRECATED back-compat shim over the per-shape kernel registry
+    (ISSUE 12; deprecation stamped in ISSUE 13): the process-level
+    combine path — the registry's winner at the canonical shape.  The
+    one-shot timing, the force semantics, and the CPU short-circuit all
+    live in :mod:`rca_tpu.engine.registry` now; sessions ask the
+    registry per-shape via
+    :func:`rca_tpu.engine.registry.engaged_kernel` and stamp this
+    process-level answer only as ``noisyor_path``.  New code must go
+    through the registry — the ``kernel-dispatch`` lint flags calls to
+    this shim anywhere inside ``rca_tpu/``."""
+    import warnings
+
+    warnings.warn(
+        "noisyor_autotune() is deprecated: ask the per-shape registry "
+        "(rca_tpu.engine.registry.engaged_kernel / autotune_path)",
+        DeprecationWarning, stacklevel=2,
+    )
     from rca_tpu.engine.registry import autotune_path
 
     return autotune_path(refresh=refresh)
 
 
 def noisyor_path():
-    """The autotuned choice, or None when no session has autotuned yet."""
+    """DEPRECATED: the autotuned choice, or None when no session has
+    autotuned yet — use
+    :func:`rca_tpu.engine.registry.autotuned_path`."""
+    import warnings
+
+    warnings.warn(
+        "noisyor_path() is deprecated: use "
+        "rca_tpu.engine.registry.autotuned_path()",
+        DeprecationWarning, stacklevel=2,
+    )
     from rca_tpu.engine.registry import autotuned_path
 
     return autotuned_path()

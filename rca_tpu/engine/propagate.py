@@ -224,6 +224,8 @@ def propagate(
     down_seg=None,          # optional engine.segscan.SegLayout
     up_seg=None,            # optional engine.segscan.SegLayout
     error_contrast: float = 0.0,
+    dbl=None,               # optional engine.doubling.DoublingLayout
+    quant: bool = False,    # int8 message quantization (engine.quantized)
 ):
     """Returns (anomaly, hard, upstream, impact, score), all [S]."""
     a = _noisy_or(features, anomaly_w)
@@ -236,6 +238,7 @@ def propagate(
     return propagate_core(
         a, h, dep_src, dep_dst, steps, decay, explain_strength, impact_bonus,
         n_live=n_live, up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+        dbl=dbl, quant=quant,
     )
 
 
@@ -252,6 +255,8 @@ def propagate_core(
     up_ell=None,            # optional (idx, mask, ovf_seg, ovf_other)
     down_seg=None,          # optional engine.segscan.SegLayout
     up_seg=None,            # optional engine.segscan.SegLayout
+    dbl=None,               # optional engine.doubling.DoublingLayout
+    quant: bool = False,    # int8 message quantization (engine.quantized)
 ):
     """Propagation given precomputed evidence vectors (lets the fused
     Pallas noisy-OR feed the same core).
@@ -267,7 +272,28 @@ def propagate_core(
     edges (dependents past the width cap) go through one small scatter-max.
     """
 
-    if up_seg is not None:
+    if dbl is not None:
+        # log-depth operator doubling (engine.doubling): the whole
+        # serial ladder collapses into base + log2(steps) frontier
+        # applications — no lax.scan, no per-step round trips
+        from rca_tpu.engine.doubling import doubling_down, doubling_up
+
+        u = doubling_up(h, decay, dbl)
+        a_ex = background_excess(a, n_live)
+        deg = jnp.zeros_like(a).at[dep_dst].add(1.0)
+        inv_deg = 1.0 / jnp.maximum(deg, 1.0)
+        m = doubling_down(a_ex, decay, dbl, inv_deg)
+        score = combine_score(a, h, u, m, explain_strength, impact_bonus)
+        return a, h, u, m, score
+
+    if quant:
+        # int8 per-row message quantization on the E-sized gather
+        # traffic (engine.quantized): rank-parity-gated, not bitwise
+        from rca_tpu.engine.quantized import quant_up_step
+
+        def up_step(u, _):
+            return quant_up_step(u, h, decay, dep_src, dep_dst), None
+    elif up_seg is not None:
         # Pallas segmented-MAX layout (engine.segscan): one E-gather per
         # step vs the ELL table's [S, 8] gathers; bit-identical (fp32 max
         # is order-invariant)
@@ -299,7 +325,14 @@ def propagate_core(
     deg = jnp.zeros_like(a).at[dep_dst].add(1.0)
     inv_deg = 1.0 / jnp.maximum(deg, 1.0)
 
-    if down_seg is not None:
+    if quant:
+        from rca_tpu.engine.quantized import quant_imp_step
+
+        def imp_step(m, _):
+            return quant_imp_step(
+                m, a_ex, decay, dep_src, dep_dst, inv_deg
+            ), None
+    elif down_seg is not None:
         # Pallas segmented-scan layout (engine.segscan): replaces the
         # per-edge-serialized scatter at large tiers — 12.5 -> 8.4 ms for
         # the 8-step chain at 50k on v5e

@@ -1,0 +1,184 @@
+"""Quantized propagation: bf16 evidence + int8 messages on the edge traffic.
+
+ROADMAP item 4(c), PAPERS.md [5] (GNN acceleration survey): once the
+combine kernels are tuned, large-graph message passing is bound by the
+E-sized gather/scatter traffic — at the 50k tier the propagation chain
+moves ~8 x E float32 message elements per direction through HBM (PERF.md
+edge-layout study attributes ~6 ms of the 12.5 ms 8-step chain to the
+gather alone).  The survey's low-precision message trick applies
+directly: the per-step message vectors are smooth, bounded quantities
+(``max(h, y*u)`` in [0, 1/(1-y)], ``a_ex + y*m`` likewise), so they
+survive 8-bit quantization with rank-stable scores.
+
+This kernel cuts the traffic two ways:
+
+- **bf16 evidence**: the [S, C] noisy-OR evidence passes run on a
+  bfloat16 cast of the feature matrix (same expression as
+  ``propagate._noisy_or``, upcast to f32 after the product) — halves the
+  feature-read bytes of the two evidence passes;
+- **per-row int8 messages**: each propagation step quantizes the dense
+  [S] per-node signal to int8 with one float32 scale per 128-lane row
+  (``QUANT_ROW``), then the E-sized gather reads the int8 vector — 1
+  byte per gathered element instead of 4 — and dequantizes with the
+  row scale gathered from the 128x-smaller scale vector (which stays
+  cache/VMEM-resident).  Accumulation (scatter-add / scatter-max) stays
+  float32, so error does not compound through the reduction.
+
+Parity contract: RANK parity, not bit parity (ISSUE 13 tentpole).  An
+int8 message lane carries ~2 decimal digits; scores move in the 4th
+decimal, which is invisible to hit@k but fatal to a bitwise replay gate.
+The gates this kernel ships under are therefore hit@1/hit@3 equality
+plus a Kendall-tau floor on the top-k order vs the f32 path
+(:func:`rank_parity`), wired into bench ``accuracy_by_mode``, the chaos
+soak, and a dedicated corpus replay leg — see tests/test_kernels.py.
+
+Interpret/hermetic path: the kernel is pure jax.numpy (quantize /
+gather / dequantize lower on every backend), so CPU-host tests exercise
+EXACTLY the math the TPU runs — no interpreter shim needed; forcing is
+``RCA_KERNEL=quantized`` (the unified knob, see engine/registry.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: per-row quantization granularity: one f32 scale per 128 message lanes
+#: (a TPU vector register row).  Shapes are power-of-two buckets, so any
+#: ``n_pad`` divides into ``min(n_pad, QUANT_ROW)`` rows exactly.
+QUANT_ROW = 128
+
+
+def quant_row(n_pad: int) -> int:
+    """The effective row width for an ``n_pad``-padded vector."""
+    return min(QUANT_ROW, int(n_pad))
+
+
+def quantize_rows(x: jnp.ndarray):
+    """Per-row symmetric int8 quantization of a dense [S] f32 vector:
+    returns ``(q int8 [S], scale f32 [S // row])``.  An all-zero row
+    keeps scale 1.0 so the dequant is exact-zero, never 0/0."""
+    row = quant_row(x.shape[0])
+    r = x.reshape(-1, row)
+    amax = jnp.max(jnp.abs(r), axis=1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(r / scale[:, None]).astype(jnp.int8)
+    return q.reshape(-1), scale
+
+
+def dequant_gather(q: jnp.ndarray, scale: jnp.ndarray,
+                   idx: jnp.ndarray) -> jnp.ndarray:
+    """Gather ``x[idx]`` through the quantized representation: an int8
+    gather (1 byte/element of E-sized traffic) plus a row-scale gather
+    from the [S/128] scale vector."""
+    row = quant_row(q.shape[0])
+    return q[idx].astype(jnp.float32) * scale[idx // row]
+
+
+def quant_up_step(u, h, decay: float, dep_src, dep_dst):
+    """One explain-away step with the per-node signal ``max(h, y*u)``
+    quantized before the E-sized gather.  Accumulation is the same f32
+    scatter-max as the COO path."""
+    q, scale = quantize_rows(jnp.maximum(h, decay * u))
+    vals = dequant_gather(q, scale, dep_dst)
+    u_new = jnp.zeros_like(u).at[dep_src].max(vals)
+    return jnp.maximum(u, u_new)
+
+
+def quant_imp_step(m, a_ex, decay: float, dep_src, dep_dst, inv_deg):
+    """One impact step with ``a_ex + y*m`` quantized before the gather;
+    the scatter-add and degree normalization stay f32."""
+    q, scale = quantize_rows(a_ex + decay * m)
+    vals = dequant_gather(q, scale, dep_src)
+    return jnp.zeros_like(m).at[dep_dst].add(vals) * inv_deg
+
+
+def noisy_or_pair_bf16(features, anomaly_w, hard_w):
+    """The evidence pair over a bfloat16 cast of the feature matrix —
+    same expression as ``propagate._noisy_or``, half the feature-read
+    bytes, f32 out."""
+    f = jnp.clip(features.astype(jnp.bfloat16), 0.0, 1.0)
+    a = 1.0 - jnp.prod(1.0 - f * anomaly_w.astype(jnp.bfloat16)[None, :],
+                       axis=1)
+    h = 1.0 - jnp.prod(1.0 - f * hard_w.astype(jnp.bfloat16)[None, :],
+                       axis=1)
+    return a.astype(jnp.float32), h.astype(jnp.float32)
+
+
+# -- the rank-parity gate (first-class gate mode, ISSUE 13) -------------------
+
+def kendall_tau(order_a, order_b) -> float:
+    """Kendall rank correlation between two orderings of the same item
+    set (1.0 = identical order, -1.0 = reversed).  Host-side, O(k^2) on
+    top-k-sized lists — the gate compares rankings, not score arrays."""
+    items = [x for x in order_a if x in set(order_b)]
+    k = len(items)
+    if k < 2:
+        return 1.0
+    pos_b = {x: i for i, x in enumerate(order_b)}
+    concordant = discordant = 0
+    for i in range(k):
+        for j in range(i + 1, k):
+            d = pos_b[items[i]] - pos_b[items[j]]
+            if d < 0:
+                concordant += 1
+            elif d > 0:
+                discordant += 1
+    return (concordant - discordant) / (k * (k - 1) / 2)
+
+
+#: the score precision the kernel PROMISES: the symmetric int8 step is
+#: ~1/254 of the per-row signal max per quantize, and the 8-step scans
+#: accumulate it geometrically (sum decay^t ~ 1/(1-y) = 3.3x), so score
+#: perturbations up to ~1e-2 are within spec (measured ~4e-3 typical).
+#: Pairs the f32 path separates by LESS than this carry no rank signal.
+SCORE_EPS = 1e-2
+
+
+def topk_score_tau(scores_ref, scores_got, k: int = 25,
+                   tie_eps: float = SCORE_EPS) -> float:
+    """Tie-aware Kendall tau over the top-k of the REFERENCE score
+    vector: pairs whose reference scores differ by <= ``tie_eps``
+    (:data:`SCORE_EPS` — the kernel's documented score precision) are
+    excluded; the deep tail of a cascade ranking is exactly such
+    near-ties.  Pairs the f32 path DOES separate beyond the promised
+    precision must keep their order: those count, and the bench/test
+    gates hold this tau at >= 0.99."""
+    import numpy as np
+
+    ref = np.asarray(scores_ref, np.float64)
+    got = np.asarray(scores_got, np.float64)
+    top = np.argsort(-ref)[:k]
+    concordant = discordant = 0
+    for a in range(len(top)):
+        for b in range(a + 1, len(top)):
+            i, j = int(top[a]), int(top[b])
+            if ref[i] - ref[j] <= tie_eps:
+                continue
+            if got[i] > got[j]:
+                concordant += 1
+            elif got[i] < got[j]:
+                discordant += 1
+    total = concordant + discordant
+    return 1.0 if total == 0 else (concordant - discordant) / total
+
+
+def rank_parity(ranked_ref, ranked_got, k: int = 3,
+                tau_floor: float = 0.99) -> dict:
+    """The quantized kernel's landing gate: hit@1 and hit@k equality
+    (same leaders, as SETS for k>1 — order within the tail is judged by
+    tau) plus a Kendall-tau floor over the common top-k.  ``ranked_*``
+    are ranked dicts (``[{"component": ..., ...}]``) or plain name
+    lists."""
+    def names(r):
+        return [x["component"] if isinstance(x, dict) else x for x in r]
+
+    ref, got = names(ranked_ref), names(ranked_got)
+    hit1 = bool(ref[:1] == got[:1])
+    hitk = bool(set(ref[:k]) == set(got[:k]))
+    tau = kendall_tau(ref, got)
+    return {
+        "hit1_equal": hit1,
+        f"hit{k}_equal": hitk,
+        "kendall_tau": round(float(tau), 4),
+        "ok": hit1 and hitk and tau >= tau_floor,
+    }

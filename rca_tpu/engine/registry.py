@@ -45,10 +45,28 @@ from typing import Any, Dict, List, Optional, Tuple
 from rca_tpu.config import kernel_cache_path
 from rca_tpu.util.threads import make_lock
 
-#: candidate combine kernels, in table order.  Adding a kernel =
-#: appending here + teaching :func:`_eligible` / :func:`_time_candidates`
-#: about it (ROADMAP item 4 names ``segscan`` and ``quantized`` next).
-KERNELS = ("xla", "pallas")
+#: candidate propagation kernels, in table order (ISSUE 13 tentpole —
+#: ROADMAP item 4 (a)-(c) landed).  Adding a kernel = appending here +
+#: an eligibility entry in :func:`_eligibility` + a timing leg in
+#: :func:`_time_candidates`:
+#:
+#: - ``xla``      — f32 evidence + hybrid/COO scans (the default);
+#: - ``pallas``   — fused Pallas noisy-OR evidence, same scans;
+#: - ``segscan``  — Pallas flagged segmented-scan up/down layouts
+#:                  (engine/segscan.py; its old ``RCA_SEGSCAN`` side
+#:                  gate now lives HERE, registry-resident);
+#: - ``quantized``— bf16 evidence + per-row int8 message quantization
+#:                  on the E-sized gather traffic (engine/quantized.py;
+#:                  rank-parity-gated, not bitwise);
+#: - ``doubling`` — log-depth operator doubling over precomputed
+#:                  frontier layouts (engine/doubling.py; 8 serial steps
+#:                  -> base + 3 applications).
+KERNELS = ("xla", "pallas", "segscan", "quantized", "doubling")
+
+#: kernels expressible on the sharded (shard_map) engine: the per-block
+#: scatter kernel has a segscan twin (parallel/sharded.py), the rest
+#: have none yet
+SHARDED_KERNELS = ("xla", "segscan")
 
 #: the canonical shape the process-level compat path times at (the old
 #: ``noisyor_autotune`` measured one [8192, C] block and applied the
@@ -60,9 +78,38 @@ _CACHE_VERSION = 1
 
 
 def _flag() -> str:
+    """Composite env fingerprint for the row key: a test flipping ANY
+    dispatch knob mid-process re-decides instead of serving a stale
+    verdict (``RCA_KERNEL`` is the unified force added in ISSUE 13;
+    ``RCA_PALLAS``/``RCA_SEGSCAN`` keep their documented semantics)."""
+    from rca_tpu.config import env_int, env_str
+
+    return ":".join((
+        env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1")),
+        env_str("RCA_KERNEL", "", choices=("",) + KERNELS, lower=True),
+        env_str("RCA_SEGSCAN", "", choices=("0", "1")),
+        env_str("SEGSCAN_INTERPRET", "", choices=("0", "1")),
+        env_str("RCA_EDGE_LAYOUT", "hybrid", lower=True),
+        str(env_int("RCA_SEGSCAN_MIN", 1024, 0, 2**31 - 1)),
+    ))
+
+
+def forced_kernel() -> Optional[str]:
+    """The explicitly forced kernel, or None for autotune.  Precedence:
+    the unified ``RCA_KERNEL`` knob, then the legacy per-kernel forces
+    it unifies (``RCA_PALLAS=1``, ``RCA_SEGSCAN=1``, the hermetic-test
+    ``SEGSCAN_INTERPRET=1``)."""
     from rca_tpu.config import env_str
 
-    return env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1"))
+    k = env_str("RCA_KERNEL", "", choices=("",) + KERNELS, lower=True)
+    if k:
+        return k
+    if env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1")) == "1":
+        return "pallas"
+    if (env_str("RCA_SEGSCAN", "", choices=("0", "1")) == "1"
+            or env_str("SEGSCAN_INTERPRET", "", choices=("0", "1")) == "1"):
+        return "segscan"
+    return None
 
 
 def _backend() -> str:
@@ -80,7 +127,12 @@ def kernel_set_hash() -> str:
     if _KERNEL_SET_HASH is None:
         h = hashlib.sha1(repr(KERNELS).encode())
         base = os.path.dirname(os.path.abspath(__file__))
-        for fname in ("propagate.py", "pallas_kernels.py", "registry.py"):
+        # the grown kernel set is part of the key by construction (repr
+        # above) AND by source: a cache written by the 2-kernel registry
+        # re-times under the 5-kernel one (ISSUE 13 acceptance)
+        for fname in ("propagate.py", "pallas_kernels.py", "registry.py",
+                      "segscan.py", "quantized.py", "doubling.py",
+                      "ell.py"):
             try:
                 with open(os.path.join(base, fname), "rb") as f:
                     h.update(f.read())
@@ -95,7 +147,13 @@ _KERNEL_SET_HASH: Optional[str] = None
 
 @dataclasses.dataclass
 class KernelRow:
-    """One registry row: the engaged kernel for one padded shape."""
+    """One registry row: the engaged kernel for one padded shape.
+    ``e_pad`` (the padded EDGE tier) joined the key in ISSUE 13: the
+    segscan/doubling/quantized kernels are edge-layout kernels, so their
+    eligibility and timings are per (node tier, edge tier), not per node
+    tier alone.  ``e_pad is None`` marks a caller that could not name an
+    edge tier (the legacy process-level shim): edge-dependent kernels
+    are ineligible there and the row decides among xla/pallas only."""
 
     variant: str                  # dense | sharded
     n_pad: int
@@ -103,6 +161,8 @@ class KernelRow:
     winner: str                   # the engaged kernel (a KERNELS member)
     source: str                   # forced|cpu-default|unsupported|
     #                               ineligible|timed|cache|sharded
+    e_pad: Optional[int] = None   # padded edge tier (None = unknown)
+    steps: int = 8                # propagation depth the row decided for
     eligible: Dict[str, Any] = dataclasses.field(default_factory=dict)
     timings_ms: Dict[str, Optional[float]] = dataclasses.field(
         default_factory=dict
@@ -113,6 +173,8 @@ class KernelRow:
         return {
             "variant": self.variant,
             "n_pad": self.n_pad,
+            "e_pad": self.e_pad,
+            "steps": self.steps,
             "backend": self.backend,
             "winner": self.winner,
             "source": self.source,
@@ -206,70 +268,80 @@ class KernelRegistry:
             pass  # an unwritable cache must not fail the dispatch
 
     # -- resolution ----------------------------------------------------------
-    def resolve(self, n_pad: int, sharded: bool = False) -> KernelRow:
+    def resolve(self, n_pad: int, e_pad: Optional[int] = None,
+                sharded: bool = False, steps: int = 8) -> KernelRow:
         """The row for one padded shape, created on first ask.  Rows are
-        keyed by the ``RCA_PALLAS`` flag too, so a test flipping the env
-        mid-process re-decides instead of serving a stale verdict."""
+        keyed by the dispatch env knobs too (:func:`_flag`), so a test
+        flipping the env mid-process re-decides instead of serving a
+        stale verdict."""
         n_pad = int(n_pad)
+        e_pad = int(e_pad) if e_pad is not None else None
+        steps = int(steps)
         variant = "sharded" if sharded else "dense"
         flag = _flag()
         backend = _backend()
-        key = (variant, n_pad, backend, flag)
+        key = (variant, n_pad, e_pad, steps, backend, flag)
         with self._lock:
             row = self._rows.get(key)
         if row is not None:
             return row
-        row = self._decide(variant, n_pad, backend, flag)
+        row = self._decide(variant, n_pad, e_pad, steps, backend)
         with self._lock:
             self._rows[key] = row
         return row
 
-    def _decide(self, variant: str, n_pad: int, backend: str,
-                flag: str) -> KernelRow:
-        from rca_tpu.engine.pallas_kernels import (
-            BLOCK_S,
-            pallas_supported,
-        )
+    def _decide(self, variant: str, n_pad: int, e_pad: Optional[int],
+                steps: int, backend: str) -> KernelRow:
+        from rca_tpu.engine.pallas_kernels import pallas_supported
 
-        divisible = n_pad % min(n_pad, BLOCK_S) == 0
-        eligible: Dict[str, Any] = {
-            "xla": True,
-            "pallas": (
-                True if divisible
-                else f"n_pad {n_pad} not divisible into {BLOCK_S} blocks"
-            ),
-        }
+        eligible = _eligibility(variant, n_pad, e_pad, steps)
         row = KernelRow(
-            variant=variant, n_pad=n_pad, backend=backend,
-            winner="xla", source="default", eligible=eligible,
+            variant=variant, n_pad=n_pad, e_pad=e_pad, steps=steps,
+            backend=backend, winner="xla", source="default",
+            eligible=eligible,
         )
         if variant == "sharded":
-            # the sharded per-block kernel keeps XLA's fused noisy-OR —
-            # the Pallas pair has no shard_map twin (SERVING.md)
+            # the sharded per-block propagation has a segscan twin
+            # (parallel/sharded.py) but no shard_map twin of the other
+            # kernels; its gate mirrors the dense auto gate (forced, or
+            # TPU at or above RCA_SEGSCAN_MIN)
             row.source = "sharded"
-            row.eligible["pallas"] = "no shard_map twin"
+            if eligible.get("segscan") is True and (
+                forced_kernel() == "segscan"
+                or (backend == "tpu" and n_pad >= _segscan_min())
+            ):
+                row.winner = "segscan"
             return row
-        if flag == "1":
-            # forced: pallas_supported raises loudly if the compile fails
-            pallas_supported()
-            row.winner = "pallas" if divisible else "xla"
-            row.source = "forced" if divisible else "ineligible"
-            return row
-        if flag == "0":
-            row.source = "forced"
+        forced = forced_kernel()
+        if forced is not None:
+            if forced == "pallas":
+                # forced: pallas_supported raises loudly on compile fail
+                pallas_supported()
+            if eligible.get(forced) is True:
+                row.winner = forced
+                row.source = "forced"
+            else:
+                row.source = "ineligible"
             return row
         if backend == "cpu":
-            # non-accelerator: the kernel only runs interpreted here —
-            # timing an interpreter burns seconds to confirm the obvious
+            # non-accelerator: every non-XLA kernel runs interpreted (or
+            # emulated) here — timing an interpreter burns seconds to
+            # confirm the obvious; forcing still works for tests
             row.source = "cpu-default"
             return row
-        if not pallas_supported():
-            row.source = "unsupported"
-            return row
-        if not divisible:
+        candidates = [k for k in KERNELS if eligible.get(k) is True]
+        if "pallas" in candidates and not pallas_supported():
+            eligible["pallas"] = "pallas compile probe failed"
+            candidates.remove("pallas")
+        if "segscan" in candidates and n_pad < _segscan_min():
+            eligible["segscan"] = (
+                f"n_pad {n_pad} below RCA_SEGSCAN_MIN {_segscan_min()}"
+            )
+            candidates.remove("segscan")
+        if candidates == ["xla"]:
             row.source = "ineligible"
             return row
-        cache_key = f"{variant}:{n_pad}:{backend}"
+        cache_key = f"{variant}:{n_pad}:{e_pad}:{steps}:{backend}"
         cached = self._load_cached(cache_key)
         if cached is not None:
             row.winner = cached["winner"]
@@ -278,17 +350,8 @@ class KernelRegistry:
             if cached.get("cost"):
                 row.cost = dict(cached["cost"])
             return row
-        row.timings_ms = _time_candidates(n_pad)
-        t_xla = row.timings_ms.get("xla")
-        t_pallas = row.timings_ms.get("pallas")
-        # ties (and unmeasurable candidates) go to XLA — the simpler,
-        # default-tested path, same policy the one-shot autotune had
-        row.winner = (
-            "pallas"
-            if t_xla is not None and t_pallas is not None
-            and t_pallas < 0.95 * t_xla
-            else "xla"
-        )
+        row.timings_ms = _time_candidates(n_pad, e_pad, steps, candidates)
+        row.winner = _pick_winner(row.timings_ms)
         row.source = "timed"
         self._store_cached(cache_key, row)
         return row
@@ -301,9 +364,12 @@ class KernelRegistry:
         must never trigger a compile — ``rca kernels`` and bench call
         this, serve surfaces export whatever is already captured."""
         if row.cost is None:
-            row.cost = _capture_cost(row.n_pad, row.winner)
+            row.cost = _capture_cost(
+                row.n_pad, row.e_pad, row.winner, row.steps
+            )
             if row.source in ("timed", "cache"):
-                cache_key = f"{row.variant}:{row.n_pad}:{row.backend}"
+                cache_key = (f"{row.variant}:{row.n_pad}:{row.e_pad}:"
+                             f"{row.steps}:{row.backend}")
                 self._store_cached(cache_key, row)
         return row
 
@@ -318,7 +384,8 @@ class KernelRegistry:
         with self._lock:
             rows = sorted(
                 self._rows.values(),
-                key=lambda r: (r.variant, r.n_pad, r.backend),
+                key=lambda r: (r.variant, r.n_pad, r.e_pad or -1,
+                               r.backend),
             )
         out = []
         for row in rows:
@@ -332,65 +399,187 @@ class KernelRegistry:
             self._rows.clear()
 
 
-def _time_candidates(n_pad: int, reps: int = 200) -> Dict[str, Optional[float]]:
-    """Amortized in-jit timing of each candidate's evidence pass at THIS
-    padded shape: rep count folds a salt so no transport cache can
-    replay, sync is by FETCHING a slice — never ``block_until_ready``
-    (PERF.md round-1 correction).  A candidate that cannot even time
-    records ``None`` (and cannot win)."""
+def _segscan_min() -> int:
+    from rca_tpu.config import env_int
+
+    return env_int("RCA_SEGSCAN_MIN", 1024, 0, 2**31 - 1)
+
+
+def _eligibility(variant: str, n_pad: int, e_pad: Optional[int],
+                 steps: int) -> Dict[str, Any]:
+    """Per-kernel structural eligibility at one shape: ``True`` or a
+    human-readable decline reason.  THE hook a new kernel registers
+    with (ISSUE 13): segscan's old ``RCA_SEGSCAN`` side gate, the
+    quantized row-width rule, and doubling's power-of-two depth rule all
+    live here, so ``rca kernels --explain`` can say WHY a candidate was
+    never in the race."""
+    from rca_tpu.config import env_str
+    from rca_tpu.engine.pallas_kernels import BLOCK_S
+    from rca_tpu.engine.doubling import doubling_eligible
+    from rca_tpu.engine.segscan import segscan_eligibility
+
+    layout = env_str("RCA_EDGE_LAYOUT", "hybrid",
+                     choices=("hybrid", "coo", "ell"), lower=True)
+    out: Dict[str, Any] = {"xla": True}
+    # pallas: the fused evidence kernel (dense only, block-divisible)
+    if variant == "sharded":
+        out["pallas"] = "no shard_map twin"
+    elif env_str("RCA_PALLAS", "auto", choices=("auto", "0", "1")) == "0":
+        out["pallas"] = "RCA_PALLAS=0"
+    elif n_pad % min(n_pad, BLOCK_S) != 0:
+        out["pallas"] = f"n_pad {n_pad} not divisible into {BLOCK_S} blocks"
+    else:
+        out["pallas"] = True
+    # segscan: structural gate shared by dense and sharded (the sharded
+    # engine ships the per-block twin); dense additionally requires the
+    # hybrid layout (RCA_EDGE_LAYOUT=coo/ell pin the layout-study paths)
+    if variant == "dense" and layout != "hybrid":
+        out["segscan"] = f"RCA_EDGE_LAYOUT={layout} pins the scan layout"
+    else:
+        out["segscan"] = segscan_eligibility(n_pad, e_pad)
+    # quantized / doubling: dense-only edge-layout kernels
+    for name, extra in (("quantized", None), ("doubling", None)):
+        if variant == "sharded":
+            out[name] = "no shard_map twin"
+        elif layout == "ell":
+            out[name] = "RCA_EDGE_LAYOUT=ell pins the gather-table layout"
+        elif e_pad is None:
+            out[name] = "edge tier unknown (caller passed no e_pad)"
+        else:
+            out[name] = True
+    if out.get("doubling") is True and not doubling_eligible(steps):
+        out["doubling"] = (
+            f"steps {steps} not a power of two (doubled ladder cannot "
+            f"land exactly)"
+        )
+    return out
+
+
+def _pick_winner(timings: Dict[str, Optional[float]]) -> str:
+    """Ties (and unmeasurable candidates) go to XLA — the simpler,
+    default-tested path, same policy the one-shot autotune had; a
+    challenger must beat XLA by >5% to take a row."""
+    t_xla = timings.get("xla")
+    if t_xla is None:
+        return "xla"
+    best, best_t = "xla", t_xla
+    for k, t in timings.items():
+        if k != "xla" and t is not None and t < best_t:
+            best, best_t = k, t
+    return best if best_t < 0.95 * t_xla else "xla"
+
+
+def _timing_harness(n_pad: int, e_pad: Optional[int], steps: int):
+    """The synthetic graph + per-kernel layout builder the timing and
+    cost hooks share: a ring over ``n_pad - 1`` live nodes padded to
+    ``e_pad`` edges — canonical per shape (the registry key), not per
+    graph, so rows stay comparable across rounds."""
+    import numpy as np
+
+    n_pad = int(n_pad)
+    e_pad = int(e_pad) if e_pad is not None else n_pad
+    n = max(1, n_pad - 1)
+    dummy = n_pad - 1
+    src = np.full(e_pad, dummy, np.int32)
+    dst = np.full(e_pad, dummy, np.int32)
+    ring = np.arange(min(n, e_pad), dtype=np.int32)
+    src[: len(ring)] = ring
+    dst[: len(ring)] = (ring + 1) % n
+    return n, e_pad, src, dst, ring
+
+
+def _layouts_for_winner(kernel: str, n_pad: int, e_pad: int,
+                        src, dst, steps: int):
+    """(down_seg, up_seg, up_ell, dbl) for one candidate over the
+    canonical harness graph — the same layout assembly the dispatch
+    surfaces run (runner.kernel_plan), minus the registry ask."""
+    down_seg = up_seg = up_ell = dbl = None
+    raw = src[src != n_pad - 1], dst[src != n_pad - 1]
+    if kernel == "segscan":
+        from rca_tpu.engine.segscan import build_down_seg, build_up_seg
+
+        down_seg = build_down_seg(n_pad, e_pad, raw[0], raw[1])
+        up_seg = build_up_seg(n_pad, e_pad, raw[0], raw[1])
+    elif kernel == "doubling":
+        from rca_tpu.engine.doubling import build_doubling
+
+        dbl = build_doubling(n_pad, e_pad, raw[0], raw[1], steps)
+        if dbl is None:
+            raise ValueError("doubling frontier declined the harness graph")
+    elif kernel in ("xla", "pallas", "quantized"):
+        from rca_tpu.engine.runner import up_ell_for
+
+        up_ell = up_ell_for(n_pad, raw[0], raw[1])
+    return down_seg, up_seg, up_ell, dbl
+
+
+def _time_candidates(n_pad: int, e_pad: Optional[int], steps: int,
+                     candidates) -> Dict[str, Optional[float]]:
+    """Amortized in-jit timing of each candidate's FULL propagation
+    chain (evidence + both scans) at THIS padded shape: rep count folds
+    a salt so no transport cache can replay, sync is by FETCHING a slice
+    — never ``block_until_ready`` (PERF.md round-1 correction).  A
+    candidate that cannot even time records ``None`` (and cannot win)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from rca_tpu.engine.pallas_kernels import (
-        noisy_or_pair_pallas,
-        noisy_or_pair_xla,
-    )
     from rca_tpu.features.schema import NUM_SERVICE_FEATURES
 
+    n, e_pad, src, dst, _ = _timing_harness(n_pad, e_pad, steps)
     rng = np.random.default_rng(0)
     f = jnp.asarray(
         rng.uniform(0, 1, (n_pad, NUM_SERVICE_FEATURES)).astype(np.float32)
     )
-    ft = f.T
+    edges = jnp.asarray(np.stack([src, dst]))
     w = jnp.asarray(
         rng.uniform(0.2, 0.9, NUM_SERVICE_FEATURES).astype(np.float32)
     )
 
-    def timed(fn, arg) -> Optional[float]:
-        @jax.jit
-        def many(x, salt):
-            def body(i, acc):
-                a, h = fn(x * (1.0 + salt + i * 1e-7), w, w)
-                return acc + a + h
-            return jax.lax.fori_loop(0, reps, body, jnp.zeros(n_pad))
+    def timed(kernel: str, reps: int = 20) -> Optional[float]:
+        from rca_tpu.engine.runner import propagate_auto
 
         try:
-            jax.device_get(many(arg, jnp.float32(1e-7))[:4])  # compile
+            layouts = _layouts_for_winner(
+                kernel, n_pad, e_pad, src, dst, steps
+            )
+            down_seg, up_seg, up_ell, dbl = layouts
+
+            @jax.jit
+            def many(x, salt):
+                def body(i, acc):
+                    out = propagate_auto(
+                        x * (1.0 + salt + i * 1e-7), edges, w, w,
+                        steps, 0.7, 0.85, 1.6,
+                        up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+                        kernel=kernel, dbl=dbl,
+                    )
+                    return acc + out[4]
+                return jax.lax.fori_loop(0, reps, body, jnp.zeros(n_pad))
+
+            jax.device_get(many(f, jnp.float32(1e-7))[:4])  # compile
             outs = []
             for j in range(3):
                 t0 = time.perf_counter()
-                jax.device_get(many(arg, jnp.float32((j + 2) * 1e-7))[:4])
+                jax.device_get(many(f, jnp.float32((j + 2) * 1e-7))[:4])
                 outs.append(time.perf_counter() - t0)
             return float(min(outs)) * 1e3 / reps
         except Exception:
             return None  # a path that cannot even time cannot win
 
-    return {
-        "xla": timed(noisy_or_pair_xla, f),
-        "pallas": timed(noisy_or_pair_pallas, ft),
-    }
+    return {k: timed(k) for k in candidates}
 
 
-def _capture_cost(n_pad: int, winner: str) -> Dict[str, Any]:
+def _capture_cost(n_pad: int, e_pad: Optional[int], winner: str,
+                  steps: int = 8) -> Dict[str, Any]:
     """XLA cost + memory analysis of the canonical propagation
     executable at this padded shape: the one-shot fused body
     (``_propagate_ranked`` — sanitize + evidence + propagation + top-k)
-    AOT-lowered over a ring graph with ``n_pad`` padded edges in pure
-    COO form.  Canonical, not per-session: the figures scale with the
-    shape (the registry key), not with one graph's edge list, so rows
-    stay comparable across rounds.  Backends without cost analysis
-    record why instead of crashing."""
+    AOT-lowered over a ring graph at the row's (node, edge) tiers with
+    the WINNER's layouts.  Canonical, not per-session: the figures scale
+    with the shape (the registry key), not with one graph's edge list,
+    so rows stay comparable across rounds.  Backends without cost
+    analysis record why instead of crashing."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -399,24 +588,21 @@ def _capture_cost(n_pad: int, winner: str) -> Dict[str, Any]:
     from rca_tpu.features.schema import NUM_SERVICE_FEATURES
 
     n_pad = int(n_pad)
-    n = max(1, n_pad - 1)  # slot n_pad-1 is the engine's dummy row
-    dummy = n_pad - 1
-    src = np.full(n_pad, dummy, np.int32)
-    dst = np.full(n_pad, dummy, np.int32)
-    ring = np.arange(n, dtype=np.int32)
-    src[:n] = ring
-    dst[:n] = (ring + 1) % n
-    p = default_params()
+    n, e_pad, src, dst, _ = _timing_harness(n_pad, e_pad, steps)
+    p = default_params(steps)
     aw, hw = p.weight_arrays()
     features = jnp.zeros((n_pad, NUM_SERVICE_FEATURES), jnp.float32)
     edges = jnp.asarray(np.stack([src, dst]))
     kk = min(13, n_pad)
     try:
+        down_seg, up_seg, up_ell, dbl = _layouts_for_winner(
+            winner, n_pad, e_pad, src, dst, steps
+        )
         compiled = _propagate_ranked.lower(
             features, edges, aw, hw,
             p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
-            winner == "pallas", jnp.asarray(n, jnp.int32), None, None,
-            None, error_contrast=p.error_contrast,
+            winner, jnp.asarray(n, jnp.int32), up_ell, down_seg,
+            up_seg, dbl, error_contrast=p.error_contrast,
         ).compile()
     except Exception as exc:
         return {"unavailable": f"compile: {type(exc).__name__}: {exc}"}
@@ -466,13 +652,17 @@ def reset_registry() -> None:
     get_registry().clear()
 
 
-def engaged_kernel(n_pad: int, sharded: bool = False) -> str:
-    """THE dispatch seam: which combine kernel a propagation over an
-    ``n_pad``-padded graph engages.  Every call surface (one-shot
-    analyze, streaming flush, resident delta, serve dispatch, sharded
-    tick) asks HERE — graftlint rule ``kernel-dispatch`` keeps it that
-    way."""
-    return get_registry().resolve(n_pad, sharded=sharded).winner
+def engaged_kernel(n_pad: int, e_pad: Optional[int] = None,
+                   sharded: bool = False, steps: int = 8) -> str:
+    """THE dispatch seam: which propagation kernel an
+    ``(n_pad, e_pad)``-padded graph engages.  Every call surface
+    (one-shot analyze, streaming flush, resident delta, serve dispatch,
+    sharded tick) asks HERE — graftlint rule ``kernel-dispatch`` keeps
+    it that way.  Callers that cannot name an edge tier get the
+    xla/pallas-only decision (edge-layout kernels need ``e_pad``)."""
+    return get_registry().resolve(
+        n_pad, e_pad=e_pad, sharded=sharded, steps=steps
+    ).winner
 
 
 def autotune_path(refresh: bool = False) -> str:

@@ -70,14 +70,14 @@ def graph_digest(
     donate_argnums=(0,),
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
-        "error_contrast", "use_pallas",
+        "error_contrast", "kernel",
     ),
 )
 def _resident_delta_ranked(
     features, idx, rows, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live, up_ell=None, down_seg=None, up_seg=None,
-    error_contrast: float = 0.0, use_pallas: bool = False,
+    error_contrast: float = 0.0, kernel: str = "xla", dbl=None,
 ):
     """One request in ONE dispatch: scatter the delta rows into the
     donated resident buffer, sanitize, propagate, top-k, and gather the
@@ -94,7 +94,7 @@ def _resident_delta_ranked(
         clean, edges, anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, n_live=n_live,
         up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-        error_contrast=error_contrast, use_pallas=use_pallas,
+        error_contrast=error_contrast, kernel=kernel, dbl=dbl,
     )
     vals, topi = jax.lax.top_k(score, k)
     stacked = jnp.stack([a, u, m, score])
@@ -113,8 +113,7 @@ class ResidentSession:
         dep_src: np.ndarray,
         dep_dst: np.ndarray,
     ):
-        from rca_tpu.engine.registry import engaged_kernel
-        from rca_tpu.engine.runner import coo_layouts_for
+        from rca_tpu.engine.runner import kernel_plan
 
         self.engine = engine
         self.key = key
@@ -133,14 +132,17 @@ class ResidentSession:
         # edges + layouts + (lazily) the feature matrix live on device for
         # the session lifetime — same pinning the streaming session does
         self._edges = jnp.asarray(np.stack([s, d]))
-        self._down_seg, self._up_seg, self._up_ell = coo_layouts_for(
-            self._n_pad, e_pad, dep_src, dep_dst
+        # per-shape registry plan (ISSUE 12/13): the same dispatch seam
+        # the one-shot and streaming surfaces ask, so the resident delta
+        # path cannot drift to a different kernel
+        self._plan = kernel_plan(
+            self._n_pad, e_pad, dep_src, dep_dst,
+            steps=engine.params.steps,
         )
+        self._down_seg = self._plan.down_seg
+        self._up_seg = self._plan.up_seg
+        self._up_ell = self._plan.up_ell
         self._n_live = jnp.asarray(n, jnp.int32)
-        # per-shape registry row (ISSUE 12): the same dispatch seam the
-        # one-shot and streaming surfaces ask, so the resident delta
-        # path cannot drift to a different combine kernel
-        self._use_pallas = engaged_kernel(self._n_pad) == "pallas"
         # raw host mirror of the resident buffer's live rows (the diff
         # base); None until the first request stages the buffer
         self._mirror: Optional[np.ndarray] = None
@@ -191,8 +193,8 @@ class ResidentSession:
             stacked, diag, vals, idx, n_bad = _propagate_ranked(
                 self._features, self._edges, eng._aw, eng._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
-                self._use_pallas, self._n_live, self._up_ell,
-                self._down_seg, self._up_seg,
+                self._plan.kernel, self._n_live, self._up_ell,
+                self._down_seg, self._up_seg, self._plan.dbl,
                 error_contrast=p.error_contrast,
             )
         elif len(changed) == 0:
@@ -202,8 +204,8 @@ class ResidentSession:
             stacked, diag, vals, idx, n_bad = _propagate_ranked(
                 self._features, self._edges, eng._aw, eng._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
-                self._use_pallas, self._n_live, self._up_ell,
-                self._down_seg, self._up_seg,
+                self._plan.kernel, self._n_live, self._up_ell,
+                self._down_seg, self._up_seg, self._plan.dbl,
                 error_contrast=p.error_contrast,
             )
         else:
@@ -223,7 +225,7 @@ class ResidentSession:
                 p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
                 self._n_live, self._up_ell, self._down_seg, self._up_seg,
                 error_contrast=p.error_contrast,
-                use_pallas=self._use_pallas,
+                kernel=self._plan.kernel, dbl=self._plan.dbl,
             )
             # mirror updates only once the dispatch is accepted — a raise
             # above (fresh-tier compile failure) leaves the old mirror, so

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional, Sequence
+from typing import List, NamedTuple, Optional, Sequence
 
 import functools
 import os
@@ -98,25 +98,69 @@ def up_ell_for(n_pad: int, dep_src, dep_dst):
     return build_up_ell(n_pad, dep_src, dep_dst)
 
 
-def coo_layouts_for(n_pad: int, e_pad: int, dep_src, dep_dst):
-    """Layout selection for the COO-family executables, shared by every
-    caller that stages a padded graph (one-shot analyze, hypothesis batch,
-    streaming session, serving dispatcher): segscan upgrades only the
-    hybrid DEFAULT (an explicit ``RCA_EDGE_LAYOUT=coo`` stays pure COO —
-    the documented A/B knob for the PERF.md layout study), and the hybrid
-    up-table fills in when segscan declines the tier.  One definition so a
-    layout-gating change cannot land in one caller and silently break the
-    cross-path score parity.  Returns ``(down_seg, up_seg, up_ell)``."""
-    from rca_tpu.engine.segscan import seg_layouts_for
+class KernelPlan(NamedTuple):
+    """One shape's resolved dispatch: the engaged kernel plus the device
+    layouts it runs over — what every staging surface pins per graph."""
 
-    down_seg, up_seg = (
-        seg_layouts_for(n_pad, e_pad, dep_src, dep_dst)
-        if edge_layout() == "hybrid" else (None, None)
-    )
-    up_ell = (
-        None if up_seg is not None else up_ell_for(n_pad, dep_src, dep_dst)
-    )
-    return down_seg, up_seg, up_ell
+    kernel: str                   # the engaged KERNELS member
+    down_seg: object = None       # engine.segscan.SegLayout
+    up_seg: object = None         # engine.segscan.SegLayout
+    up_ell: object = None         # hybrid up-table (idx, mask, ovf, ovf)
+    dbl: object = None            # engine.doubling.DoublingLayout
+
+
+def kernel_plan(n_pad: int, e_pad: int, dep_src, dep_dst,
+                steps: int = 8) -> KernelPlan:
+    """THE per-graph dispatch step, shared by every caller that stages a
+    padded graph (one-shot analyze, hypothesis batch, streaming session,
+    resident session, serving dispatcher): ask the registry which kernel
+    this ``(n_pad, e_pad)`` shape engages (ISSUE 13 — segscan's old
+    ``RCA_SEGSCAN`` side gate, the quantized and doubling gates, the
+    forcing knobs, and the per-shape timings all live THERE), then build
+    that kernel's layouts.  One definition so a layout-gating change
+    cannot land in one caller and silently break the cross-path score
+    parity.
+
+    The doubling kernel may decline a specific GRAPH (frontier blowup on
+    hub-heavy topologies — engine/doubling.py cost model) even when the
+    shape row elected it; the plan then falls back to the serial xla
+    path and says so via ``plan.kernel``, so the stamped kernel is
+    always the one that actually ran."""
+    from rca_tpu.engine.registry import engaged_kernel
+
+    kernel = engaged_kernel(n_pad, e_pad, steps=steps)
+    down_seg = up_seg = up_ell = dbl = None
+    if kernel == "segscan":
+        from rca_tpu.engine.segscan import build_seg_layouts
+
+        down_seg, up_seg = build_seg_layouts(n_pad, e_pad, dep_src, dep_dst)
+    elif kernel == "doubling":
+        from rca_tpu.engine.doubling import doubling_layouts_for
+
+        dbl = doubling_layouts_for(n_pad, e_pad, dep_src, dep_dst, steps)
+        if dbl is None:
+            kernel = "xla"
+    if kernel in ("xla", "pallas"):
+        # the hybrid up-table serves the serial scans (quantized brings
+        # its own int8 gather steps; segscan/doubling their layouts)
+        up_ell = up_ell_for(n_pad, dep_src, dep_dst)
+    return KernelPlan(kernel, down_seg, up_seg, up_ell, dbl)
+
+
+def coo_layouts_for(n_pad: int, e_pad: int, dep_src, dep_dst):
+    """Back-compat shim over :func:`kernel_plan` for callers that only
+    want the serial-scan layouts: ``(down_seg, up_seg, up_ell)``."""
+    plan = kernel_plan(n_pad, e_pad, dep_src, dep_dst)
+    return plan.down_seg, plan.up_seg, plan.up_ell
+
+
+def batch_kernel(kernel: str) -> str:
+    """The batched (vmapped) executables' kernel for a shape whose solo
+    winner is ``kernel``: the fused Pallas evidence pair keeps no vmap
+    twin (the batch path has always run XLA's fusion — the any-width ==
+    solo parity contract in SERVING.md predates it); every other kernel
+    vmaps as-is."""
+    return "xla" if kernel == "pallas" else kernel
 
 
 def edge_layout() -> str:
@@ -143,24 +187,32 @@ def propagate_auto(
     features, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     n_live=None, up_ell=None, down_seg=None, up_seg=None,
-    error_contrast: float = 0.0, use_pallas: bool = False,
+    error_contrast: float = 0.0, kernel: str = "xla", dbl=None,
 ):
     """The shared traced propagation body behind every fused COO-family
-    executable (one-shot, streaming flush, resident delta): the
-    pallas-vs-XLA evidence branch lives HERE once, so the autotuned
-    combine path cannot drift between the call surfaces.  Returns
-    ``(a, h, u, m, score)``."""
+    executable (one-shot, streaming flush, resident delta, hypothesis
+    lanes): the per-kernel evidence branch lives HERE once, so the
+    registry's engaged kernel cannot drift between the call surfaces.
+    ``kernel`` is the registry winner (a static string in every jitted
+    caller); segscan/doubling additionally arrive as layout pytrees.
+    Returns ``(a, h, u, m, score)``."""
     from rca_tpu.engine.propagate import propagate
 
-    if use_pallas:
-        from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
+    if kernel in ("pallas", "quantized"):
         from rca_tpu.engine.propagate import (
             error_source_excess,
             fold_error_contrast,
             propagate_core,
         )
 
-        a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
+        if kernel == "pallas":
+            from rca_tpu.engine.pallas_kernels import noisy_or_pair_pallas
+
+            a, h = noisy_or_pair_pallas(features.T, anomaly_w, hard_w)
+        else:
+            from rca_tpu.engine.quantized import noisy_or_pair_bf16
+
+            a, h = noisy_or_pair_bf16(features, anomaly_w, hard_w)
         if error_contrast:
             a = fold_error_contrast(
                 a, error_source_excess(features, edges[0], edges[1]),
@@ -170,12 +222,13 @@ def propagate_auto(
             a, h, edges[0], edges[1],
             steps, decay, explain_strength, impact_bonus, n_live=n_live,
             up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
+            dbl=dbl, quant=kernel == "quantized",
         )
     return propagate(
         features, edges[0], edges[1], anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, n_live=n_live,
         up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-        error_contrast=error_contrast,
+        error_contrast=error_contrast, dbl=dbl,
     )
 
 
@@ -191,14 +244,14 @@ def topk_diag(stacked, idx):
     jax.jit,
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
-        "use_pallas", "error_contrast",
+        "kernel", "error_contrast",
     ),
 )
 def _propagate_ranked(
     features, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
-    k: int, use_pallas: bool = False, n_live=None, up_ell=None,
-    down_seg=None, up_seg=None, error_contrast: float = 0.0,
+    k: int, kernel: str = "xla", n_live=None, up_ell=None,
+    down_seg=None, up_seg=None, dbl=None, error_contrast: float = 0.0,
 ):
     """One dispatch, minimal transfers: edges arrive as one [2, E] buffer;
     the top-k pair leaves with a [4, k] gather of their diagnostic rows —
@@ -206,9 +259,11 @@ def _propagate_ranked(
     a diagnostics consumer asks).  Matters on tunneled TPUs where every
     host<->device hop pays an RTT and transfer scales with bytes.
 
-    With ``use_pallas`` the two noisy-OR evidence passes run as the fused
-    Pallas kernel over the channel-major transpose (one feature read feeds
-    both products); the propagation core is shared either way.
+    ``kernel`` is the registry's engaged kernel for this shape (static):
+    ``pallas`` runs the evidence passes as the fused Pallas kernel over
+    the channel-major transpose, ``quantized`` runs bf16 evidence +
+    int8-message scans, ``segscan``/``doubling`` arrive as layout
+    pytrees; the propagation core is shared in every case.
 
     The finite-mask sanitize runs first, fused into this same dispatch:
     NaN/Inf rows (poisoned telemetry) zero out on device and the count
@@ -221,7 +276,7 @@ def _propagate_ranked(
         features, edges, anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, n_live=n_live,
         up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-        error_contrast=error_contrast, use_pallas=use_pallas,
+        error_contrast=error_contrast, kernel=kernel, dbl=dbl,
     )
     vals, idx = jax.lax.top_k(score, k)
     stacked = jnp.stack([a, u, m, score])
@@ -232,20 +287,20 @@ def _ranked_lanes(
     features_b, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live, up_ell, down_seg, up_seg, error_contrast: float,
+    kernel: str = "xla", dbl=None,
 ):
     """The traced per-lane body shared by the full and delta batched
     executables: vmap of the propagation + per-hypothesis top-k + the
     [4, k] diagnostic gather.  One definition so the serving dispatcher's
     delta path cannot drift from the full-staging executable it must stay
     bit-identical to."""
-    from rca_tpu.engine.propagate import propagate
 
     def one(f):
-        a, h, u, m, score = propagate(
-            f, edges[0], edges[1], anomaly_w, hard_w,
+        a, h, u, m, score = propagate_auto(
+            f, edges, anomaly_w, hard_w,
             steps, decay, explain_strength, impact_bonus, n_live=n_live,
             up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-            error_contrast=error_contrast,
+            error_contrast=error_contrast, kernel=kernel, dbl=dbl,
         )
         vals, idx = jax.lax.top_k(score, k)
         stacked = jnp.stack([a, u, m, score])
@@ -258,14 +313,14 @@ def _ranked_lanes(
     jax.jit,
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
-        "error_contrast",
+        "error_contrast", "kernel",
     ),
 )
 def _propagate_ranked_batch(
     features_b, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live=None, up_ell=None, down_seg=None, up_seg=None,
-    error_contrast: float = 0.0,
+    error_contrast: float = 0.0, kernel: str = "xla", dbl=None,
 ):
     """Hypothesis batch over ONE graph in ONE dispatch: vmap of the
     propagation + per-hypothesis top-k (BASELINE.json "pmap over fault
@@ -278,6 +333,7 @@ def _propagate_ranked_batch(
         features_b, edges, anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, k,
         n_live, up_ell, down_seg, up_seg, error_contrast,
+        kernel=kernel, dbl=dbl,
     )
     return stacked, diag, vals, idx, n_bad
 
@@ -286,14 +342,14 @@ def _propagate_ranked_batch(
     jax.jit,
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
-        "error_contrast",
+        "error_contrast", "kernel",
     ),
 )
 def _propagate_ranked_batch_delta(
     base, idx_b, rows_b, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live=None, up_ell=None, down_seg=None, up_seg=None,
-    error_contrast: float = 0.0,
+    error_contrast: float = 0.0, kernel: str = "xla", dbl=None,
 ):
     """Delta-staged hypothesis batch (ISSUE 6): each lane is the resident
     base feature buffer plus that request's changed rows, scattered on
@@ -311,6 +367,7 @@ def _propagate_ranked_batch_delta(
         features_b, edges, anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, k,
         n_live, up_ell, down_seg, up_seg, error_contrast,
+        kernel=kernel, dbl=dbl,
     )
     return stacked, diag, vals, idx, n_bad
 
@@ -674,16 +731,15 @@ class GraphEngine(EngineAPI):
                 )
         else:
             ej = jnp.asarray(np.stack([s, d]))  # one [2, E] upload
-            down_seg, up_seg, up_ell = coo_layouts_for(
-                f.shape[0], len(s), dep_src, dep_dst
+            # kernel + layouts from the per-shape registry (ISSUE 12/13):
+            # the ONE dispatch seam shared with streaming, resident, and
+            # serve staging — forcing knobs, the autotune, and every
+            # eligibility gate (segscan's old side gate included) live
+            # there
+            plan = kernel_plan(
+                f.shape[0], len(s), dep_src, dep_dst, steps=p.steps
             )
-            from rca_tpu.engine.registry import engaged_kernel
-
-            # combine-kernel choice comes from the per-shape registry
-            # (ISSUE 12): the ONE dispatch seam shared with streaming,
-            # resident, and serve staging — RCA_PALLAS forcing, the
-            # autotune, and the block-divisibility gate all live there
-            use_pallas = engaged_kernel(f.shape[0]) == "pallas"
+            up_ell, down_seg, up_seg = plan.up_ell, plan.down_seg, plan.up_seg
 
             # AOT compile warming (ISSUE 6 satellite): the timed path's
             # old warmup dispatched the executable and fetched its results
@@ -697,21 +753,21 @@ class GraphEngine(EngineAPI):
                 aot.append(_propagate_ranked.lower(
                     fj, ej, self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus,
-                    kk, use_pallas, n_live, up_ell, down_seg, up_seg,
-                    error_contrast=p.error_contrast,
+                    kk, plan.kernel, n_live, up_ell, down_seg, up_seg,
+                    plan.dbl, error_contrast=p.error_contrast,
                 ).compile())
 
             def run():
                 if aot:
                     return aot[0](
                         fj, ej, self._aw, self._hw, n_live, up_ell,
-                        down_seg, up_seg,
+                        down_seg, up_seg, plan.dbl,
                     )
                 return _propagate_ranked(
                     fj, ej, self._aw, self._hw,
                     p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
-                    use_pallas, n_live, up_ell, down_seg, up_seg,
-                    error_contrast=p.error_contrast,
+                    plan.kernel, n_live, up_ell, down_seg, up_seg,
+                    plan.dbl, error_contrast=p.error_contrast,
                 )
 
         stacked, diag, vals, idx, n_bad, latency_ms = timed_fetch(
@@ -746,18 +802,19 @@ class GraphEngine(EngineAPI):
         fb = np.zeros((B, *f0.shape), np.float32)
         fb[:, :n] = features_batch
         ej = jnp.asarray(np.stack([s, d]))
-        # same layout selection as analyze_arrays
-        down_seg, up_seg, up_ell = coo_layouts_for(
-            f0.shape[0], len(s), dep_src, dep_dst
-        )
         p = self.params
+        # same registry plan as analyze_arrays (the one dispatch seam)
+        plan = kernel_plan(
+            f0.shape[0], len(s), dep_src, dep_dst, steps=p.steps
+        )
         kk = min(k + 8, f0.shape[0])
         t0 = _time.perf_counter()
         stacked, diag, vals, idx, n_bad = _propagate_ranked_batch(
             jnp.asarray(fb), ej, self._aw, self._hw,
             p.steps, p.decay, p.explain_strength, p.impact_bonus, kk,
-            jnp.asarray(n, jnp.int32), up_ell, down_seg, up_seg,
-            error_contrast=p.error_contrast,
+            jnp.asarray(n, jnp.int32), plan.up_ell, plan.down_seg,
+            plan.up_seg, error_contrast=p.error_contrast,
+            kernel=batch_kernel(plan.kernel), dbl=plan.dbl,
         )
         # top-k-sized fetch only: the [B, 4, n_pad] stack stays on device
         # behind each lane's lazy diagnostics (ISSUE 6)

@@ -26,14 +26,17 @@ dst-sorted edges, run as ONE Pallas kernel pass over a VMEM-resident
 Measured 8-step chain at 50k: 12.5 ms (COO scatter) -> 8.4 ms (segscan);
 the residual is the per-step gather, which is shared by every layout.
 
-Engagement: TPU backend only (Mosaic kernel), graphs at or above
-``RCA_SEGSCAN_MIN`` padded nodes (default 1024: the same-session A/B
+Engagement (ISSUE 13): registry-resident.  This module ships the kernel
+and its structural eligibility (:func:`segscan_eligibility` — edge tier
+divisible by 128, under the VMEM cap); :mod:`rca_tpu.engine.registry`
+owns the decision — forcing (``RCA_KERNEL=segscan`` or the legacy
+``RCA_SEGSCAN=1``; ``RCA_SEGSCAN=0`` disables), the TPU +
+``RCA_SEGSCAN_MIN`` auto gate (default 1024: the same-session A/B
 showed segscan winning at EVERY measured tier — 0.63 vs 0.88 ms at 2k,
-1.6 vs 3.5 ms at 5k, 4.3 vs 9.3 ms at 10k, 18.6 vs 47.3 ms at 50k —
-so the floor only spares sub-millisecond micro-graphs the extra kernel
-compile), edge tier divisible by 128.  ``RCA_SEGSCAN=0`` disables;
-``RCA_SEGSCAN=1`` forces it on any eligible tier.  Tests exercise the
-kernel hermetically on CPU via ``SEGSCAN_INTERPRET=1``.
+1.6 vs 3.5 ms at 5k, 4.3 vs 9.3 ms at 10k, 18.6 vs 47.3 ms at 50k — so
+the floor only spares sub-millisecond micro-graphs the extra kernel
+compile), the per-shape timings, and the persisted winner cache.  Tests
+exercise the kernel hermetically on CPU via ``SEGSCAN_INTERPRET=1``.
 """
 
 from __future__ import annotations
@@ -45,7 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rca_tpu.config import env_int, env_str
+from rca_tpu.config import env_str
 
 LANES = 128
 # beyond this edge tier the [R, 128] working set stops fitting VMEM
@@ -246,12 +249,26 @@ def cache_insert(cache: dict, key, value, maxsize: int = _LAYOUT_CACHE_MAX):
 
 
 def seg_layouts_for(n_pad: int, e_pad: int, dep_src, dep_dst):
-    """(down_seg, up_seg) when the segscan layouts are engaged for this
-    tier, else (None, None) — the one gate callers share.  Layouts are
-    cached on the edge-set digest, so repeated analyses of the same graph
-    (the common live/bench pattern) pay the host-side sort once."""
-    if not segscan_engaged(n_pad, e_pad):
+    """(down_seg, up_seg) when the REGISTRY engages segscan for this
+    shape, else (None, None).  ISSUE 13 folded the old ``RCA_SEGSCAN``
+    side gate into the per-shape kernel registry: eligibility (edge-tier
+    divisibility, VMEM cap, ``RCA_SEGSCAN_MIN``), forcing, and the
+    per-shape timing all live in :mod:`rca_tpu.engine.registry` now, so
+    the winner cache, cost analysis, bench ``kernel_registry`` section,
+    and ``rca kernels`` finally see this kernel like any other.  Layouts
+    are cached on the edge-set digest, so repeated analyses of the same
+    graph (the common live/bench pattern) pay the host-side sort once."""
+    from rca_tpu.engine.registry import engaged_kernel
+
+    if engaged_kernel(n_pad, e_pad) != "segscan":
         return None, None
+    return build_seg_layouts(n_pad, e_pad, dep_src, dep_dst)
+
+
+def build_seg_layouts(n_pad: int, e_pad: int, dep_src, dep_dst):
+    """Digest-cached (down_seg, up_seg) build with NO engagement gate —
+    the assembly half :func:`seg_layouts_for` and the registry's timing
+    harness share."""
     src = np.asarray(dep_src)
     dst = np.asarray(dep_dst)
     key = arrays_digest((n_pad, e_pad), (src, dst))
@@ -265,20 +282,19 @@ def seg_layouts_for(n_pad: int, e_pad: int, dep_src, dep_dst):
     return hit
 
 
-def segscan_engaged(n_pad: int, e_pad: int) -> bool:
-    """Static host-side decision per (backend, tier, env).  A forced
-    ``RCA_SEGSCAN=1`` is safe on any backend: off-TPU the kernel runs in
-    interpret mode automatically (:func:`interpret_mode`)."""
-    mode = env_str("RCA_SEGSCAN", "", choices=("0", "1"))
-    if mode == "0":
-        return False
-    if e_pad % LANES or e_pad > MAX_EPAD:
-        return False
-    if env_str("SEGSCAN_INTERPRET", "", choices=("0", "1")) == "1" or mode == "1":
-        return True
-    try:
-        on_tpu = jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
-    min_npad = env_int("RCA_SEGSCAN_MIN", 1024, 0, 2**31 - 1)
-    return on_tpu and n_pad >= min_npad
+def segscan_eligibility(n_pad: int, e_pad):
+    """Structural eligibility at one shape: ``True`` or the decline
+    reason — the registry's segscan hook (:mod:`rca_tpu.engine.registry`
+    owns forcing, the TPU/``RCA_SEGSCAN_MIN`` auto gate, and the
+    decision itself).  A forced segscan is safe on any backend: off-TPU
+    the kernel runs in interpret mode automatically
+    (:func:`interpret_mode`)."""
+    if env_str("RCA_SEGSCAN", "", choices=("0", "1")) == "0":
+        return "RCA_SEGSCAN=0"
+    if e_pad is None:
+        return "edge tier unknown (caller passed no e_pad)"
+    if e_pad % LANES:
+        return f"e_pad {e_pad} not divisible into {LANES}-lane rows"
+    if e_pad > MAX_EPAD:
+        return f"e_pad {e_pad} past the VMEM cap {MAX_EPAD}"
+    return True

@@ -50,14 +50,14 @@ from rca_tpu.engine.runner import GraphEngine, _propagate_ranked
     donate_argnums=(0,),
     static_argnames=(
         "steps", "decay", "explain_strength", "impact_bonus", "k",
-        "error_contrast", "use_pallas",
+        "error_contrast", "kernel",
     ),
 )
 def _flush_propagate_ranked(
     features, idx, rows, edges, anomaly_w, hard_w,
     steps: int, decay: float, explain_strength: float, impact_bonus: float,
     k: int, n_live, up_ell=None, down_seg=None, up_seg=None,
-    error_contrast: float = 0.0, use_pallas: bool = False,
+    error_contrast: float = 0.0, kernel: str = "xla", dbl=None,
 ):
     """Whole tick in ONE dispatch: scatter the delta rows into the donated
     resident buffer, propagate, top-k.  On tunneled TPUs every dispatch pays
@@ -74,14 +74,14 @@ def _flush_propagate_ranked(
 
     features = features.at[idx].set(rows)
     features, n_bad = finite_mask_rows(features)
-    # propagate_auto is the ONE traced propagation body (pallas-vs-XLA
+    # propagate_auto is the ONE traced propagation body (per-kernel
     # branch included) shared with the one-shot and resident executables,
-    # so the combine path cannot drift between the call surfaces
+    # so the engaged kernel cannot drift between the call surfaces
     a, h, u, m, score = propagate_auto(
         features, edges, anomaly_w, hard_w,
         steps, decay, explain_strength, impact_bonus, n_live=n_live,
         up_ell=up_ell, down_seg=down_seg, up_seg=up_seg,
-        error_contrast=error_contrast, use_pallas=use_pallas,
+        error_contrast=error_contrast, kernel=kernel, dbl=dbl,
     )
     vals, topi = jax.lax.top_k(score, k)
     return features, vals, topi, n_bad
@@ -321,27 +321,26 @@ class StreamingSession(StreamingHostState):
         d[: len(dep_dst)] = dep_dst
         # edges + weights + FEATURES live on device for the whole session
         self._edges = jnp.asarray(np.stack([s, d]))
-        # segscan layouts at large tiers (same gate as the one-shot
-        # engine: hybrid default only; replaces the hybrid up-table when
-        # engaged), built once for the session's pinned edges
-        from rca_tpu.engine.runner import coo_layouts_for
+        # kernel + layouts from the per-shape registry (ISSUE 12/13 —
+        # the ONE dispatch seam): the engaged kernel for THIS padded
+        # shape, its layouts built once for the session's pinned edges
+        from rca_tpu.engine.registry import autotune_path
+        from rca_tpu.engine.runner import kernel_plan
 
-        self._down_seg, self._up_seg, self._up_ell = coo_layouts_for(
-            self._n_pad, e_pad, dep_src, dep_dst
+        p = self.engine.params
+        self._plan = kernel_plan(
+            self._n_pad, e_pad, dep_src, dep_dst, steps=p.steps
         )
+        self._down_seg = self._plan.down_seg
+        self._up_seg = self._plan.up_seg
+        self._up_ell = self._plan.up_ell
         self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
         self._kk = min(k + 8, self._n_pad)
-        # combine path from the per-shape kernel registry (ISSUE 12 —
-        # the ONE dispatch seam): per-shape winner for THIS padded shape
-        # plus the process-level compat stamp health records carry
-        from rca_tpu.engine.registry import autotune_path, engaged_kernel
-
+        # process-level compat stamp health records carry
         self.noisyor_path = autotune_path()
-        # the ENGAGED path for THIS padded shape (the autotune choice
-        # plus the block-divisibility gate) — health records and span
-        # attributes carry it so a pallas regression names a shape
-        self.kernel_path = engaged_kernel(self._n_pad)
-        self._use_pallas = self.kernel_path == "pallas"
+        # the ENGAGED kernel for THIS padded shape — health records and
+        # span attributes carry it so a kernel regression names a shape
+        self.kernel_path = self._plan.kernel
         self._init_host_state(clock)
 
     def set_all(self, features: np.ndarray) -> None:
@@ -372,7 +371,7 @@ class StreamingSession(StreamingHostState):
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
                 self._kk, self._n_live, self._up_ell, self._down_seg,
                 self._up_seg, error_contrast=p.error_contrast,
-                use_pallas=self._use_pallas,
+                kernel=self._plan.kernel, dbl=self._plan.dbl,
             )
             # only drop the deltas once the dispatch is accepted — a raise
             # above (fresh-tier compile failure) must leave them retryable
@@ -385,8 +384,8 @@ class StreamingSession(StreamingHostState):
                 self._features, self._edges,
                 self.engine._aw, self.engine._hw,
                 p.steps, p.decay, p.explain_strength, p.impact_bonus,
-                self._kk, self._use_pallas, self._n_live, self._up_ell,
-                self._down_seg, self._up_seg,
+                self._kk, self._plan.kernel, self._n_live, self._up_ell,
+                self._down_seg, self._up_seg, self._plan.dbl,
                 error_contrast=p.error_contrast,
             )
         now = self._clock()

@@ -197,11 +197,15 @@ def build_sharded_seg_layouts(graph: ShardedGraph) -> ShardedSegLayouts:
 
 def sharded_seg_layouts_for(graph: ShardedGraph) -> Optional[ShardedSegLayouts]:
     """Engagement gate + builder: the sharded twin of
-    :func:`rca_tpu.engine.segscan.seg_layouts_for`, sharing its decision
-    (backend, ``RCA_SEGSCAN``, per-shard edge tier divisible by 128)."""
-    from rca_tpu.engine.segscan import segscan_engaged
+    :func:`rca_tpu.engine.segscan.seg_layouts_for`.  The decision lives
+    in the per-shape kernel registry's SHARDED row (ISSUE 13 — backend,
+    ``RCA_SEGSCAN``/``RCA_KERNEL`` forcing, per-shard edge tier
+    divisible by 128), so ``rca kernels`` and bench show the sharded
+    engagement like any dense row."""
+    from rca_tpu.engine.registry import engaged_kernel
 
-    if not segscan_engaged(graph.n_pad, graph.src_local.shape[1]):
+    if engaged_kernel(graph.n_pad, graph.src_local.shape[1],
+                      sharded=True) != "segscan":
         return None
     return build_sharded_seg_layouts(graph)
 

@@ -163,11 +163,14 @@ class ShardedStreamingSession(StreamingHostState):
         )
         # the sharded per-block kernel keeps XLA's fused noisy-OR (the
         # Pallas pair kernel has no shard_map twin); the registry's
-        # sharded row records it so the kernel table shows the shape ran
+        # sharded row records xla — or segscan, when the per-block twin
+        # engaged above — so the kernel table shows the shape ran
         from rca_tpu.engine.registry import engaged_kernel
 
         self.noisyor_path = "xla"
-        self.kernel_path = engaged_kernel(self._n_pad, sharded=True)
+        self.kernel_path = engaged_kernel(
+            self._n_pad, graph.src_local.shape[1], sharded=True,
+        )
         self._feat_sharding = NamedSharding(self.mesh, P("sp", None))
         self._features = jax.device_put(
             jnp.zeros((self._n_pad, num_features), jnp.float32),

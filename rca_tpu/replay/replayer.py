@@ -180,10 +180,25 @@ def _replay_session(rec: Recording, source: ReplaySource, engine: Any,
     )
 
 
+def _tick_diverged(recorded: dict, delivered: List[dict],
+                   parity: str) -> bool:
+    """One tick's parity verdict.  ``exact`` is the bitwise digest gate;
+    ``rank`` (ISSUE 13) judges hit@1/hit@3 + Kendall-tau instead — the
+    gate mode that makes the quantized kernel replayable (its scores
+    move in the 4th decimal; its RANKING must not)."""
+    if parity == "rank":
+        from rca_tpu.engine.quantized import rank_parity
+
+        return not rank_parity(
+            recorded.get("ranked") or [], delivered
+        )["ok"]
+    return digest_obj(delivered) != recorded["ranked_digest"]
+
+
 def _run_stream(rec: Recording, engine: Any = None,
                 pipeline_depth: Optional[int] = None,
                 upto: Optional[int] = None,
-                compare: bool = True) -> _StreamRun:
+                compare: bool = True, parity: str = "exact") -> _StreamRun:
     info = rec.session_info
     depth = (
         int(info.get("pipeline_depth", 1)) if pipeline_depth is None
@@ -203,9 +218,7 @@ def _run_stream(rec: Recording, engine: Any = None,
         out = session.poll()
         delivered[t] = out["ranked"]
         unconsumed += source.unconsumed()
-        if compare and digest_obj(out["ranked"]) != (
-            rec.ticks[t]["ranked_digest"]
-        ):
+        if compare and _tick_diverged(rec.ticks[t], out["ranked"], parity):
             mismatched.append(t)
     return _StreamRun(session=session, delivered=delivered,
                       mismatched=mismatched, unconsumed_calls=unconsumed)
@@ -227,14 +240,22 @@ def replay_stream(
     pipeline_depth: Optional[int] = None,
     seek: Optional[int] = None,
     ticks: Optional[int] = None,
+    parity: str = "exact",
 ) -> Dict[str, Any]:
-    """Replay a stream recording and score per-tick bit-identity.
+    """Replay a stream recording and score per-tick parity.
+
+    ``parity`` picks the gate mode: ``exact`` (the default bitwise
+    digest claim) or ``rank`` (hit@1/hit@3 + Kendall-tau per tick —
+    ISSUE 13's first-class gate for the quantized kernel, whose scores
+    legitimately move in the low decimals while its ranking must not).
 
     ``seek`` replays up to that tick (time travel) and attaches its full
     detail (both rankings, feature digests/rows) to the report.  When the
     replay depth differs from the recorded one, per-tick delivered
     rankings legitimately shift by the lag difference, so the report
     compares the lag-stripped SERIAL sequences instead."""
+    if parity not in ("exact", "rank"):
+        raise ValueError(f"parity={parity!r}: expected 'exact' or 'rank'")
     rec = load_recording(path)
     if rec.mode != "stream":
         raise ValueError(f"{path}: {rec.mode!r} recording; use replay_serve")
@@ -245,9 +266,10 @@ def replay_stream(
     if ticks is not None:
         upto = min(ticks, upto) if upto is not None else ticks
     run = _run_stream(rec, engine=engine, pipeline_depth=depth, upto=upto,
-                      compare=(depth == rec_depth))
+                      compare=(depth == rec_depth), parity=parity)
     report: Dict[str, Any] = {
         "mode": "stream",
+        "parity_mode": parity,
         "recording": rec.path,
         "ticks_recorded": len(rec.ticks),
         "ticks_replayed": len(run.delivered),
@@ -275,12 +297,19 @@ def replay_stream(
         )
         replayed_serial = _serial_sequence(run.delivered, depth)
         n = min(len(recorded_serial), len(replayed_serial))
-        first = next(
-            (i for i in range(n)
-             if digest_obj(recorded_serial[i]) != digest_obj(
-                 replayed_serial[i])),
-            None,
-        )
+        if parity == "rank":
+            from rca_tpu.engine.quantized import rank_parity
+
+            def same(i):
+                return rank_parity(
+                    recorded_serial[i], replayed_serial[i]
+                )["ok"]
+        else:
+            def same(i):
+                return digest_obj(recorded_serial[i]) == digest_obj(
+                    replayed_serial[i]
+                )
+        first = next((i for i in range(n) if not same(i)), None)
         report["serial_ticks_compared"] = n
         report["parity_ok"] = first is None and run.unconsumed_calls == 0
         report["first_divergent_serial"] = first

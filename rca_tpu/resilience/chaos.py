@@ -220,6 +220,7 @@ def run_chaos_soak(
     record_path: Optional[str] = None,
     pipeline_depth: Optional[int] = None,
     replay_check: bool = True,
+    parity_mode: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Run ``ticks`` polls of a :class:`LiveStreamingSession` over a
     chaos-wrapped mock world and score the resilience contract:
@@ -239,9 +240,27 @@ def run_chaos_soak(
     replaying its own recording through a fresh engine and asserting
     tick-for-tick bit-identity (``summary["replay"]``): a chaos run is
     thereby a durable regression artifact, not a one-shot.
+
+    ``parity_mode`` picks the fault-free parity gate: ``exact`` (bitwise
+    rankings, the default) or ``rank`` (hit@1/hit@3 + Kendall-tau,
+    ISSUE 13's first-class gate mode).  ``None`` auto-selects: ``rank``
+    when the registry forces the quantized kernel (whose scores move in
+    the low decimals by design), ``exact`` otherwise — so
+    ``RCA_KERNEL=quantized rca chaos`` gates out of the box.
     """
     from rca_tpu.cluster.mock_client import MockClusterClient
     from rca_tpu.engine.live import LiveStreamingSession
+
+    if parity_mode is None:
+        from rca_tpu.engine.registry import forced_kernel
+
+        parity_mode = (
+            "rank" if forced_kernel() == "quantized" else "exact"
+        )
+    if parity_mode not in ("exact", "rank"):
+        raise ValueError(
+            f"parity_mode={parity_mode!r}: expected 'exact' or 'rank'"
+        )
 
     make_engine = engine_factory or (lambda: None)
 
@@ -250,7 +269,8 @@ def run_chaos_soak(
         engine=make_engine(), topology_check_every=topology_check_every,
         pipeline_depth=pipeline_depth,
     )
-    baseline_ranked = json.dumps(base.poll()["ranked"], sort_keys=True)
+    baseline_list = base.poll()["ranked"]
+    baseline_ranked = json.dumps(baseline_list, sort_keys=True)
 
     recorder = None
     if record_path is not None:
@@ -314,8 +334,13 @@ def run_chaos_soak(
             dirty = False  # a clean full capture restored ground truth
         if not faulted and not dirty and not out.get("degraded"):
             parity_checked += 1
-            ranked = json.dumps(out["ranked"], sort_keys=True)
-            if ranked != baseline_ranked:
+            if parity_mode == "rank":
+                from rca_tpu.engine.quantized import rank_parity
+
+                if not rank_parity(baseline_list, out["ranked"])["ok"]:
+                    parity_ok = False
+            elif json.dumps(out["ranked"], sort_keys=True) != (
+                    baseline_ranked):
                 parity_ok = False
     replay_summary = None
     if recorder is not None:
@@ -330,7 +355,8 @@ def run_chaos_soak(
             # the log just written and demand bit-identical rankings
             from rca_tpu.replay import replay_stream
 
-            report = replay_stream(record_path, engine=make_engine())
+            report = replay_stream(record_path, engine=make_engine(),
+                                   parity=parity_mode)
             replay_summary.update({
                 "parity_ok": report["parity_ok"],
                 "first_divergent_tick": report.get("first_divergent_tick"),
@@ -367,5 +393,6 @@ def run_chaos_soak(
         "resyncs_expired": getattr(live, "resyncs_expired", 0),
         "resyncs_topology": getattr(live, "resyncs_topology", 0),
         "parity_ticks_checked": parity_checked,
+        "parity_mode": parity_mode,
         "parity_ok": parity_ok,
     }

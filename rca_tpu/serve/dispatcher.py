@@ -60,6 +60,7 @@ class _PreparedGraph:
     down_seg: object = None
     up_seg: object = None
     up_ell: object = None
+    dbl: object = None            # engine.doubling.DoublingLayout
     n_live: object = None
     sharded_graph: object = None  # ShardedGraph (sharded engine)
     kk: int = 0
@@ -70,9 +71,9 @@ class _PreparedGraph:
     base_host: object = None      # np [n_pad, C] raw mirror (diff base)
     base_dev: object = None       # device [n_pad, C]
     base_clean: bool = False
-    # the combine kernel THIS padded shape engages (ISSUE 11 satellite:
-    # xla | pallas per shape, not per round) — stamped into dispatch
-    # span attributes so a pallas regression names a shape bucket
+    # the kernel THIS padded shape engages (ISSUE 11/13: a KERNELS
+    # member per shape, not per round) — stamped into dispatch span
+    # attributes so a kernel regression names a shape bucket
     kernel: str = "xla"
 
 
@@ -173,15 +174,17 @@ class BatchDispatcher:
                 n=n, n_pad=graph.n_pad, n_edges=len(req.dep_src),
                 sharded_graph=graph,
                 kk=min(K_CAP + 8, graph.n_pad),
-                # the registry's sharded row: always XLA (no shard_map
-                # twin of the Pallas pair), recorded so the table shows
+                # the registry's sharded row (xla, or segscan when the
+                # per-block twin engages), recorded so the table shows
                 # the shape was served
-                kernel=engaged_kernel(graph.n_pad, sharded=True),
+                kernel=engaged_kernel(
+                    graph.n_pad, graph.src_local.shape[1], sharded=True,
+                ),
             )
         else:
             import jax.numpy as jnp
 
-            from rca_tpu.engine.runner import coo_layouts_for
+            from rca_tpu.engine.runner import kernel_plan
 
             cfg = self.engine.config
             n_pad = bucket_for(n + 1, cfg.shape_buckets)
@@ -191,18 +194,19 @@ class BatchDispatcher:
             d = np.full(e_pad, dummy, np.int32)
             s[: len(req.dep_src)] = req.dep_src
             d[: len(req.dep_dst)] = req.dep_dst
-            down_seg, up_seg, up_ell = coo_layouts_for(
-                n_pad, e_pad, req.dep_src, req.dep_dst
+            # kernel + layouts from the one dispatch seam (ISSUE 12/13)
+            plan = kernel_plan(
+                n_pad, e_pad, req.dep_src, req.dep_dst,
+                steps=self.engine.params.steps,
             )
-            from rca_tpu.engine.registry import engaged_kernel
-
             gs = _PreparedGraph(
                 n=n, n_pad=n_pad, n_edges=len(req.dep_src),
                 edges_j=jnp.asarray(np.stack([s, d])),
-                down_seg=down_seg, up_seg=up_seg, up_ell=up_ell,
+                down_seg=plan.down_seg, up_seg=plan.up_seg,
+                up_ell=plan.up_ell, dbl=plan.dbl,
                 n_live=jnp.asarray(n, jnp.int32),
                 kk=min(K_CAP + 8, n_pad),
-                kernel=engaged_kernel(n_pad),
+                kernel=plan.kernel,
             )
         evictions = 0
         with self._graphs_lock:
@@ -312,7 +316,7 @@ class BatchDispatcher:
         this graph can go delta."""
         import jax.numpy as jnp
 
-        from rca_tpu.engine.runner import _propagate_ranked_batch
+        from rca_tpu.engine.runner import _propagate_ranked_batch, batch_kernel
 
         fb = np.zeros(
             (b_pad, gs.n_pad, batch[0].features.shape[1]), np.float32
@@ -326,6 +330,7 @@ class BatchDispatcher:
             p.steps, p.decay, p.explain_strength, p.impact_bonus,
             gs.kk, gs.n_live, gs.up_ell, gs.down_seg, gs.up_seg,
             error_contrast=p.error_contrast,
+            kernel=batch_kernel(gs.kernel), dbl=gs.dbl,
         )
         gs.base_host = fb[0].copy()
         gs.base_dev = jnp.asarray(gs.base_host)
@@ -345,7 +350,10 @@ class BatchDispatcher:
         (and whole pad lanes) aim zero rows at the dummy row."""
         import jax.numpy as jnp
 
-        from rca_tpu.engine.runner import _propagate_ranked_batch_delta
+        from rca_tpu.engine.runner import (
+            _propagate_ranked_batch_delta,
+            batch_kernel,
+        )
 
         C = batch[0].features.shape[1]
         u_max = max((len(d) for d in deltas), default=0)
@@ -366,6 +374,7 @@ class BatchDispatcher:
             p.steps, p.decay, p.explain_strength, p.impact_bonus,
             gs.kk, gs.n_live, gs.up_ell, gs.down_seg, gs.up_seg,
             error_contrast=p.error_contrast,
+            kernel=batch_kernel(gs.kernel), dbl=gs.dbl,
         )
 
     def fetch(self, handle: BatchHandle) -> List[object]:

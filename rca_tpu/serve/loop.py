@@ -152,7 +152,7 @@ class ServeLoop:
         rows — rendered by ``/metrics`` and the selftest summary.  Cost
         analysis is exported only where already captured; a metrics
         scrape never triggers a compile."""
-        from rca_tpu.engine.registry import kernel_table
+        from rca_tpu.engine.registry import kernel_set_hash, kernel_table
         from rca_tpu.observability.kernelscope import sample_device_memory
 
         out = dict(self.recompile_monitor.snapshot())
@@ -160,6 +160,10 @@ class ServeLoop:
             sample_device_memory() if out["enabled"] else None
         )
         out["kernel_registry"] = kernel_table()
+        # the grown kernel-set source hash (ISSUE 13): the winner-cache
+        # invalidation key, exported so a scrape can tell WHICH kernel
+        # set a plane's rows were timed under
+        out["kernel_set"] = kernel_set_hash()
         return out
 
     def __enter__(self) -> "ServeLoop":

@@ -150,7 +150,7 @@ class ServePool:
         """Pool twin of :meth:`rca_tpu.serve.loop.ServeLoop.
         kernelscope_summary`: recompile counts + a device-memory sample
         + the live kernel-registry rows."""
-        from rca_tpu.engine.registry import kernel_table
+        from rca_tpu.engine.registry import kernel_set_hash, kernel_table
         from rca_tpu.observability.kernelscope import sample_device_memory
 
         out = dict(self.recompile_monitor.snapshot())
@@ -158,6 +158,10 @@ class ServePool:
             sample_device_memory() if out["enabled"] else None
         )
         out["kernel_registry"] = kernel_table()
+        # the grown kernel-set source hash (ISSUE 13): the winner-cache
+        # invalidation key, exported so a scrape can tell WHICH kernel
+        # set a plane's rows were timed under
+        out["kernel_set"] = kernel_set_hash()
         return out
 
     def stop(self, timeout: float = 10.0) -> None:

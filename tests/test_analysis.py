@@ -232,18 +232,23 @@ def handle(tracer, ctx):
     return sp, raw
 """, 2),
     "kernel-dispatch": ("rca_tpu/engine/bad_dispatch.py", """\
+from rca_tpu.engine.doubling import doubling_layouts_for
 from rca_tpu.engine.pallas_kernels import (
     noisy_or_pair_pallas,
     noisyor_autotune,
 )
+from rca_tpu.engine.quantized import quant_imp_step
 
 
-def tick(ft, w):
+def tick(ft, w, m, a_ex, src, dst, inv_deg):
     # re-deriving the kernel choice locally bypasses the registry seam
     if noisyor_autotune() == "pallas":
         return noisy_or_pair_pallas(ft, w, w)
-    return None
-""", 2),
+    # the NEW kernels' bodies are seam-guarded too (ISSUE 13): calling
+    # them outside engine/{quantized,doubling}.py is unlandable
+    dbl = doubling_layouts_for(64, 64, src, dst, 8)
+    return quant_imp_step(m, a_ex, 0.7, src, dst, inv_deg), dbl
+""", 4),
 }
 
 

@@ -53,12 +53,60 @@ def test_cpu_rows_default_to_xla_without_timing():
 
 
 def test_forced_flag_and_sharded_rows(monkeypatch):
+    # RCA_PALLAS=0 marks pallas ineligible (the row records why); the
+    # CPU short-circuit still decides the winner
     monkeypatch.setenv("RCA_PALLAS", "0")
     row = reg_mod.get_registry().resolve(1024)
-    assert (row.winner, row.source) == ("xla", "forced")
+    assert (row.winner, row.source) == ("xla", "cpu-default")
+    assert row.eligible["pallas"] == "RCA_PALLAS=0"
     sharded = reg_mod.get_registry().resolve(2048, sharded=True)
     assert (sharded.winner, sharded.source) == ("xla", "sharded")
     assert "shard_map" in sharded.eligible["pallas"]
+    assert "shard_map" in sharded.eligible["quantized"]
+    assert "shard_map" in sharded.eligible["doubling"]
+
+
+def test_grown_kernel_set_and_forced_rows(monkeypatch):
+    """ISSUE 13 acceptance: KERNELS has >= 5 members; RCA_KERNEL forces
+    any of them per shape (eligibility permitting), and the row records
+    WHY an ineligible candidate never raced."""
+    assert len(reg_mod.KERNELS) >= 5
+    assert {"xla", "pallas", "segscan", "quantized", "doubling"} <= set(
+        reg_mod.KERNELS
+    )
+    monkeypatch.setenv("RCA_KERNEL", "quantized")
+    row = reg_mod.get_registry().resolve(1024, e_pad=2048)
+    assert (row.winner, row.source) == ("quantized", "forced")
+    monkeypatch.setenv("RCA_KERNEL", "doubling")
+    row = reg_mod.get_registry().resolve(1024, e_pad=2048)
+    assert (row.winner, row.source) == ("doubling", "forced")
+    monkeypatch.setenv("RCA_KERNEL", "segscan")
+    row = reg_mod.get_registry().resolve(1024, e_pad=2048)
+    assert (row.winner, row.source) == ("segscan", "forced")
+    # ineligible force: segscan needs a 128-divisible edge tier
+    row = reg_mod.get_registry().resolve(64, e_pad=64)
+    assert (row.winner, row.source) == ("xla", "ineligible")
+    assert "128" in row.eligible["segscan"]
+    # without an edge tier, edge-layout kernels cannot race
+    monkeypatch.delenv("RCA_KERNEL")
+    row = reg_mod.get_registry().resolve(512)
+    assert "e_pad" in row.eligible["segscan"]
+    assert "e_pad" in row.eligible["quantized"]
+
+
+def test_legacy_segscan_knobs_map_to_registry(monkeypatch):
+    """RCA_SEGSCAN=1 / SEGSCAN_INTERPRET=1 force the segscan row;
+    RCA_SEGSCAN=0 records ineligibility (knob unification, ISSUE 13)."""
+    monkeypatch.setenv("SEGSCAN_INTERPRET", "1")
+    row = reg_mod.get_registry().resolve(512, e_pad=512)
+    assert (row.winner, row.source) == ("segscan", "forced")
+    monkeypatch.setenv("RCA_SEGSCAN", "0")
+    row = reg_mod.get_registry().resolve(512, e_pad=512)
+    assert row.winner == "xla"
+    assert row.eligible["segscan"] == "RCA_SEGSCAN=0"
+    monkeypatch.setenv("RCA_SEGSCAN", "1")
+    row = reg_mod.get_registry().resolve(512, e_pad=512)
+    assert (row.winner, row.source) == ("segscan", "forced")
 
 
 def test_engaged_kernel_matches_table_by_construction():
@@ -110,9 +158,9 @@ def _accelerated(monkeypatch, timings):
     monkeypatch.setattr(pk, "pallas_supported", lambda: True)
     calls = {"n": 0}
 
-    def fake_time(n_pad, reps=200):
+    def fake_time(n_pad, e_pad, steps, candidates):
         calls["n"] += 1
-        return dict(timings)
+        return {k: v for k, v in timings.items() if k in candidates}
 
     monkeypatch.setattr(reg_mod, "_time_candidates", fake_time)
     return calls

@@ -20,6 +20,13 @@ hardware is the operator's judgment call (the report prints both
 values so the call is informed).  Baseline files may be a raw bench
 line or the driver's wrapper (``{"parsed": <line>, ...}``).
 
+ISSUE 13 adds the KERNEL TABLE guard: when a shape row's engaged kernel
+flips vs the last committed round's ``kernel_registry`` section without
+a recorded >10% timing win for the new winner, the guard fails — the
+exact failure mode being autotune noise landing as a silent kernel
+regression.  Only autotuned rows (source ``timed``/``cache``) are
+compared: forced/cpu-default rows flip legitimately with the env.
+
 Usage::
 
     python bench.py --skip-accuracy > line.json
@@ -45,6 +52,10 @@ HEADLINE_METRICS = {
 }
 
 DEFAULT_THRESHOLD = 0.15
+
+#: a kernel winner flip must be backed by at least this fractional
+#: timing win for the new winner, or the flip reads as autotune noise
+KERNEL_FLIP_WIN = 0.10
 
 _BENCH_FILE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -90,10 +101,71 @@ def latest_baseline(root: str) -> Tuple[Optional[str], Optional[Dict]]:
     return None, None
 
 
+def _kernel_rows(line: Dict[str, Any]) -> Dict[tuple, Dict[str, Any]]:
+    """Autotuned ``kernel_registry`` rows keyed by shape.  Forced and
+    cpu-default rows are excluded — they flip legitimately when the env
+    or host changes; the guard targets AUTOTUNE flips."""
+    rows = line.get("kernel_registry")
+    out: Dict[tuple, Dict[str, Any]] = {}
+    if not isinstance(rows, list):
+        return out
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        if row.get("source") not in ("timed", "cache"):
+            continue
+        key = (row.get("variant"), row.get("n_pad"), row.get("e_pad"))
+        out[key] = row
+    return out
+
+
+def kernel_guard(current: Dict[str, Any], baseline: Dict[str, Any],
+                 win_threshold: float = KERNEL_FLIP_WIN) -> Dict[str, Any]:
+    """Winner-flip gate over the kernel table (ISSUE 13 satellite):
+    a shape whose engaged kernel changed vs the last committed round
+    must carry a recorded timing win of more than ``win_threshold`` for
+    the new winner over the old one IN THE CURRENT ROW's timings —
+    otherwise the flip is indistinguishable from autotune noise and the
+    guard fails.  Shapes missing on either side are skipped (new tiers,
+    different hosts)."""
+    cur = _kernel_rows(current)
+    base = _kernel_rows(baseline)
+    flips = []
+    ok = True
+    for key, row in cur.items():
+        old = base.get(key)
+        if old is None or row.get("winner") == old.get("winner"):
+            continue
+        timings = row.get("timings_ms") or {}
+        t_new = timings.get(row.get("winner"))
+        t_old = timings.get(old.get("winner"))
+        justified = (
+            isinstance(t_new, (int, float))
+            and isinstance(t_old, (int, float))
+            and t_old > 0
+            and t_new < (1.0 - win_threshold) * t_old
+        )
+        if not justified:
+            ok = False
+        flips.append({
+            "variant": key[0], "n_pad": key[1], "e_pad": key[2],
+            "winner_was": old.get("winner"), "winner_now": row.get("winner"),
+            "t_now_ms": t_new, "t_was_kernel_ms": t_old,
+            "status": "justified" if justified else "unjustified-flip",
+        })
+    return {
+        "ok": ok,
+        "compared": len(set(cur) & set(base)),
+        "win_threshold_pct": round(win_threshold * 100.0, 1),
+        "flips": flips,
+    }
+
+
 def compare(current: Dict[str, Any], baseline: Dict[str, Any],
             threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
     """Per-metric regression report.  ``ok`` is False iff any headline
-    metric is more than ``threshold`` WORSE (higher) than baseline."""
+    metric is more than ``threshold`` WORSE (higher) than baseline, or
+    the kernel table records an unjustified winner flip."""
     metrics: Dict[str, Dict[str, Any]] = {}
     ok = True
     for name, path in HEADLINE_METRICS.items():
@@ -117,11 +189,16 @@ def compare(current: Dict[str, Any], baseline: Dict[str, Any],
             "baseline": round(float(base), 3),
             "change_pct": round(change * 100.0, 1),
         }
-    return {
+    report = {
         "ok": ok,
         "threshold_pct": round(threshold * 100.0, 1),
         "metrics": metrics,
     }
+    kg = kernel_guard(current, baseline)
+    if kg["compared"] or kg["flips"]:
+        report["kernel_table"] = kg
+        report["ok"] = report["ok"] and kg["ok"]
+    return report
 
 
 def check_line(current: Dict[str, Any], root: str,

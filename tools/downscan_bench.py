@@ -187,11 +187,153 @@ def marginal_chain_ms(make, m0, reps=8, outer=8):
     return float("nan")
 
 
+# ---------------------------------------------------------------------------
+# registry kernel A/B (ISSUE 13 satellite): the full propagation chain
+# under EVERY registry kernel, per tier — the table PERF.md round 13
+# cites and bench.py's `kernel_ab` section embeds
+# ---------------------------------------------------------------------------
+
+def _kernel_chain_ms(kernel, n_pad, e_pad, case, steps, reps=8):
+    """Amortized full-chain timing for one kernel over the REAL cascade
+    graph at this tier (evidence + both scans via propagate_auto — the
+    same traced body production dispatches), marginal-rep methodology.
+    Returns None when the kernel cannot build/run at this tier."""
+    import jax
+    import jax.numpy as jnp
+
+    from rca_tpu.engine.runner import propagate_auto, up_ell_for
+
+    dummy = n_pad - 1
+    src = np.full(e_pad, dummy, np.int32)
+    dst = np.full(e_pad, dummy, np.int32)
+    src[: len(case.dep_src)] = case.dep_src
+    dst[: len(case.dep_dst)] = case.dep_dst
+    edges = jnp.asarray(np.stack([src, dst]))
+    f = np.zeros((n_pad, case.features.shape[1]), np.float32)
+    f[: case.n] = case.features
+    fj = jnp.asarray(f)
+    from rca_tpu.engine.propagate import default_params
+
+    p = default_params(steps)
+    aw, hw = p.weight_arrays()
+    down_seg = up_seg = up_ell = dbl = None
+    try:
+        if kernel == "segscan":
+            from rca_tpu.engine.segscan import build_seg_layouts
+
+            down_seg, up_seg = build_seg_layouts(
+                n_pad, e_pad, case.dep_src, case.dep_dst
+            )
+        elif kernel == "doubling":
+            from rca_tpu.engine.doubling import build_doubling
+
+            dbl = build_doubling(
+                n_pad, e_pad, case.dep_src, case.dep_dst, steps
+            )
+            if dbl is None:
+                return None  # frontier cap declined this graph
+        else:
+            up_ell = up_ell_for(n_pad, case.dep_src, case.dep_dst)
+
+        def make_many(reps_):
+            @jax.jit
+            def many(x, salt):
+                def body(i, acc):
+                    out = propagate_auto(
+                        x * (1.0 + salt + i * 1e-7), edges, aw, hw,
+                        p.steps, p.decay, p.explain_strength,
+                        p.impact_bonus, up_ell=up_ell, down_seg=down_seg,
+                        up_seg=up_seg, kernel=kernel, dbl=dbl,
+                    )
+                    return acc + out[4]
+                return jax.lax.fori_loop(0, reps_, body, jnp.zeros(n_pad))
+            return many
+
+        def min_total(r):
+            run = make_many(r)
+            jax.device_get(run(fj, jnp.float32(1e-7))[:4])
+            outs = []
+            for j in range(4):
+                salt = jnp.float32((j + 2) * 1e-7)
+                t0 = time.perf_counter()
+                jax.device_get(run(fj, salt)[:4])
+                outs.append((time.perf_counter() - t0) * 1e3)
+            return float(np.min(outs))
+
+        t_r, t_2r = min_total(reps), min_total(2 * reps)
+        if t_2r <= t_r:
+            return None
+        return (t_2r - t_r) / reps
+    except Exception:
+        return None
+
+
+def registry_kernel_ab(tiers=(2_000, 10_000), steps: int = 8,
+                       kernels=None) -> dict:
+    """A/B every registry kernel per tier over the real cascade
+    generator graph.  CPU-host honest: the report stamps the backend
+    AND whether the Pallas kernels ran interpreted — interpret-mode
+    numbers prove mechanics, not speed, and are labeled as such."""
+    import jax
+
+    from rca_tpu.engine.registry import KERNELS
+    from rca_tpu.engine.segscan import interpret_mode
+
+    kernels = tuple(kernels or KERNELS)
+    backend = jax.devices()[0].platform
+    out = {
+        "backend": backend,
+        "pallas_interpreted": bool(interpret_mode()),
+        "steps": steps,
+        "tiers": {},
+    }
+    buckets = RCAConfig().shape_buckets
+    for n in tiers:
+        case = synthetic_cascade_arrays(n, n_roots=3, seed=0)
+        n_pad = bucket_for(n + 1, buckets)
+        e_pad = bucket_for(len(case.dep_src), buckets)
+        timings = {
+            k: _kernel_chain_ms(k, n_pad, e_pad, case, steps)
+            for k in kernels
+        }
+        measured = {k: t for k, t in timings.items() if t is not None}
+        out["tiers"][str(n)] = {
+            "n_pad": n_pad,
+            "e_pad": e_pad,
+            "n_edges": len(case.dep_src),
+            "timings_ms": {
+                k: (round(t, 4) if t is not None else None)
+                for k, t in timings.items()
+            },
+            "fastest": (
+                min(measured, key=measured.get) if measured else None
+            ),
+        }
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=50_000)
     ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--ab", action="store_true",
+                    help="A/B the FULL chain under every registry "
+                    "kernel per tier instead of the step-cost "
+                    "attribution (ISSUE 13)")
+    ap.add_argument("--tiers", default="2000,10000,50000",
+                    help="comma-separated tiers for --ab")
     args = ap.parse_args(argv)
+
+    if args.ab:
+        import json as _json
+
+        tiers = tuple(
+            int(x) for x in args.tiers.split(",") if x.strip()
+        )
+        print(_json.dumps(
+            registry_kernel_ab(tiers=tiers, steps=args.steps), indent=2
+        ))
+        return 0
 
     print(f"backend: {jax.devices()[0].platform} ({jax.devices()[0]})")
     case = synthetic_cascade_arrays(args.n, n_roots=3, seed=0)
