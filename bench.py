@@ -54,6 +54,59 @@ def chaos_metrics(seed: int = 7, ticks: int = 100) -> dict:
     }
 
 
+def attribution_metrics(engine) -> dict:
+    """causelens cost rows (ISSUE 14): what an attribution PASS costs
+    per shape (first call = compile + run, steady = the cached
+    executables), reported from the kernel registry's ``attribution``
+    variant rows so bench, ``rca kernels``, and ``/metrics`` agree by
+    construction.  The explain-OFF overhead claim is cross-round: the
+    default path computes nothing (attribution is lazy), so the
+    explain-off serve p50 rides the bench_guard gate (<5% on
+    ``attribution.explain_off_request_ms_p50``) against the last
+    committed round."""
+    import time as _time
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine.registry import kernel_table
+
+    per_shape = {}
+    for n in (256, 2000):
+        c = synthetic_cascade_arrays(n, n_roots=1, seed=11)
+
+        def one():
+            res = engine.analyze_arrays(
+                c.features, c.dep_src, c.dep_dst, c.names, k=5,
+            )
+            t0 = _time.perf_counter()
+            prov = res.attribution()
+            ms = (_time.perf_counter() - t0) * 1e3
+            return ms, prov
+
+        first_ms, prov = one()
+        steady = min(one()[0] for _ in range(3))
+        block = prov["attribution"]
+        per_shape[str(n)] = {
+            "first_ms": round(first_ms, 3),
+            "steady_ms": round(steady, 3),
+            "k": block["k"], "topm": block["topm"],
+            "reconstruction_err_max": max(
+                (cand["reconstruction_error"]
+                 for cand in block["candidates"]), default=0.0,
+            ),
+        }
+    rows = [
+        {
+            "n_pad": r["n_pad"], "e_pad": r["e_pad"],
+            "winner": r["winner"],
+            "attribution_ms": (r.get("timings_ms") or {}).get(
+                "attribution"
+            ),
+        }
+        for r in kernel_table() if r["variant"] == "attribution"
+    ]
+    return {"per_shape": per_shape, "registry_rows": rows}
+
+
 def replay_metrics(n_services: int = 50, ticks: int = 40) -> dict:
     """Flight-recorder row (ISSUE 5): what recording COSTS (tick-time
     overhead vs an unrecorded twin and log bytes/tick) and what replay
@@ -1493,12 +1546,8 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         pallas_enabled,
         pallas_supported,
     )
-    from rca_tpu.engine.registry import autotune_path, engaged_kernel
+    from rca_tpu.engine.registry import engaged_kernel
 
-    # process-level combine path (the registry's winner at the canonical
-    # shape — ISSUE 12 moved the one-shot autotune into the per-shape
-    # kernel registry; this stamp keeps the bench line comparable)
-    noisyor_choice = autotune_path()
     pallas_ok = pallas_supported()
     aw_j, hw_j = jnp.asarray(aw), jnp.asarray(hw)
     ft = bfj.T  # kernel reads channel-major; bfj is the padded 50k matrix
@@ -1788,6 +1837,18 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
     except Exception as exc:
         observability_line = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- causelens attribution (ISSUE 14): per-shape explain-on cost
+    # (first vs steady) from the registry's attribution rows; the
+    # explain-off serve p50 below feeds bench_guard's tighter 5% gate
+    try:
+        attribution_line = attribution_metrics(engine)
+    except Exception as exc:
+        attribution_line = {"error": f"{type(exc).__name__}: {exc}"}
+    if isinstance(serve_line, dict):
+        attribution_line["explain_off_request_ms_p50"] = serve_line.get(
+            "request_ms_p50"
+        )
+
     # -- columnar world state (ISSUE 10): 100k-pod capture, columnar vs
     # dict sweep, coldiff bytes/tick, bit parity asserted in-run
     try:
@@ -2044,9 +2105,8 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         "segscan_engaged_50k": big_down_seg is not None,
         "pallas_supported": bool(pallas_ok),
         "pallas_engaged": bool(pallas_enabled()),  # reflects RCA_PALLAS env
-        # the measured one-shot autotune choice sessions actually run
-        # (xla | pallas; RCA_PALLAS=1/0 forces, auto times both on TPU)
-        "noisyor_path": noisyor_choice,
+        # (the retired process-level noisyor_path stamp is gone — ISSUE
+        # 14 satellite; kernel_by_shape below says strictly more)
         # per-shape engaged kernel + the full registry rows (ISSUE 12):
         # both derive from engine/registry.py's table, so a pallas
         # regression names a shape AND the row shows why (timings,
@@ -2058,6 +2118,9 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         "kernel_ab": kernel_ab,
         "xla_noisyor_50k_ms": r(xla_nor_ms),
         "pallas_noisyor_50k_ms": r(pallas_nor_ms),
+        # causelens (ISSUE 14): per-shape attribution cost + the
+        # explain-off serve p50 the bench_guard 5% gate compares
+        "attribution": attribution_line,
         # flight recorder: record overhead, log size, replay throughput
         "replay": replay_metrics(),
         # analyzer wall time: lint gates every PR, so it is benched too

@@ -46,6 +46,13 @@ TARGETS = frozenset({
     "doubling_down",
     "doubling_layouts_for",
     "build_doubling",
+    # causelens attribution executables (engine/attribution.py, ISSUE
+    # 14): the counterfactual sweep + gradient saliency re-propagate
+    # through the registry's `attribution` variant — callers go through
+    # compute_attribution / EngineResult.attribution(), never the
+    # executables directly
+    "attribution_sweep",
+    "attribution_saliency",
 })
 
 #: files that ARE the seam (definitions + the registry's own timing/cost)
@@ -88,6 +95,10 @@ class KernelDispatchRule(Rule):
     allow = {
         "rca_tpu/engine/runner.py": {"propagate_auto", "kernel_plan"},
         "rca_tpu/engine/train.py": {"_forward"},
+        # the causelens host wrapper (ISSUE 14): asks the registry's
+        # `attribution` variant first, then invokes the attribution
+        # executables it owns — the one function allowed to call them
+        "rca_tpu/engine/attribution.py": {"compute_attribution"},
     }
 
     def applies_to(self, relpath: str) -> bool:

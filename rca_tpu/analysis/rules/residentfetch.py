@@ -42,6 +42,11 @@ FETCH_SURFACES = {
         "full_diagnostics",   # BULK, deferred: the documented lazy seam
     },
     "rca_tpu/engine/resident.py": {"_fetch_topk"},
+    # causelens (ISSUE 14): compute_attribution fetches the [5,k] diag,
+    # the [m,k] counterfactual deltas, and the [k,P] path arrays — all
+    # top-k/top-m-sized by construction; the masked-score matrix and
+    # the full saliency stay on device
+    "rca_tpu/engine/attribution.py": {"compute_attribution"},
     "rca_tpu/engine/sharded_runner.py": {"analyze_batch"},
     # streaming tick + serve paths (tick-sync's fetch-only contract,
     # restated here with the top-k-size obligation)

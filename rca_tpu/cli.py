@@ -28,7 +28,12 @@
 - ``kernels``   the live per-shape kernel registry table: engaged
                 kernel, autotune timings, and XLA cost analysis per
                 padded shape (engine/registry.py; OBSERVABILITY.md
-                §kernelscope)
+                §kernelscope).  ``--explain`` explains KERNEL dispatch
+                decisions — ranking attributions are ``rca why``
+- ``why``       causelens blame tree for a stored investigation's
+                latest explained ranking: evidence channels → blame
+                edges → ranked service (ISSUE 14; OBSERVABILITY.md
+                §causelens)
 - ``lint``      graftlint static analysis: JAX/TPU-correctness rules +
                 recompile tracecheck (``rca lint --help``; ANALYSIS.md)
 - ``investigations``  list / show persisted investigations
@@ -754,6 +759,7 @@ def cmd_replay(args) -> int:
             seek=args.seek, ticks=args.ticks,
             parity="rank" if getattr(args, "rank_parity", False)
             else "exact",
+            explain=getattr(args, "explain", False),
         )
     print(json.dumps(report, indent=None if args.compact else 2,
                      default=str))
@@ -837,10 +843,15 @@ def cmd_kernels(args) -> int:
     for row in rows:
         cost = row.get("cost") or {}
         timings = row.get("timings_ms") or {}
+        # attribution rows (ISSUE 14) time the whole causelens sweep,
+        # recorded under "attribution" rather than the winner's name
+        t_win = (timings.get("attribution")
+                 if row["variant"] == "attribution"
+                 else timings.get(row["winner"]))
         table.append((
             str(row["n_pad"]), fmt(row.get("e_pad")), row["variant"],
             row["backend"], row["winner"], row["source"],
-            fmt(timings.get("xla")), fmt(timings.get(row["winner"])),
+            fmt(timings.get("xla")), fmt(t_win),
             fmt(cost.get("flops")), fmt(cost.get("bytes_accessed")),
             fmt(cost.get("peak_temp_bytes")),
             fmt(cost.get("output_bytes")),
@@ -851,6 +862,12 @@ def cmd_kernels(args) -> int:
         if i == 0:
             print("  ".join("-" * w for w in widths))
     if getattr(args, "explain", False):
+        # name-collision pointer (ISSUE 14 satellite): this flag
+        # explains KERNEL decisions; ranking attributions live under
+        # `rca why`
+        print("\n(explaining KERNEL dispatch decisions — for RANKING "
+              "attributions / blame trees, see `rca why "
+              "<investigation-id>`)")
         # the full candidate set per shape (ISSUE 13 satellite): the
         # registry records every decision — ineligible candidates name
         # their gate, timed losers show both timings
@@ -883,6 +900,69 @@ def cmd_kernels(args) -> int:
                 else:
                     print(f"  {k:10s} not raced "
                           f"(decision source: {row['source']})")
+    return 0
+
+
+def cmd_why(args) -> int:
+    """``rca why <investigation-id>`` (ISSUE 14): render the stored
+    causelens provenance — the blame tree behind the investigation's
+    latest explained ranking (evidence channels → blame edges → ranked
+    service).  NOT ``rca kernels --explain``, which explains KERNEL
+    dispatch decisions; this explains RANKINGS.
+
+    Provenance lands in the store when an explained analysis names the
+    investigation: a serve/gateway request with ``investigation_id`` +
+    ``explain``, or a correlate run under ``RCA_EXPLAIN=1`` persisted
+    through the chat/analyze flows."""
+    from rca_tpu.observability.causelens import render_blame_tree
+    from rca_tpu.store import InvestigationStore
+
+    store = InvestigationStore(root=args.log_dir)
+    inv = store.get_investigation(args.investigation_id)
+    if inv is None:
+        print(json.dumps(
+            {"error": f"no investigation {args.investigation_id}"}
+        ))
+        return 1
+    provenance = inv.get("provenance")
+    if provenance is None:
+        # fall back to the newest chat turn that carried one
+        for msg in reversed(inv.get("conversation", []) or []):
+            content = msg.get("content")
+            if isinstance(content, dict):
+                rd = content.get("response_data") or {}
+                cand = (
+                    content.get("provenance")
+                    or rd.get("provenance")
+                    or (rd.get("correlated") or {}).get("provenance")
+                )
+                if cand is not None:
+                    provenance = cand
+                    break
+    if provenance is None:
+        hint = {
+            "error": f"investigation {args.investigation_id} carries no "
+            "provenance block",
+            "hint": "serve the analysis with explain=true (wire: "
+            "?explain=1) naming this investigation_id, or run the "
+            "correlate flow with RCA_EXPLAIN=1",
+        }
+        if inv.get("recording_ref"):
+            hint["recording_ref"] = inv["recording_ref"]
+            hint["hint"] += (
+                "; the investigation has a recording — `rca replay "
+                "--explain` can recompute attributions from the tape "
+                "when it was recorded with RCA_EXPLAIN=1"
+            )
+        print(json.dumps(hint, indent=None if args.compact else 2))
+        return 1
+    if args.json:
+        print(json.dumps(provenance,
+                         indent=None if args.compact else 2))
+        return 0
+    print(f"investigation {args.investigation_id} · "
+          f"{inv.get('title', '')}".rstrip(" ·"))
+    print(render_blame_tree(provenance))
     return 0
 
 
@@ -1233,6 +1313,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="judge ticks by hit@1/hit@3 + Kendall-tau "
                     "instead of bitwise digests (ISSUE 13: the gate "
                     "mode that makes the quantized kernel replayable)")
+    sp.add_argument("--explain", action="store_true",
+                    help="causelens parity leg (ISSUE 14): recompute "
+                    "per-tick attribution blocks from the tape and "
+                    "REQUIRE their digests to match the live-recorded "
+                    "ones (needs a recording made with RCA_EXPLAIN=1; "
+                    "digests present in the log are compared even "
+                    "without this flag)")
     sp.add_argument("--investigation", default=None, metavar="ID",
                     help="resolve the recording from this stored "
                     "investigation's recording_ref")
@@ -1277,9 +1364,11 @@ def build_parser() -> argparse.ArgumentParser:
                     "edge tier gates the segscan/quantized/doubling "
                     "candidates")
     sp.add_argument("--explain", action="store_true",
-                    help="per shape, print WHY each non-winning kernel "
+                    help="per shape, print WHY each non-winning KERNEL "
                     "was declined: the eligibility reason, or the "
-                    "timing it lost with (ISSUE 13)")
+                    "timing it lost with (ISSUE 13).  Explains kernel "
+                    "dispatch decisions only — RANKING attributions "
+                    "(blame trees) live under `rca why`")
     sp.add_argument("--no-cost", action="store_true", dest="no_cost",
                     help="skip XLA cost analysis (cost capture compiles "
                     "the canonical executable once per shape)")
@@ -1301,6 +1390,23 @@ def build_parser() -> argparse.ArgumentParser:
         add_help=False,  # every flag (incl. --help) goes to the analyzer
     )
     sp.set_defaults(fn=cmd_lint, lint_args=[])
+
+    sp = sub.add_parser(
+        "why",
+        help="render an investigation's causelens blame tree: which "
+        "evidence channels, dependency edges, and counterfactual rows "
+        "produced its ranking (ISSUE 14; kernel DISPATCH decisions are "
+        "`rca kernels --explain`)",
+    )
+    sp.add_argument("investigation_id",
+                    help="stored investigation id (see `rca "
+                    "investigations`)")
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw provenance block instead of the "
+                    "ASCII tree")
+    sp.add_argument("--log-dir", default="logs")
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_why)
 
     sp = sub.add_parser("investigations", help="list/show investigations")
     sp.add_argument("--id", default=None)

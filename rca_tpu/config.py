@@ -476,6 +476,44 @@ def slo_ms() -> float:
     return env_float("RCA_SLO_MS", 500.0, 1.0, 600_000.0)
 
 
+# -- causelens: evidence attribution (ISSUE 14) ------------------------------
+# env knobs for on-device blame attribution (rca_tpu/engine/attribution.py +
+# rca_tpu/observability/causelens.py, OBSERVABILITY.md §causelens), each
+# validated here so a typo'd value fails loudly:
+#
+#   RCA_EXPLAIN        0 (default) | 1 — compute a per-ranking provenance
+#                      block (per-channel evidence contributions,
+#                      counterfactual evidence rows, blame paths, gradient
+#                      saliency) beside every streaming tick, and stamp
+#                      its digest into recordings so `rca replay --explain`
+#                      can parity-check attributions against the tape.
+#                      Serve/gateway explain is per-request (the
+#                      ServeRequest.explain flag / ?explain=1), not gated
+#                      by this knob.
+#   RCA_EXPLAIN_PATHS  [1, 16]  blame-path hop cap per candidate (the
+#                      greedy up-term walk; default 4)
+#   RCA_EXPLAIN_TOPM   [1, 64]  evidence rows the counterfactual sweep
+#                      masks (top-M by anomaly; default 8 — each row is
+#                      one extra vmapped propagation lane)
+
+
+def explain_enabled() -> bool:
+    """``RCA_EXPLAIN``: per-tick attribution + recording digests."""
+    return env_str(
+        "RCA_EXPLAIN", "0", choices=("0", "1", "on", "off"), lower=True,
+    ) in ("1", "on")
+
+
+def explain_paths() -> int:
+    """``RCA_EXPLAIN_PATHS``: blame-path hop cap per candidate."""
+    return env_int("RCA_EXPLAIN_PATHS", 4, 1, 16)
+
+
+def explain_topm() -> int:
+    """``RCA_EXPLAIN_TOPM``: counterfactual evidence rows per sweep."""
+    return env_int("RCA_EXPLAIN_TOPM", 8, 1, 64)
+
+
 # -- kernel registry + kernelscope (ISSUE 12) --------------------------------
 # env knobs for the per-shape kernel registry (rca_tpu/engine/registry.py)
 # and the kernelscope runtime watchdogs (rca_tpu/observability/kernelscope),

@@ -26,8 +26,8 @@ import re
 from typing import AbstractSet, Any, Dict, List, Optional
 
 from rca_tpu.agents.base import AnalysisContext
-from rca_tpu.config import env_str
-from rca_tpu.findings import max_severity, severity_rank
+from rca_tpu.config import env_str, explain_enabled
+from rca_tpu.findings import attach_provenance, max_severity, severity_rank
 
 _SERVICE_SUFFIX = re.compile(r"-[a-z0-9]{8,10}-[a-z0-9]{5}$")
 
@@ -179,7 +179,7 @@ def correlate_jax(
         f"cause: {top[0]['component']}"
         if top else "No findings to correlate."
     )
-    return {
+    out = {
         "root_causes": top,
         "groups": groups,
         "backend": "jax",
@@ -187,6 +187,17 @@ def correlate_jax(
         "summary": summary,
         "engine_latency_ms": result.latency_ms,
     }
+    if explain_enabled():
+        # causelens (ISSUE 14): the schema-versioned provenance block
+        # rides the findings JSON — per-channel contributions, blame
+        # paths, counterfactual evidence for every engine-ranked service.
+        # An attribution failure degrades to a named error, never loses
+        # the ranking (same honesty rule as the backend fallbacks).
+        try:
+            attach_provenance(out, result.attribution())
+        except Exception as exc:  # noqa: BLE001 - degrade, but say so
+            out["provenance_error"] = f"{type(exc).__name__}: {exc}"
+    return out
 
 
 def correlate_llm(

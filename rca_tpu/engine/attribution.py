@@ -1,0 +1,441 @@
+"""causelens core: batched on-device evidence attribution (ISSUE 14).
+
+Every ranking the engine produces says "checkout, score 0.93" — this
+module says WHY, decomposing :func:`rca_tpu.engine.propagate.
+combine_score` for each top-k candidate into the terms that built it:
+
+- **channel contributions**: the noisy-OR is a product of per-channel
+  survival factors, so each channel's contribution ``w_c · clip(f_c)``
+  (plus the round-5 error-contrast term, which folds in as a 14th
+  channel) reconstructs the anomaly evidence EXACTLY — and
+  ``a · impact_factor · suppression_factor`` reconstructs the combined
+  score.  The completeness axiom (per-channel contributions reconstruct
+  ``combine_score`` within 1e-5 for the float32 kernels) is
+  property-tested in tests/test_causelens.py;
+- **counterfactual evidence rows**: re-propagate with each of the top-M
+  evidence rows masked (vectorized over the masks via vmap, one fused
+  dispatch) and record each candidate's score drop — "which service's
+  evidence is this ranking actually standing on";
+- **blame paths**: per candidate, a greedy walk over the dependency
+  edges following the up-scan's own term (``max(h_d, γ·u_d)``) — the
+  exact quantity explain-away propagated, so the path names the edges
+  that suppressed (or failed to suppress) the candidate;
+- **gradient saliency**: ``∂(Σ top-k score)/∂features`` over the same
+  traced propagation body, per-candidate channel gradients plus the
+  top-M rows by gradient norm (a second opinion on the counterfactuals
+  that costs one backward pass instead of M propagations).
+
+Dispatch discipline: the sweep asks the :class:`rca_tpu.engine.registry.
+KernelRegistry` for its kernel as a first-class ``attribution`` variant
+(the counterfactual/gradient body re-propagates through the
+differentiable xla path; quantized/pallas/doubling record WHY they are
+ineligible), records its per-shape wall cost into the registry row, and
+fetches only top-k/top-m-sized results — the full masked-score matrix
+never leaves the device.  graftlint's ``kernel-dispatch`` rule guards
+``attribution_sweep``/``attribution_saliency`` exactly like the kernel
+bodies: callers go through :func:`compute_attribution`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rca_tpu.config import (
+    RCAConfig,
+    bucket_for,
+    explain_paths,
+    explain_topm,
+)
+from rca_tpu.features.schema import SERVICE_FEATURE_NAMES, SvcF
+
+#: provenance block schema (bumped whenever the block layout changes —
+#: consumers check it before parsing; replay digests embed it)
+ATTRIBUTION_SCHEMA = 1
+
+
+@dataclasses.dataclass
+class AttributionContext:
+    """Everything a lazy ``EngineResult.attribution()`` needs to compute
+    the provenance block after the fact: the RAW request arrays plus the
+    engine's resolved params.  Arrays are the caller's own copies (the
+    serve request already copied at construction; the engines pass the
+    arrays they analyzed)."""
+
+    features: np.ndarray             # [S, C] raw request features (host)
+    dep_src: np.ndarray              # [E] int32
+    dep_dst: np.ndarray              # [E] int32
+    params: Any                      # engine.propagate.PropagationParams
+    names: Optional[Sequence[str]] = None
+    shape_buckets: tuple = RCAConfig.shape_buckets
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus",
+        "error_contrast", "kernel", "path_len",
+    ),
+)
+def attribution_sweep(
+    features, edges, anomaly_w, hard_w, cand_idx, mask_rows,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    error_contrast: float = 0.0, kernel: str = "xla", path_len: int = 4,
+    n_live=None, up_ell=None,
+):
+    """One fused attribution dispatch: the base propagation, the M-lane
+    counterfactual vmap, and the per-candidate blame-path walk.  Returns
+    top-k/top-m-sized device values only (ISSUE 6 discipline):
+
+    - ``diag``       [5, K]  (a, h, u, m, score) at the candidates;
+    - ``deltas``     [M, K]  base score minus the score with evidence
+                             row ``mask_rows[j]`` zeroed;
+    - ``path_edge``  [K, P]  edge index per hop (-1 = walk stopped);
+    - ``path_term``  [K, P]  the up-term ``max(h_d, γ·u_d)`` that chose
+                             the hop;
+    - ``path_dst``   [K, P]  the blamed dependency per hop;
+    - ``path_hard`` / ``path_up``  [K, P]  h / u at that dependency.
+    """
+    from rca_tpu.engine.propagate import finite_mask_rows
+    from rca_tpu.engine.runner import propagate_auto
+
+    features, _ = finite_mask_rows(features)
+
+    def run(f):
+        return propagate_auto(
+            f, edges, anomaly_w, hard_w,
+            steps, decay, explain_strength, impact_bonus, n_live=n_live,
+            up_ell=up_ell, error_contrast=error_contrast, kernel=kernel,
+        )
+
+    a, h, u, m, score = run(features)
+    diag = jnp.stack([a, h, u, m, score])[:, cand_idx]
+
+    def masked(row):
+        # the counterfactual: this evidence row contributes nothing
+        return run(features.at[row].set(0.0))[4][cand_idx]
+
+    deltas = score[cand_idx][None, :] - jax.vmap(masked)(mask_rows)
+
+    # blame-path walk: at each hop follow the dependency edge whose
+    # up-term is largest — the same quantity the up-scan propagated, so
+    # the path is the explain-away chain itself, not a heuristic
+    n_edges = edges.shape[1]
+
+    def walk(c0):
+        def step(cur, _):
+            term = jnp.where(
+                edges[0] == cur,
+                jnp.maximum(h[edges[1]], decay * u[edges[1]]),
+                -jnp.inf,
+            )
+            j = jnp.argmax(term)
+            t = term[j]
+            live = t > 0.0
+            return (
+                jnp.where(live, edges[1][j], cur),
+                (jnp.where(live, j, -1), jnp.where(live, t, 0.0)),
+            )
+
+        _, (ej, tv) = jax.lax.scan(step, c0, None, length=path_len)
+        return ej, tv
+
+    path_edge, path_term = jax.vmap(walk)(cand_idx)
+    pe = jnp.clip(path_edge, 0, n_edges - 1)
+    path_dst = edges[1][pe]
+    return (diag, deltas, path_edge, path_term, path_dst,
+            h[path_dst], u[path_dst])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "steps", "decay", "explain_strength", "impact_bonus",
+        "error_contrast", "kernel", "m",
+    ),
+)
+def attribution_saliency(
+    features, edges, anomaly_w, hard_w, cand_idx,
+    steps: int, decay: float, explain_strength: float, impact_bonus: float,
+    error_contrast: float = 0.0, kernel: str = "xla", m: int = 8,
+    n_live=None, up_ell=None,
+):
+    """Gradient saliency over the propagation core: ``∂(Σ candidate
+    scores)/∂features``, returning the candidates' own channel gradients
+    [K, C] plus the top-``m`` rows by gradient L1 norm."""
+    from rca_tpu.engine.propagate import finite_mask_rows
+    from rca_tpu.engine.runner import propagate_auto
+
+    def total(f):
+        f, _ = finite_mask_rows(f)
+        score = propagate_auto(
+            f, edges, anomaly_w, hard_w,
+            steps, decay, explain_strength, impact_bonus, n_live=n_live,
+            up_ell=up_ell, error_contrast=error_contrast, kernel=kernel,
+        )[4]
+        return jnp.sum(score[cand_idx])
+
+    sal = jax.grad(total)(features)
+    row_norm = jnp.sum(jnp.abs(sal), axis=1)
+    vals, idx = jax.lax.top_k(row_norm, m)
+    return sal[cand_idx], vals, idx
+
+
+def _error_source_excess_np(clipped: np.ndarray, dep_src, dep_dst):
+    """Host twin of :func:`rca_tpu.engine.propagate.error_source_excess`
+    over ALREADY-clipped features — the channel-decomposition mirror for
+    the error-contrast pseudo-channel."""
+    e = clipped[:, SvcF.ERROR_RATE].astype(np.float32)
+    dep_max = np.zeros_like(e)
+    src = np.asarray(dep_src, np.int64)
+    dst = np.asarray(dep_dst, np.int64)
+    if len(src):
+        np.maximum.at(dep_max, src, e[dst])
+    return np.maximum(e - dep_max, 0.0)
+
+
+def _f32(x) -> float:
+    return float(np.float32(x))
+
+
+def compute_attribution(
+    ctx: AttributionContext,
+    ranked: List[dict],
+    k: Optional[int] = None,
+    paths: Optional[int] = None,
+    topm: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The host attribution entry point: pad like the engine, resolve
+    the ``attribution`` registry variant, run the fused sweep + saliency,
+    and assemble the schema-versioned provenance block.  ``ranked`` is
+    the engine's rendered ranking (the candidates to explain); entries
+    whose component is not a live service are skipped.
+
+    The block is fully deterministic for a given (features, edges,
+    params) on one platform — no wall times inside — which is what lets
+    ``rca replay --explain`` parity-check digests against the tape."""
+    from rca_tpu.engine.registry import engaged_kernel, get_registry
+    from rca_tpu.engine.runner import finite_mask_rows_np, up_ell_for
+
+    t0 = time.perf_counter()
+    p = ctx.params
+    feats = np.asarray(ctx.features, np.float32)
+    n = int(feats.shape[0])
+    names = (
+        list(ctx.names) if ctx.names is not None
+        else [f"svc-{i}" for i in range(n)]
+    )
+    paths = explain_paths() if paths is None else max(1, int(paths))
+    topm = explain_topm() if topm is None else max(1, int(topm))
+    index = {nm: i for i, nm in enumerate(names)}
+    cand = [
+        index[r["component"]] for r in ranked
+        if r.get("component") in index
+    ]
+    if k is not None:
+        cand = cand[: max(1, int(k))]
+    block: Dict[str, Any] = {
+        "schema": ATTRIBUTION_SCHEMA,
+        "k": len(cand), "topm": int(topm), "paths": int(paths),
+        "n_services": n, "n_edges": int(len(ctx.dep_src)),
+        "candidates": [],
+    }
+    from rca_tpu.engine.propagate import SCORE_FORMULA_VERSION
+
+    block["score_formula_version"] = SCORE_FORMULA_VERSION
+    if not cand:
+        block["kernel"] = None
+        block["evidence_rows"] = []
+        return block
+
+    # pad exactly like GraphEngine._pad (same tiers, same dummy slot)
+    n_pad = bucket_for(n + 1, ctx.shape_buckets)
+    e_pad = bucket_for(max(len(ctx.dep_src), 1), ctx.shape_buckets)
+    dummy = n_pad - 1
+    f = np.zeros((n_pad, feats.shape[1]), np.float32)
+    f[:n] = feats
+    s = np.full(e_pad, dummy, np.int32)
+    d = np.full(e_pad, dummy, np.int32)
+    s[: len(ctx.dep_src)] = np.asarray(ctx.dep_src, np.int32)
+    d[: len(ctx.dep_dst)] = np.asarray(ctx.dep_dst, np.int32)
+
+    # THE dispatch seam, as its own registry variant (ISSUE 14): the row
+    # names the engaged kernel (xla — the differentiable body) and WHY
+    # every other kernel sat out; the wall cost lands in its timings
+    kernel = engaged_kernel(
+        n_pad, e_pad=e_pad, steps=p.steps, variant="attribution",
+    )
+    block["kernel"] = kernel
+    up_ell = up_ell_for(
+        n_pad, np.asarray(ctx.dep_src, np.int32),
+        np.asarray(ctx.dep_dst, np.int32),
+    )
+    aw, hw = p.weight_arrays()
+    aw_np = np.asarray(aw, np.float32)
+    hw_np = np.asarray(hw, np.float32)
+
+    # host channel decomposition over the SANITIZED features (mirrors
+    # the fused finite-mask pass, so a poisoned row contributes zero on
+    # both sides)
+    clean, _ = finite_mask_rows_np(feats)
+    clipped = np.clip(clean, 0.0, 1.0).astype(np.float32)
+    err = _error_source_excess_np(clipped, ctx.dep_src, ctx.dep_dst)
+    a0 = (1.0 - np.prod(
+        np.float32(1.0) - clipped * aw_np[None, :], axis=1,
+        dtype=np.float32,
+    )).astype(np.float32)
+    if p.error_contrast:
+        a_host = (1.0 - (1.0 - a0)
+                  * (1.0 - np.float32(p.error_contrast) * err)
+                  ).astype(np.float32)
+    else:
+        a_host = a0
+
+    # counterfactual mask set: the top-M evidence rows by anomaly (the
+    # rows the ranking could be standing on), stable order for replay
+    m_rows = int(min(topm, n))
+    mask_rows = np.argsort(-a_host, kind="stable")[:m_rows].astype(np.int32)
+
+    cand_arr = np.asarray(cand, np.int32)
+    n_live = jnp.asarray(n, jnp.int32)
+    edges_j = jnp.asarray(np.stack([s, d]))
+    out = attribution_sweep(
+        jnp.asarray(f), edges_j, aw, hw,
+        jnp.asarray(cand_arr), jnp.asarray(mask_rows),
+        p.steps, p.decay, p.explain_strength, p.impact_bonus,
+        error_contrast=p.error_contrast, kernel=kernel,
+        path_len=paths, n_live=n_live, up_ell=up_ell,
+    )
+    (diag, deltas, path_edge, path_term, path_dst, path_hard,
+     path_up) = jax.device_get(out)
+
+    sal_cand = sal_vals = sal_idx = None
+    saliency_note = None
+    try:
+        sal_cand, sal_vals, sal_idx = jax.device_get(attribution_saliency(
+            jnp.asarray(f), edges_j, aw, hw, jnp.asarray(cand_arr),
+            p.steps, p.decay, p.explain_strength, p.impact_bonus,
+            error_contrast=p.error_contrast, kernel=kernel,
+            m=min(m_rows, n_pad), n_live=n_live, up_ell=up_ell,
+        ))
+    except Exception as exc:  # noqa: BLE001 - saliency is best-effort
+        # a backend without the needed gradient rules still gets the
+        # counterfactual/channel attribution; the block says why
+        saliency_note = f"{type(exc).__name__}: {exc}"
+
+    block["evidence_rows"] = [
+        {"row": int(r), "component": names[int(r)],
+         "anomaly": _f32(a_host[int(r)])}
+        for r in mask_rows
+    ]
+    for rank, i in enumerate(cand):
+        a_dev, h_v, u_v, m_v, score = (
+            _f32(diag[0, rank]), _f32(diag[1, rank]),
+            _f32(diag[2, rank]), _f32(diag[3, rank]),
+            _f32(diag[4, rank]),
+        )
+        channels = []
+        for c, cname in enumerate(SERVICE_FEATURE_NAMES):
+            contrib = float(np.float32(aw_np[c] * clipped[i, c]))
+            if contrib == 0.0 and clipped[i, c] == 0.0:
+                continue
+            channels.append({
+                "channel": cname,
+                "value": _f32(clipped[i, c]),
+                "weight": _f32(aw_np[c]),
+                "hard_weight": _f32(hw_np[c]),
+                "contribution": contrib,
+            })
+        if p.error_contrast:
+            channels.append({
+                "channel": "error_contrast",
+                "value": _f32(err[i]),
+                "weight": _f32(p.error_contrast),
+                "hard_weight": 0.0,
+                "contribution": _f32(np.float32(p.error_contrast)
+                                     * err[i]),
+            })
+        # the completeness axiom: the channel survival product rebuilds
+        # a, and a · impact_factor · suppression_factor rebuilds score
+        surv = np.float32(1.0)
+        for ch in channels:
+            surv = np.float32(surv * np.float32(1.0 - ch["contribution"]))
+        a_rec = float(np.float32(1.0) - surv)
+        impact_factor = 1.0 + float(p.impact_bonus) * float(np.tanh(m_v))
+        suppression = 1.0 - (float(p.explain_strength) * u_v * (1.0 - h_v))
+        reconstructed = a_rec * impact_factor * suppression
+        counterfactuals = sorted(
+            (
+                {
+                    "row": int(mask_rows[j]),
+                    "component": names[int(mask_rows[j])],
+                    "self": bool(int(mask_rows[j]) == i),
+                    "score_drop": _f32(deltas[j, rank]),
+                }
+                for j in range(m_rows)
+            ),
+            key=lambda e: -e["score_drop"],
+        )
+        path = []
+        for hop in range(paths):
+            if int(path_edge[rank, hop]) < 0:
+                break
+            path.append({
+                "to": names[int(path_dst[rank, hop])]
+                if int(path_dst[rank, hop]) < n
+                else f"row-{int(path_dst[rank, hop])}",
+                "row": int(path_dst[rank, hop]),
+                "term": _f32(path_term[rank, hop]),
+                "hard": _f32(path_hard[rank, hop]),
+                "upstream": _f32(path_up[rank, hop]),
+            })
+        entry: Dict[str, Any] = {
+            "component": names[i], "row": int(i), "rank": rank + 1,
+            "score": score,
+            "anomaly": a_dev, "hard": h_v, "upstream": u_v,
+            "impact_mean": m_v,
+            "factors": {
+                "evidence": a_rec,
+                "impact": _f32(impact_factor),
+                "suppression": _f32(suppression),
+            },
+            "channels": channels,
+            "reconstructed_score": _f32(reconstructed),
+            "reconstruction_error": _f32(abs(reconstructed - score)),
+            "counterfactuals": counterfactuals,
+            "blame_path": path,
+        }
+        if sal_cand is not None:
+            grads = {
+                SERVICE_FEATURE_NAMES[c]: _f32(sal_cand[rank, c])
+                for c in range(sal_cand.shape[1])
+                if float(sal_cand[rank, c]) != 0.0
+            }
+            entry["saliency"] = {"channels": grads}
+        block["candidates"].append(entry)
+    if sal_idx is not None:
+        block["saliency_rows"] = [
+            {"row": int(r), "component": names[int(r)]
+             if int(r) < n else f"row-{int(r)}",
+             "grad_l1": _f32(v)}
+            for v, r in zip(sal_vals, sal_idx)
+            if int(r) < n and float(v) != 0.0
+        ]
+    elif saliency_note is not None:
+        block["saliency_unavailable"] = saliency_note
+    # per-shape cost telemetry: the wall cost of THIS attribution lands
+    # in the registry row's timings (bench's attribution section and
+    # `rca kernels` read it) — never inside the block, which must stay
+    # deterministic for replay digests
+    get_registry().note_timing(
+        n_pad, e_pad, "attribution",
+        (time.perf_counter() - t0) * 1e3,
+        variant="attribution", steps=p.steps,
+    )
+    return block

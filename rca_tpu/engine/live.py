@@ -111,6 +111,7 @@ class LiveStreamingSession:
         clock=None,
         use_columnar: Optional[bool] = None,
         tracer=None,
+        explain: Optional[bool] = None,
     ):
         """``topology_check_every``: do a full sweep + dependency-edge
         compare on every Nth poll — the edge build is the most expensive
@@ -172,6 +173,17 @@ class LiveStreamingSession:
         # the recording replays the session from construction, not from
         # some mid-life tick
         self.recorder = recorder
+        # causelens (ISSUE 14): per-tick attribution of the delivered
+        # ranking (RCA_EXPLAIN, or the explicit param — replay pins the
+        # recorded value).  Each explained tick computes the provenance
+        # block from the session's host mirror and stamps its DIGEST
+        # into the tick output — recordings carry it, so `rca replay
+        # --explain` parity-checks attributions against the tape.
+        from rca_tpu.config import explain_enabled
+
+        self._explain = (
+            explain_enabled() if explain is None else bool(explain)
+        )
         if recorder is not None:
             recorder.begin_session({
                 "namespace": namespace, "k": int(k),
@@ -182,6 +194,7 @@ class LiveStreamingSession:
                     columnar_enabled() if use_columnar is None
                     else bool(use_columnar)
                 ),
+                "explain": self._explain,
             })
             client = recorder.wrap_client(client)
         self.client = client
@@ -590,6 +603,8 @@ class LiveStreamingSession:
                 "degraded": True,
             }
         self._last_ranked = list(out.get("ranked", []))
+        if self._explain:
+            self._explain_tick(out)
         if not self._warm_marked:
             # warmup ends after the first completed poll: the steady
             # state is what the zero-post-warmup-recompiles gate covers
@@ -600,6 +615,33 @@ class LiveStreamingSession:
         if self.recorder is not None:
             self.recorder.end_tick(out, features=self._features)
         return out
+
+    def _explain_tick(self, out: Dict[str, Any]) -> None:
+        """Attribute this poll's DELIVERED ranking against the session's
+        current host mirror (causelens, ISSUE 14).  Degraded ticks are
+        attributed too — the last-known ranking over the retained state
+        is exactly the answer the operator is looking at.  A failing
+        attribution records a fault and stamps the error; it never takes
+        down poll()."""
+        try:
+            from rca_tpu.engine.attribution import compute_attribution
+            from rca_tpu.engine.runner import make_attribution_ctx
+            from rca_tpu.observability.causelens import attribution_digest
+
+            src, dst = self._edges_raw
+            ctx = make_attribution_ctx(
+                self._features, src, dst, self.engine.params, self._names,
+                getattr(self.engine, "config", None).shape_buckets
+                if getattr(self.engine, "config", None) is not None
+                else None,
+            )
+            block = compute_attribution(ctx, out.get("ranked") or [])
+            out["attribution"] = block
+            out["attribution_digest"] = attribution_digest(block)
+        except Exception as exc:  # noqa: BLE001 - explain never kills a tick
+            record_fault("live.explain", exc)
+            out["attribution_digest"] = None
+            out["attribution_error"] = f"{type(exc).__name__}: {exc}"
 
     def _trace_tick(self, out: Dict[str, Any], t0: float) -> None:
         """Record this poll's spans and embed them in the health record.
@@ -620,9 +662,6 @@ class LiveStreamingSession:
                 "degraded": bool(out.get("degraded", False)),
                 "changed_rows": int(out.get("changed_rows", 0) or 0),
                 "upload_rows": int(out.get("upload_rows", 0) or 0),
-                "noisyor_path": getattr(
-                    self.session, "noisyor_path", None
-                ),
                 "kernel_path": getattr(
                     self.session, "kernel_path", None
                 ),
@@ -692,10 +731,11 @@ class LiveStreamingSession:
             "inflight": len(self._inflight),
             "pipeline_flushed": self.pipeline_flushed,
             "pipeline_fill": bool(out.get("pipeline_fill", False)),
-            "noisyor_path": getattr(self.session, "noisyor_path", None),
             # the ENGAGED combine path for this session's padded shape
             # (autotune winner AND block-divisibility — ISSUE 11): a
-            # pallas regression in a health stream names a shape
+            # pallas regression in a health stream names a shape.  The
+            # retired process-level noisyor_path stamp (ISSUE 14
+            # satellite) is subsumed by this per-shape attribution.
             "kernel_path": getattr(self.session, "kernel_path", None),
             "compile_cache": dict(self._compile_cache),
             "resyncs_expired": self.resyncs_expired,

@@ -130,20 +130,18 @@ def pallas_enabled() -> bool:
 
 
 def noisyor_autotune(refresh: bool = False) -> str:
-    """DEPRECATED back-compat shim over the per-shape kernel registry
-    (ISSUE 12; deprecation stamped in ISSUE 13): the process-level
-    combine path — the registry's winner at the canonical shape.  The
-    one-shot timing, the force semantics, and the CPU short-circuit all
-    live in :mod:`rca_tpu.engine.registry` now; sessions ask the
-    registry per-shape via
-    :func:`rca_tpu.engine.registry.engaged_kernel` and stamp this
-    process-level answer only as ``noisyor_path``.  New code must go
-    through the registry — the ``kernel-dispatch`` lint flags calls to
-    this shim anywhere inside ``rca_tpu/``."""
+    """RETIRED (ISSUE 14 satellite; deprecation stamped in ISSUE 13) —
+    a thin alias kept ONLY for external/test importers.  The per-shape
+    registry (:func:`rca_tpu.engine.registry.engaged_kernel`) is the
+    real surface; every internal stamp of this process-level answer
+    (the streaming sessions' ``noisyor_path``, health records, span
+    attributes, bench, ``rca profile``) is gone — per-shape
+    ``kernel_path`` says strictly more.  The ``kernel-dispatch`` lint
+    flags calls to this alias anywhere inside ``rca_tpu/``."""
     import warnings
 
     warnings.warn(
-        "noisyor_autotune() is deprecated: ask the per-shape registry "
+        "noisyor_autotune() is retired: ask the per-shape registry "
         "(rca_tpu.engine.registry.engaged_kernel / autotune_path)",
         DeprecationWarning, stacklevel=2,
     )
@@ -153,13 +151,13 @@ def noisyor_autotune(refresh: bool = False) -> str:
 
 
 def noisyor_path():
-    """DEPRECATED: the autotuned choice, or None when no session has
-    autotuned yet — use
+    """RETIRED twin of :func:`noisyor_autotune` (alias for external/
+    test importers): the cached process-level choice, or None — use
     :func:`rca_tpu.engine.registry.autotuned_path`."""
     import warnings
 
     warnings.warn(
-        "noisyor_path() is deprecated: use "
+        "noisyor_path() is retired: use "
         "rca_tpu.engine.registry.autotuned_path()",
         DeprecationWarning, stacklevel=2,
     )

@@ -155,7 +155,7 @@ class KernelRow:
     edge tier (the legacy process-level shim): edge-dependent kernels
     are ineligible there and the row decides among xla/pallas only."""
 
-    variant: str                  # dense | sharded
+    variant: str                  # dense | sharded | attribution
     n_pad: int
     backend: str                  # jax.default_backend() at resolve time
     winner: str                   # the engaged kernel (a KERNELS member)
@@ -269,15 +269,20 @@ class KernelRegistry:
 
     # -- resolution ----------------------------------------------------------
     def resolve(self, n_pad: int, e_pad: Optional[int] = None,
-                sharded: bool = False, steps: int = 8) -> KernelRow:
+                sharded: bool = False, steps: int = 8,
+                variant: Optional[str] = None) -> KernelRow:
         """The row for one padded shape, created on first ask.  Rows are
         keyed by the dispatch env knobs too (:func:`_flag`), so a test
         flipping the env mid-process re-decides instead of serving a
-        stale verdict."""
+        stale verdict.  ``variant`` overrides the dense/sharded pair —
+        ``"attribution"`` (ISSUE 14) is the causelens counterfactual/
+        gradient sweep, which dispatches through this same seam so its
+        rows show up in ``rca kernels``, bench, and ``/metrics``."""
         n_pad = int(n_pad)
         e_pad = int(e_pad) if e_pad is not None else None
         steps = int(steps)
-        variant = "sharded" if sharded else "dense"
+        if variant is None:
+            variant = "sharded" if sharded else "dense"
         flag = _flag()
         backend = _backend()
         key = (variant, n_pad, e_pad, steps, backend, flag)
@@ -290,6 +295,20 @@ class KernelRegistry:
             self._rows[key] = row
         return row
 
+    def note_timing(self, n_pad: int, e_pad: Optional[int], name: str,
+                    ms: float, variant: str = "dense",
+                    steps: int = 8) -> None:
+        """Record one observed wall cost into a row's timings (keeps the
+        MINIMUM — first calls carry compile time, the floor is the
+        steady-state cost).  The attribution sweep stamps its per-shape
+        cost here so bench's ``attribution`` section and ``rca kernels``
+        report explain-on cost from the one registry table."""
+        row = self.resolve(n_pad, e_pad=e_pad, steps=steps, variant=variant)
+        with self._lock:
+            prev = row.timings_ms.get(name)
+            if prev is None or float(ms) < float(prev):
+                row.timings_ms[name] = round(float(ms), 4)
+
     def _decide(self, variant: str, n_pad: int, e_pad: Optional[int],
                 steps: int, backend: str) -> KernelRow:
         from rca_tpu.engine.pallas_kernels import pallas_supported
@@ -300,6 +319,14 @@ class KernelRegistry:
             backend=backend, winner="xla", source="default",
             eligible=eligible,
         )
+        if variant == "attribution":
+            # the causelens sweep (ISSUE 14): re-propagates through the
+            # differentiable xla body (vmap over counterfactual masks +
+            # one backward pass) — the other kernels record WHY they sit
+            # out in the eligibility map; the observed per-shape cost
+            # lands in timings via note_timing
+            row.source = "attribution"
+            return row
         if variant == "sharded":
             # the sharded per-block propagation has a segscan twin
             # (parallel/sharded.py) but no shard_map twin of the other
@@ -421,6 +448,18 @@ def _eligibility(variant: str, n_pad: int, e_pad: Optional[int],
     layout = env_str("RCA_EDGE_LAYOUT", "hybrid",
                      choices=("hybrid", "coo", "ell"), lower=True)
     out: Dict[str, Any] = {"xla": True}
+    if variant == "attribution":
+        # causelens (ISSUE 14): the counterfactual vmap + gradient
+        # saliency need a differentiable, maskable body — only the xla
+        # path qualifies today; the reasons below are what `rca kernels
+        # --explain` prints for the attribution rows
+        out["pallas"] = "no gradient rule for the fused evidence kernel"
+        out["segscan"] = "no gradient twin for the flagged segment scan"
+        out["quantized"] = "int8 messages are not differentiable"
+        out["doubling"] = (
+            "frontier layouts have no per-row counterfactual twin"
+        )
+        return out
     # pallas: the fused evidence kernel (dense only, block-divisible)
     if variant == "sharded":
         out["pallas"] = "no shard_map twin"
@@ -653,15 +692,18 @@ def reset_registry() -> None:
 
 
 def engaged_kernel(n_pad: int, e_pad: Optional[int] = None,
-                   sharded: bool = False, steps: int = 8) -> str:
+                   sharded: bool = False, steps: int = 8,
+                   variant: Optional[str] = None) -> str:
     """THE dispatch seam: which propagation kernel an
     ``(n_pad, e_pad)``-padded graph engages.  Every call surface
     (one-shot analyze, streaming flush, resident delta, serve dispatch,
-    sharded tick) asks HERE — graftlint rule ``kernel-dispatch`` keeps
-    it that way.  Callers that cannot name an edge tier get the
-    xla/pallas-only decision (edge-layout kernels need ``e_pad``)."""
+    sharded tick, and the causelens attribution sweep via
+    ``variant="attribution"``) asks HERE — graftlint rule
+    ``kernel-dispatch`` keeps it that way.  Callers that cannot name an
+    edge tier get the xla/pallas-only decision (edge-layout kernels
+    need ``e_pad``)."""
     return get_registry().resolve(
-        n_pad, e_pad=e_pad, sharded=sharded, steps=steps
+        n_pad, e_pad=e_pad, sharded=sharded, steps=steps, variant=variant,
     ).winner
 
 

@@ -132,6 +132,9 @@ class ResidentSession:
         # edges + layouts + (lazily) the feature matrix live on device for
         # the session lifetime — same pinning the streaming session does
         self._edges = jnp.asarray(np.stack([s, d]))
+        # raw edges retained for the lazy causelens context (ISSUE 14)
+        self._dep_src = np.asarray(dep_src, np.int32)
+        self._dep_dst = np.asarray(dep_dst, np.int32)
         # per-shape registry plan (ISSUE 12/13): the same dispatch seam
         # the one-shot and streaming surfaces ask, so the resident delta
         # path cannot drift to a different kernel
@@ -167,7 +170,11 @@ class ResidentSession:
 
     # -- one request ---------------------------------------------------------
     def analyze(self, features: np.ndarray, names, k: int):
-        from rca_tpu.engine.runner import _propagate_ranked, render_result
+        from rca_tpu.engine.runner import (
+            _propagate_ranked,
+            make_attribution_ctx,
+            render_result,
+        )
 
         t0 = time.perf_counter()
         eng = self.engine
@@ -241,6 +248,10 @@ class ResidentSession:
             diag, vals, idx, names, self._n, k, latency_ms,
             self._n_edges, engine="single", sanitized_rows=n_bad,
             stacked_dev=stacked,
+            attribution_ctx=make_attribution_ctx(
+                features, self._dep_src, self._dep_dst, eng.params,
+                names, eng.config.shape_buckets,
+            ),
         )
 
 

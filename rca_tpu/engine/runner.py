@@ -422,6 +422,7 @@ class EngineResult:
         sanitized_rows: int = 0,      # finite-mask-zeroed rows (0 = clean)
         stacked: Optional[np.ndarray] = None,   # host [4, >=n], eager form
         stacked_dev: object = None,   # device [4, n_pad], deferred form
+        attribution_ctx: object = None,  # lazy causelens inputs (ISSUE 14)
     ):
         self.service_names = service_names
         self.ranked = ranked
@@ -432,6 +433,11 @@ class EngineResult:
         self.sanitized_rows = int(sanitized_rows)
         self._stacked = stacked
         self._stacked_dev = stacked_dev
+        # causelens (ISSUE 14): the raw inputs + resolved params this
+        # result was computed from, retained so attribution() can run
+        # lazily — like full_diagnostics(), strictly off the hot path
+        self._attribution_ctx = attribution_ctx
+        self._provenance: Optional[dict] = None
 
     def full_diagnostics(self) -> np.ndarray:
         """The [4, n] host diagnostic stack (a, u, m, score), fetching the
@@ -463,6 +469,34 @@ class EngineResult:
     def score(self) -> np.ndarray:         # [S]
         return np.asarray(self.full_diagnostics()[3][: self.n_services])
 
+    def attribution(self, paths: Optional[int] = None,
+                    topm: Optional[int] = None) -> dict:
+        """The causelens provenance block for THIS ranking (ISSUE 14):
+        per-channel evidence contributions, counterfactual evidence
+        rows, blame paths, and gradient saliency for every ranked
+        candidate — lazy like :meth:`full_diagnostics` (one extra
+        fused dispatch on first call, cached after; never on the
+        analyze hot path).  Raises ``ValueError`` on results whose
+        producer retained no attribution context (degraded renders)."""
+        default_args = paths is None and topm is None
+        if self._provenance is not None and default_args:
+            return self._provenance
+        if self._attribution_ctx is None:
+            raise ValueError(
+                "EngineResult carries no attribution context (degraded "
+                "render, or a producer predating causelens)"
+            )
+        from rca_tpu.engine.attribution import compute_attribution
+        from rca_tpu.observability.causelens import provenance_block
+
+        block = compute_attribution(
+            self._attribution_ctx, self.ranked, paths=paths, topm=topm,
+        )
+        out = provenance_block(block, engine=self.engine)
+        if default_args:
+            self._provenance = out
+        return out
+
     def top_components(self, k: Optional[int] = None) -> List[str]:
         items = self.ranked if k is None else self.ranked[:k]
         return [r["component"] for r in items]
@@ -480,6 +514,7 @@ def render_result(
     engine: str,
     sanitized_rows: int = 0,
     stacked_dev: object = None,   # device [4, n_pad] for lazy diagnostics
+    attribution_ctx: object = None,  # lazy causelens inputs (ISSUE 14)
 ) -> EngineResult:
     """Shared host-side rendering: identical findings regardless of which
     engine (single-device or sharded) produced the device arrays.  Takes
@@ -510,6 +545,27 @@ def render_result(
         engine=engine,
         sanitized_rows=int(sanitized_rows),
         stacked_dev=stacked_dev,
+        attribution_ctx=attribution_ctx,
+    )
+
+
+def make_attribution_ctx(features, dep_src, dep_dst, params, names,
+                         shape_buckets=None):
+    """The one constructor every render surface uses to retain causelens
+    inputs (ISSUE 14) — a thin wrapper so the engines do not each import
+    the attribution module at staging time."""
+    from rca_tpu.engine.attribution import AttributionContext
+
+    kwargs = {}
+    if shape_buckets is not None:
+        kwargs["shape_buckets"] = tuple(shape_buckets)
+    return AttributionContext(
+        features=np.asarray(features, np.float32),
+        dep_src=np.asarray(dep_src, np.int32),
+        dep_dst=np.asarray(dep_dst, np.int32),
+        params=params,
+        names=list(names) if names is not None else None,
+        **kwargs,
     )
 
 
@@ -777,6 +833,10 @@ class GraphEngine(EngineAPI):
             diag, vals, idx, names, n, k, latency_ms,
             int(len(dep_src)), engine="single", sanitized_rows=n_bad,
             stacked_dev=stacked,
+            attribution_ctx=make_attribution_ctx(
+                features, dep_src, dep_dst, self.params, names,
+                self.config.shape_buckets,
+            ),
         )
 
     def analyze_batch(
@@ -828,6 +888,10 @@ class GraphEngine(EngineAPI):
                 diag[b], vals[b], idx[b], names, n, k,
                 latency_ms / B, int(len(dep_src)), engine="single-batch",
                 sanitized_rows=int(n_bad), stacked_dev=stacked[b],
+                attribution_ctx=make_attribution_ctx(
+                    features_batch[b], dep_src, dep_dst, self.params,
+                    names, self.config.shape_buckets,
+                ),
             )
             for b in range(B)
         ]

@@ -215,11 +215,17 @@ class ShardedGraphEngine(EngineAPI):
             return stack[0], diag[0], vals[0], idx[0], n_bad
 
         stack, diag, vals, idx, n_bad, latency_ms = timed_fetch(run, timed)
+        from rca_tpu.engine.runner import make_attribution_ctx
+
         return render_result(
             diag, np.asarray(vals), np.asarray(idx),
             names, n, k, latency_ms, int(len(dep_src)),
             engine=self.engine_tag, sanitized_rows=n_bad,
             stacked_dev=stack,
+            attribution_ctx=make_attribution_ctx(
+                features, dep_src, dep_dst, self.params, names,
+                self.config.shape_buckets,
+            ),
         )
 
     def analyze_batch(
@@ -255,12 +261,18 @@ class ShardedGraphEngine(EngineAPI):
         # on device behind each lane's lazy diagnostics (ISSUE 6)
         diag, vals, idx = jax.device_get((diag, vals, idx))
         latency_ms = (_time.perf_counter() - t0) * 1e3
+        from rca_tpu.engine.runner import make_attribution_ctx
+
         return [
             render_result(
                 diag[b], vals[b], idx[b], names, n, k,
                 latency_ms / B, int(len(dep_src)),
                 engine=self.engine_tag + "-batch", sanitized_rows=n_bad,
                 stacked_dev=stack[b],
+                attribution_ctx=make_attribution_ctx(
+                    features_batch[b], dep_src, dep_dst, self.params,
+                    names, self.config.shape_buckets,
+                ),
             )
             for b in range(B)
         ]

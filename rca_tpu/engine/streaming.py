@@ -324,7 +324,6 @@ class StreamingSession(StreamingHostState):
         # kernel + layouts from the per-shape registry (ISSUE 12/13 —
         # the ONE dispatch seam): the engaged kernel for THIS padded
         # shape, its layouts built once for the session's pinned edges
-        from rca_tpu.engine.registry import autotune_path
         from rca_tpu.engine.runner import kernel_plan
 
         p = self.engine.params
@@ -336,10 +335,11 @@ class StreamingSession(StreamingHostState):
         self._up_ell = self._plan.up_ell
         self._features = jnp.zeros((self._n_pad, num_features), jnp.float32)
         self._kk = min(k + 8, self._n_pad)
-        # process-level compat stamp health records carry
-        self.noisyor_path = autotune_path()
         # the ENGAGED kernel for THIS padded shape — health records and
-        # span attributes carry it so a kernel regression names a shape
+        # span attributes carry it so a kernel regression names a shape.
+        # (The retired process-level noisyor_path stamp — one canonical-
+        # shape autotune per session construction — is gone: ISSUE 14
+        # satellite; per-shape kernel_path says strictly more.)
         self.kernel_path = self._plan.kernel
         self._init_host_state(clock)
 

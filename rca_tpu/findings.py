@@ -68,6 +68,27 @@ def annotate_created_ago(data: dict, now_ts: str) -> dict:
     return data
 
 
+def attach_provenance(
+    obj: Dict[str, Any], provenance: Optional[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Attach a causelens ``provenance`` block (ISSUE 14) to a findings
+    JSON object (a correlate result, a finding dict) — the ONE place the
+    block's schema is checked before it rides outward, so a malformed
+    producer fails here instead of at a consumer.  ``None`` is a no-op
+    (explain off)."""
+    if provenance is None:
+        return obj
+    if not isinstance(provenance, dict) or not isinstance(
+        provenance.get("schema"), int
+    ):
+        raise ValueError(
+            "provenance must be a schema-versioned dict "
+            "(rca_tpu.observability.causelens.provenance_block)"
+        )
+    obj["provenance"] = provenance
+    return obj
+
+
 def make_finding(
     component: str,
     issue: str,

@@ -171,6 +171,13 @@ def render_metrics_text(
         _line(out, "rca_serve_resident_delta_requests_total",
               rec.get("resident_delta_requests", 0), tenant=tenant)
 
+    _head(out, "rca_explain_requests_total", "counter",
+          "requests served with a causelens attribution "
+          "(ServeRequest.explain / ?explain=1 — ISSUE 14)")
+    for tenant, rec in sorted(tenants.items()):
+        _line(out, "rca_explain_requests_total",
+              rec.get("explain_requests", 0), tenant=tenant)
+
     _head(out, "rca_serve_batches_total", "counter",
           "device batches dispatched")
     _line(out, "rca_serve_batches_total", serve_summary.get("batches", 0))

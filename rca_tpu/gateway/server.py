@@ -36,6 +36,7 @@ replay-adjacent — its recordings must stay host-independent).
 
 from __future__ import annotations
 
+import collections
 import itertools
 import json
 import queue
@@ -350,9 +351,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes --------------------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802 - http.server contract
-        path = urlsplit(self.path).path
+        parts = urlsplit(self.path)
+        path = parts.path
         if path == "/v1/analyze":
-            self._route(self._post_analyze, "analyze")
+            self._route(
+                lambda: self._post_analyze(parse_qs(parts.query)),
+                "analyze",
+            )
         else:
             self._route(
                 lambda: (self._send_json(
@@ -372,6 +377,13 @@ class _Handler(BaseHTTPRequestHandler):
                 lambda: self._get_traces(parse_qs(parts.query)),
                 "traces",
             )
+        elif parts.path.startswith("/v1/explain/"):
+            self._route(
+                lambda: self._get_explain(
+                    parts.path[len("/v1/explain/"):]
+                ),
+                "explain",
+            )
         elif parts.path == "/v1/subscribe":
             self._route(
                 lambda: self._get_subscribe(parse_qs(parts.query)),
@@ -386,7 +398,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "unknown",
             )
 
-    def _post_analyze(self) -> int:
+    def _post_analyze(self, query: Optional[Dict[str, list]] = None) -> int:
         gw = self.gateway
         t0 = gw.clock()
         # trace context enters here (ISSUE 11): parse the caller's
@@ -432,6 +444,11 @@ class _Handler(BaseHTTPRequestHandler):
         except (WireError, UnicodeDecodeError,
                 json.JSONDecodeError) as exc:
             return _finish(400, {"status": "error", "detail": str(exc)})
+        if query and (query.get("explain") or [""])[0] in ("1", "true",
+                                                           "on"):
+            # ?explain=1 (ISSUE 14): query-param twin of the body's
+            # "explain": true — curl ergonomics for the common case
+            kwargs["explain"] = True
         if gw.limiter is not None:
             wait = gw.limiter.admit(kwargs.get("tenant", ""))
             if wait > 0.0:
@@ -461,6 +478,17 @@ class _Handler(BaseHTTPRequestHandler):
         out = response_body(resp)
         if req.trace is not None:
             out["trace_id"] = req.trace.trace_id
+        if resp.provenance is not None:
+            # retained for GET /v1/explain/<trace_id> (falls back to the
+            # request id when tracing is off — the body names both)
+            gw.remember_explain(
+                out.get("trace_id"), resp.request_id, {
+                    "request_id": resp.request_id,
+                    "tenant": resp.tenant,
+                    "trace_id": out.get("trace_id"),
+                    "provenance": resp.provenance,
+                },
+            )
         gw.hub.publish(out)
         code, retry_after = status_code_for(resp.status)
         return _finish(code, out, retry_after=retry_after,
@@ -523,6 +551,24 @@ class _Handler(BaseHTTPRequestHandler):
                          "1" if gw.tracer.enabled else "0")
         self.end_headers()
         self.wfile.write(payload)
+        return 200
+
+    def _get_explain(self, key: str) -> int:
+        """``GET /v1/explain/<trace_id>`` (ISSUE 14): the retained
+        causelens provenance for a recently explained analyze request —
+        keyed by trace id (or request id when tracing was off).  The
+        cache is bounded (oldest drop), so a 404 means expired OR never
+        explained; the analyze response body carried the block either
+        way."""
+        record = self.gateway.lookup_explain(key)
+        if record is None:
+            self._send_json(404, {
+                "status": "error",
+                "detail": f"no retained explanation for {key!r} "
+                "(expired, or the request was not sent with explain)",
+            })
+            return 404
+        self._send_json(200, record)
         return 200
 
     def _get_subscribe(self, query: Dict[str, list]) -> int:
@@ -626,6 +672,13 @@ class GatewayServer:
         )
         self.metrics = GatewayMetrics()
         self.hub = TickHub()
+        # causelens (ISSUE 14): recently served provenance blocks, keyed
+        # by trace_id AND request_id, bounded LRU — GET /v1/explain/<id>
+        # reads them back after the analyze response was consumed
+        self._explains_lock = make_lock("GatewayServer._explains_lock")
+        self._explains: "collections.OrderedDict[str, Dict[str, Any]]" = (
+            collections.OrderedDict()
+        )
         self.closing = threading.Event()
         sock = make_server_socket(
             "gateway", host, port if port is not None else gateway_port()
@@ -634,9 +687,27 @@ class GatewayServer:
         self._httpd = _GatewayHTTPServer(sock, _Handler, self)
         self._thread = None
 
+    #: explained responses retained for GET /v1/explain/<id> (per key)
+    EXPLAIN_CACHE_CAP = 256
+
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
+
+    # -- causelens retention (ISSUE 14) --------------------------------------
+    def remember_explain(self, trace_id: Optional[str], request_id: str,
+                         record: Dict[str, Any]) -> None:
+        with self._explains_lock:
+            for key in (trace_id, request_id):
+                if key:
+                    self._explains[str(key)] = record
+                    self._explains.move_to_end(str(key))
+            while len(self._explains) > self.EXPLAIN_CACHE_CAP:
+                self._explains.popitem(last=False)
+
+    def lookup_explain(self, key: str) -> Optional[Dict[str, Any]]:
+        with self._explains_lock:
+            return self._explains.get(str(key))
 
     # -- health (breaker-fed, ISSUE 9) ---------------------------------------
     def health(self) -> Dict[str, Any]:

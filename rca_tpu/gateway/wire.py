@@ -124,6 +124,9 @@ def decode_analyze(body: Dict[str, Any],
     inv = body.get("investigation_id")
     if inv is not None and not isinstance(inv, str):
         raise WireError("field 'investigation_id': expected a string")
+    explain = body.get("explain", False)
+    if not isinstance(explain, bool):
+        raise WireError("field 'explain': expected a boolean")
     return {
         "features": features, "dep_src": dep_src, "dep_dst": dep_dst,
         "names": names, "tenant": tenant, "k": k,
@@ -131,6 +134,7 @@ def decode_analyze(body: Dict[str, Any],
         "deadline_ms": float(deadline_ms) if deadline_ms is not None
         else None,
         "investigation_id": inv,
+        "explain": explain,
     }
 
 
@@ -139,6 +143,7 @@ def encode_analyze(
     names=None, tenant: Optional[str] = None, k: int = 5,
     priority: str = "normal", deadline_ms: Optional[float] = None,
     investigation_id: Optional[str] = None,
+    explain: bool = False,
 ) -> Dict[str, Any]:
     """Client-side twin of :func:`decode_analyze`: arrays → the JSON
     body.  ``tolist()`` converts float32 → exact float64, which JSON
@@ -158,13 +163,15 @@ def encode_analyze(
         body["deadline_ms"] = float(deadline_ms)
     if investigation_id is not None:
         body["investigation_id"] = investigation_id
+    if explain:
+        body["explain"] = True
     return body
 
 
 def response_body(resp: ServeResponse) -> Dict[str, Any]:
     """A :class:`ServeResponse` → the JSON body both the analyze reply
     and the subscription stream carry."""
-    return {
+    out = {
         "status": resp.status,
         "request_id": resp.request_id,
         "tenant": resp.tenant,
@@ -176,6 +183,11 @@ def response_body(resp: ServeResponse) -> Dict[str, Any]:
         "deadline_missed": bool(resp.deadline_missed),
         "engine": getattr(resp.result, "engine", None),
     }
+    if getattr(resp, "provenance", None) is not None:
+        # causelens (ISSUE 14): the attribution rides the body only for
+        # requests that asked (?explain=1 / "explain": true)
+        out["provenance"] = resp.provenance
+    return out
 
 
 def status_code_for(status: str) -> Tuple[int, Optional[int]]:

@@ -25,8 +25,18 @@ from rca_tpu.observability.export import (  # noqa: F401
     ndjson_spans,
     recording_trace,
 )
+from rca_tpu.observability.causelens import (  # noqa: F401
+    PROVENANCE_SCHEMA,
+    attribution_digest,
+    provenance_block,
+    render_blame_tree,
+)
 
 __all__ = [
+    "PROVENANCE_SCHEMA",
+    "attribution_digest",
+    "provenance_block",
+    "render_blame_tree",
     "NULL_TRACER",
     "Span",
     "SpanContext",

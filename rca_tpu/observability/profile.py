@@ -8,9 +8,9 @@ inside ``jax.profiler.trace`` (TensorBoard/Perfetto-loadable), wraps
 each poll in a ``jax.profiler.StepTraceAnnotation`` so device ops group
 under tick numbers, engages :func:`rca_tpu.observability.spans.
 device_annotation` inside the serve/tick dispatch paths, and stamps the
-autotuner's chosen combine path — plus the ENGAGED kernel per shape
-bucket, which is the part a round-level flag cannot say — into the span
-attributes and the returned summary.
+ENGAGED kernel per shape bucket — the part a round-level flag cannot
+say — into the span attributes and the returned summary.  (The retired
+process-level ``noisyor_path`` stamp is gone: ISSUE 14 satellite.)
 """
 
 from __future__ import annotations
@@ -44,7 +44,6 @@ def profile_ticks(
     from rca_tpu.cluster.generator import synthetic_cascade_world
     from rca_tpu.cluster.mock_client import MockClusterClient
     from rca_tpu.engine.live import LiveStreamingSession
-    from rca_tpu.engine.registry import autotune_path
 
     if tracer is None:
         # an explicit profile capture is its own opt-in: record spans
@@ -60,7 +59,6 @@ def profile_ticks(
     session = LiveStreamingSession(
         client, "profile", k=5, tracer=tracer,
     )
-    noisyor = autotune_path()
     kernel_path = getattr(session.session, "kernel_path", None)
     n_pad = getattr(session.session, "_n_pad", None)
     set_profiling(True)
@@ -80,7 +78,6 @@ def profile_ticks(
         "trace_dir": out_dir,
         "wall_ms": round(wall_ms, 3),
         "ms_per_tick": round(wall_ms / max(1, int(ticks)), 3),
-        "noisyor_path": noisyor,
         # the per-shape attribution the round-level flag cannot carry:
         # which kernel this session's padded shape actually ENGAGED
         "kernel_by_shape": (

@@ -576,12 +576,17 @@ class ShardedResidentSession:
     """
 
     def __init__(self, engine, key, dep_src, dep_dst):
+        import numpy as _np
+
         n, num_features, n_edges, _ = key
         self.engine = engine
         self.key = key
         self._n = n
         self._num_features = num_features
         self._n_edges = n_edges
+        # raw edges retained for the lazy causelens context (ISSUE 14)
+        self._dep_src = _np.asarray(dep_src, _np.int32)
+        self._dep_dst = _np.asarray(dep_dst, _np.int32)
         self._graph = engine._shard(n, dep_src, dep_dst)
         self._n_pad = self._graph.n_pad
         self._mesh = engine._exec_mesh
@@ -687,8 +692,15 @@ class ShardedResidentSession:
         diag = batch_topk_diag(stack, idx)
         diag, vals, idx = self._fetch_topk(diag[0], vals[0], idx[0])
         latency_ms = (_time.perf_counter() - t0) * 1e3
+        from rca_tpu.engine.runner import make_attribution_ctx
+
         return render_result(
             diag, vals, idx, names, self._n, k, latency_ms,
             self._n_edges, engine=self.engine.engine_tag,
             sanitized_rows=int(n_bad), stacked_dev=stack[0],
+            attribution_ctx=make_attribution_ctx(
+                features, self._dep_src, self._dep_dst,
+                self.engine.params, names,
+                self.engine.config.shape_buckets,
+            ),
         )

@@ -167,7 +167,6 @@ class ShardedStreamingSession(StreamingHostState):
         # engaged above — so the kernel table shows the shape ran
         from rca_tpu.engine.registry import engaged_kernel
 
-        self.noisyor_path = "xla"
         self.kernel_path = engaged_kernel(
             self._n_pad, graph.src_local.shape[1], sharded=True,
         )

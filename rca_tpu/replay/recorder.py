@@ -193,6 +193,11 @@ class Recorder:
             "changed_rows": int(out.get("changed_rows", 0)),
             "health": {k: health.get(k) for k in _HEALTH_KEYS},
         }
+        if "attribution_digest" in out:
+            # causelens (ISSUE 14): the digest of this tick's attribution
+            # block — `rca replay --explain` recomputes the block from
+            # the tape and parity-checks against THIS
+            frame["attribution_digest"] = out["attribution_digest"]
         if features is not None:
             f = np.asarray(features, np.float32)
             # one vectorized CRC pass over the host mirror (ISSUE 10);

@@ -133,6 +133,12 @@ class _StreamRun:
     delivered: Dict[int, List[dict]]  # tick -> delivered ranking
     mismatched: List[int]             # ticks whose digest diverged
     unconsumed_calls: int             # recorded calls replay never made
+    # causelens (ISSUE 14): attribution-digest parity per tick —
+    # compared wherever the recorded frame carries a digest
+    attribution_compared: int = 0
+    attribution_mismatched: List[int] = dataclasses.field(
+        default_factory=list
+    )
 
 
 def _engine_for(rec: Recording, engine: Any) -> Any:
@@ -177,6 +183,10 @@ def _replay_session(rec: Recording, source: ReplaySource, engine: Any,
         # is harmless because ReplaySource only advertises get_columnar
         # when coldiff frames exist
         use_columnar=bool(info.get("use_columnar", True)),
+        # pin the recorded explain mode the same way (ISSUE 14): an
+        # explained recording recomputes its per-tick attribution
+        # digests on replay so they can parity-check against the tape
+        explain=bool(info.get("explain", False)),
     )
 
 
@@ -212,6 +222,8 @@ def _run_stream(rec: Recording, engine: Any = None,
     session = _replay_session(rec, source, _engine_for(rec, engine), depth)
     delivered: Dict[int, List[dict]] = {}
     mismatched: List[int] = []
+    attribution_compared = 0
+    attribution_mismatched: List[int] = []
     unconsumed = 0
     for t in src_ticks:
         source.advance(t)
@@ -220,8 +232,18 @@ def _run_stream(rec: Recording, engine: Any = None,
         unconsumed += source.unconsumed()
         if compare and _tick_diverged(rec.ticks[t], out["ranked"], parity):
             mismatched.append(t)
+        recorded_digest = rec.ticks[t].get("attribution_digest")
+        if compare and recorded_digest is not None:
+            # causelens parity (ISSUE 14): the replayed session
+            # recomputed this tick's attribution from the tape — its
+            # digest must match what the live session recorded
+            attribution_compared += 1
+            if out.get("attribution_digest") != recorded_digest:
+                attribution_mismatched.append(t)
     return _StreamRun(session=session, delivered=delivered,
-                      mismatched=mismatched, unconsumed_calls=unconsumed)
+                      mismatched=mismatched, unconsumed_calls=unconsumed,
+                      attribution_compared=attribution_compared,
+                      attribution_mismatched=attribution_mismatched)
 
 
 def _serial_sequence(by_tick: Dict[int, List[dict]], depth: int
@@ -241,6 +263,7 @@ def replay_stream(
     seek: Optional[int] = None,
     ticks: Optional[int] = None,
     parity: str = "exact",
+    explain: bool = False,
 ) -> Dict[str, Any]:
     """Replay a stream recording and score per-tick parity.
 
@@ -291,6 +314,27 @@ def replay_stream(
         report["first_divergent_tick"] = (
             run.mismatched[0] if run.mismatched else None
         )
+        # causelens parity (ISSUE 14): compared automatically wherever
+        # recorded frames carry attribution digests; ``explain=True``
+        # (`rca replay --explain`) additionally REQUIRES them — a
+        # recording made without RCA_EXPLAIN cannot satisfy the gate
+        if run.attribution_compared or explain:
+            report["attribution_ticks_compared"] = run.attribution_compared
+            report["attribution_mismatched_ticks"] = (
+                run.attribution_mismatched[:_MISMATCH_DETAIL_CAP]
+            )
+            attribution_ok = not run.attribution_mismatched and (
+                run.attribution_compared > 0 or not explain
+            )
+            report["attribution_parity_ok"] = attribution_ok
+            if explain and run.attribution_compared == 0:
+                report["attribution_error"] = (
+                    "recording carries no attribution digests "
+                    "(record with RCA_EXPLAIN=1)"
+                )
+            report["parity_ok"] = bool(
+                report["parity_ok"] and attribution_ok
+            )
     else:
         recorded_serial = _serial_sequence(
             {t: rec.ticks[t]["ranked"] for t in run.delivered}, rec_depth
@@ -313,6 +357,14 @@ def replay_stream(
         report["serial_ticks_compared"] = n
         report["parity_ok"] = first is None and run.unconsumed_calls == 0
         report["first_divergent_serial"] = first
+        if explain:
+            # delivered rankings shift by the lag difference, so the
+            # per-tick digest pairing is undefined across depths
+            report["attribution_parity_ok"] = None
+            report["attribution_error"] = (
+                "cross-depth replay: attribution digests compare only "
+                "at the recorded pipeline depth"
+            )
     if seek is not None:
         t = seek
         recd = rec.ticks.get(t)

@@ -363,6 +363,15 @@ def run_chaos_soak(
                 "ticks_replayed": report["ticks_replayed"],
                 "unconsumed_calls": report["unconsumed_calls"],
             })
+            if report.get("attribution_ticks_compared") is not None:
+                # causelens (ISSUE 14): an explained recording's digests
+                # re-verified from the tape (folded into parity_ok too)
+                replay_summary["attribution_ticks_compared"] = (
+                    report["attribution_ticks_compared"]
+                )
+                replay_summary["attribution_parity_ok"] = (
+                    report["attribution_parity_ok"]
+                )
     soak_memory.sample()  # closing sample so short soaks still gate
     scope = live.recompile_monitor.snapshot()
     kernelscope_summary = {

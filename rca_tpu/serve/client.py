@@ -65,6 +65,7 @@ class ServeClient:
         deadline_ms: Optional[float] = None,
         investigation_id: Optional[str] = None,
         trace_parent=None,
+        explain: bool = False,
     ) -> ServeRequest:
         """Queue one analyze request; returns immediately with the
         request future (``queue_full``/``shed`` outcomes are already
@@ -80,7 +81,7 @@ class ServeClient:
             tenant=tenant, features=features, dep_src=dep_src,
             dep_dst=dep_dst, names=names, k=k, priority=priority,
             deadline_s=deadline_s, investigation_id=investigation_id,
-            trace_parent=trace_parent,
+            trace_parent=trace_parent, explain=explain,
         )
         self.loop.submit(req)
         return req

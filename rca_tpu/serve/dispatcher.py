@@ -390,7 +390,7 @@ class BatchDispatcher:
         stays on device behind each result's lazy diagnostics."""
         import jax
 
-        from rca_tpu.engine.runner import render_result
+        from rca_tpu.engine.runner import make_attribution_ctx, render_result
 
         if self.fault_hook is not None:
             self.fault_hook("fetch")
@@ -410,5 +410,13 @@ class BatchDispatcher:
                 # poisons every hypothesis built from the same snapshot
                 sanitized_rows=int(n_bad),
                 stacked_dev=handle.stacked[b],
+                # causelens (ISSUE 14): the request's own copied arrays
+                # back the lazy attribution — computed only when the
+                # request asked to be explained (ServeRequest.explain)
+                attribution_ctx=make_attribution_ctx(
+                    req.features, req.dep_src, req.dep_dst,
+                    self.engine.params, req.names,
+                    self.engine.config.shape_buckets,
+                ),
             ))
         return results

@@ -329,6 +329,9 @@ class ServeLoop:
                                 self.dispatcher, "engine_tag", ""
                             ),
                             "kernel": getattr(handle, "kernel", None),
+                            "explain": bool(
+                                getattr(req, "explain", False)
+                            ),
                             "resident_delta": bool(getattr(
                                 handle, "resident_delta", False
                             )),

@@ -47,6 +47,9 @@ class ServeMetrics:
         # the resident base saved them from uploading
         self._graph_cache = {"hit": 0, "miss": 0, "eviction": 0}
         self._resident: Dict[str, Dict[str, int]] = {}
+        # causelens (ISSUE 14): per-tenant explain-request counts — the
+        # requests that asked for (and were charged) an attribution pass
+        self._explained: Dict[str, int] = {}
         # serve-pool observability (ISSUE 8): per-replica dispatch
         # counters + occupancy samples, work-steal accounting, and the
         # last reported breaker/liveness state — `rca serve --selftest`
@@ -85,6 +88,12 @@ class ServeMetrics:
     def errors(self, tenant: str) -> None:
         with self._lock:
             self._tenant(tenant)["errors"] += 1
+
+    def explained(self, tenant: str) -> None:
+        """One request served with its causelens attribution (the
+        ``ServeRequest.explain`` flag — ISSUE 14)."""
+        with self._lock:
+            self._explained[tenant] = self._explained.get(tenant, 0) + 1
 
     def request_duration(
         self, tenant: str, seconds: float, ok: bool,
@@ -184,6 +193,7 @@ class ServeMetrics:
                 "resident": {
                     t: dict(r) for t, r in self._resident.items()
                 },
+                "explained": dict(self._explained),
                 "replicas": {
                     rid: dict(rec)
                     for rid, rec in self._replicas.items()
@@ -215,6 +225,7 @@ class ServeMetrics:
                 "queue_ms_p99": queue_ms.quantile(tenant, 0.99),
                 "resident_delta_requests": treuse["delta_requests"],
                 "resident_rows_saved": treuse["rows_saved"],
+                "explain_requests": snap["explained"].get(tenant, 0),
             }
         occ = snap["occupancy"]
         occ_sorted = sorted(occ)

@@ -142,6 +142,19 @@ class CompletionSink:
            dispatched_at: float) -> None:
         ranked = [dict(r) for r in result.ranked]
         self.remember(req.graph_key, ranked)
+        provenance = None
+        if getattr(req, "explain", False):
+            # causelens (ISSUE 14): one extra fused dispatch, charged to
+            # the explaining request only.  An attribution failure must
+            # never fail the ranking — the stub says what broke instead.
+            self.metrics.explained(req.tenant)
+            try:
+                provenance = result.attribution()
+            except Exception as exc:  # noqa: BLE001 - degrade, but say so
+                record_fault("serve.explain", exc)
+                provenance = {
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
         if self.recorder is not None:
             # a recording failure must not fail the response; the sink
             # lock serializes frames now that N workers write through it
@@ -151,11 +164,18 @@ class CompletionSink:
         queue_ms = max(0.0, (dispatched_at - req.enqueued_at) * 1e3)
         self.metrics.answered(req.tenant, queue_ms)
         self._store_note(req, result)
+        if (provenance is not None and self.store is not None
+                and req.investigation_id is not None):
+            # `rca why <investigation-id>` reads this back (ISSUE 14)
+            with suppressed("serve.store_provenance"):
+                self.store.set_provenance(
+                    req.investigation_id, provenance,
+                )
         self._complete(req, ServeResponse(
             status="ok", request_id=req.request_id, tenant=req.tenant,
             ranked=ranked, queue_ms=round(queue_ms, 3), batch_size=width,
             deadline_missed=req.expired(self.clock()),
-            result=result,
+            result=result, provenance=provenance,
         ))
 
     def shed(self, req: ServeRequest, detail: str) -> None:
@@ -551,9 +571,7 @@ class ReplicaWorker:
                             self.dispatcher, "engine_tag", ""
                         ),
                         "kernel": getattr(handle, "kernel", None),
-                        "noisyor_path": getattr(
-                            handle, "noisyor", None
-                        ),
+                        "explain": bool(getattr(req, "explain", False)),
                         "resident_delta": bool(getattr(
                             handle, "resident_delta", False
                         )),

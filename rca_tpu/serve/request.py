@@ -74,6 +74,11 @@ class ServeResponse:
     batch_size: int = 0          # occupancy of the batch this request rode
     deadline_missed: bool = False  # served, but past its deadline
     result: Optional[object] = None  # EngineResult for ok responses
+    # causelens provenance (ISSUE 14): present only when the request set
+    # ``explain`` and was served ok — the schema-versioned attribution
+    # block (or an ``{"error": ...}`` stub when attribution itself failed;
+    # an explain failure must never fail the ranking)
+    provenance: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -96,6 +101,11 @@ class ServeRequest:
     deadline_s: Optional[float] = None  # absolute, scheduler clock domain
     cost: float = 1.0            # weighted-fair-queue charge
     investigation_id: Optional[str] = None  # optional store append target
+    # causelens (ISSUE 14): serve this request WITH its attribution — the
+    # sink computes the provenance block after the fetch (one extra fused
+    # dispatch, charged to the explaining request only) and rides it on
+    # the response; per-tenant explain counts land in ServeMetrics
+    explain: bool = False
     # distributed tracing (ISSUE 11): ``trace_parent`` is the caller's
     # span context (the gateway's request span, or whatever rode in on
     # X-RCA-Trace); ``trace`` is THIS request's root-span identity,

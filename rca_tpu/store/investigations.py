@@ -231,6 +231,24 @@ class InvestigationStore:
         inv = self._read(investigation_id)
         return (inv or {}).get("recording_ref")
 
+    def set_provenance(
+        self, investigation_id: str, provenance: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Attach the LATEST causelens provenance block (ISSUE 14) —
+        `rca why <id>` renders the blame tree from this field.  Last
+        write wins: an investigation's attribution tracks its most
+        recent explained ranking."""
+        return self._update(
+            investigation_id,
+            lambda inv: inv.__setitem__("provenance", provenance),
+        )
+
+    def get_provenance(
+        self, investigation_id: str
+    ) -> Optional[Dict[str, Any]]:
+        inv = self._read(investigation_id)
+        return (inv or {}).get("provenance")
+
     def save_hypothesis(
         self, investigation_id: str, hypothesis: Dict[str, Any]
     ) -> Optional[Dict[str, Any]]:

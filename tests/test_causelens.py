@@ -1,0 +1,445 @@
+"""causelens (ISSUE 14): evidence attribution + blame-path provenance.
+
+The contract under test:
+
+- **completeness axiom**: per-channel contributions reconstruct
+  ``combine_score`` within 1e-5 (float32 kernels), at three shapes;
+- **rank stability**: blame ordering (candidates, counterfactual order,
+  blame-path nodes) is identical across the ``xla | segscan | doubling``
+  kernels and invariant under ``RCA_TRACE``;
+- **surfaces**: lazy ``EngineResult.attribution()``, serve
+  ``ServeRequest.explain`` + per-tenant metrics, gateway ``?explain=1``
+  + ``GET /v1/explain/<id>`` + ``/metrics`` family, findings provenance,
+  ``rca why`` rendering, and the registry's ``attribution`` variant row;
+- **replay**: per-tick attribution digests recorded with
+  ``RCA_EXPLAIN=1`` parity-check from the tape (``rca replay
+  --explain``), including through a 40-tick chaos soak where degraded
+  ticks must still carry finite attributions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.generator import (
+    synthetic_cascade_arrays,
+    synthetic_cascade_world,
+)
+from rca_tpu.cluster.mock_client import MockClusterClient
+from rca_tpu.engine.registry import kernel_table, reset_registry
+from rca_tpu.engine.runner import EngineResult, GraphEngine
+
+RECONSTRUCTION_TOL = 1e-5
+
+
+def _analyze(n=48, seed=3, k=5, engine=None):
+    case = synthetic_cascade_arrays(n, n_roots=1, seed=seed)
+    engine = engine or GraphEngine()
+    res = engine.analyze_arrays(
+        case.features, case.dep_src, case.dep_dst, case.names, k=k,
+    )
+    return case, res
+
+
+# -- completeness axiom -------------------------------------------------------
+
+@pytest.mark.parametrize("n", [24, 96, 300])
+def test_completeness_reconstruction(n):
+    """Per-channel contributions rebuild a, and a × impact ×
+    suppression rebuilds the combined score within 1e-5 — at three
+    shapes (three padded tiers)."""
+    _case, res = _analyze(n=n, seed=7)
+    block = res.attribution()["attribution"]
+    assert block["schema"] == 1
+    assert block["candidates"], "no candidates attributed"
+    for cand in block["candidates"]:
+        assert cand["reconstruction_error"] <= RECONSTRUCTION_TOL, cand
+        # the factors the reconstruction multiplies are the block's own
+        f = cand["factors"]
+        rebuilt = f["evidence"] * f["impact"] * f["suppression"]
+        assert abs(rebuilt - cand["score"]) <= RECONSTRUCTION_TOL
+    # the block is finite everywhere (json with allow_nan=False raises
+    # on any NaN/Inf) and deterministically digestable
+    json.dumps(block, allow_nan=False)
+    assert res.attribution()["digest"]
+
+
+def test_attribution_deterministic_and_cached():
+    _case, res = _analyze(seed=5)
+    first = res.attribution()
+    assert res.attribution() is first          # cached per result
+    _case2, res2 = _analyze(seed=5)            # fresh result, same inputs
+    assert res2.attribution()["digest"] == first["digest"]
+
+
+def test_attribution_requires_context():
+    bare = EngineResult(["a"], [], 0.0, 1, 0)
+    with pytest.raises(ValueError):
+        bare.attribution()
+
+
+def test_counterfactual_self_mask_drops_own_score():
+    """Masking the top candidate's own evidence row must drop its score
+    by (approximately) the whole score — the strongest counterfactual
+    names itself."""
+    _case, res = _analyze(n=48, seed=3)
+    top = res.attribution()["attribution"]["candidates"][0]
+    self_cf = [c for c in top["counterfactuals"] if c["self"]]
+    assert self_cf, "top candidate's own row was not in the mask set"
+    assert self_cf[0]["score_drop"] == max(
+        c["score_drop"] for c in top["counterfactuals"]
+    )
+
+
+# -- registry: the attribution variant ---------------------------------------
+
+def test_registry_attribution_variant_row():
+    _case, res = _analyze(n=48, seed=3)
+    res.attribution()
+    rows = [r for r in kernel_table() if r["variant"] == "attribution"]
+    assert rows, "attribution dispatch left no registry row"
+    row = rows[0]
+    assert row["winner"] == "xla"
+    assert row["source"] == "attribution"
+    # every non-xla kernel names WHY it sat out
+    for kern in ("pallas", "segscan", "quantized", "doubling"):
+        assert isinstance(row["eligible"][kern], str)
+    # the observed per-shape cost landed in the row's timings
+    assert row["timings_ms"].get("attribution") is not None
+
+
+# -- rank stability across kernels and knobs ---------------------------------
+
+def _blame_key(prov):
+    return [
+        (
+            c["component"],
+            tuple(e["component"] for e in c["counterfactuals"]),
+            tuple(h["to"] for h in c["blame_path"]),
+        )
+        for c in prov["attribution"]["candidates"]
+    ]
+
+
+def test_blame_order_rank_stable_across_kernels(monkeypatch):
+    """The attribution sweep runs through its own registry variant, so
+    the blame ordering must be IDENTICAL whichever serving kernel the
+    ranking came from (xla | segscan | doubling)."""
+    case = synthetic_cascade_arrays(96, n_roots=1, seed=9)
+    outs = {}
+    try:
+        for kern in ("xla", "segscan", "doubling"):
+            monkeypatch.setenv("RCA_KERNEL", kern)
+            reset_registry()
+            res = GraphEngine().analyze_arrays(
+                case.features, case.dep_src, case.dep_dst, case.names,
+                k=5,
+            )
+            outs[kern] = (_blame_key(res.attribution()),
+                          res.attribution()["digest"])
+    finally:
+        monkeypatch.delenv("RCA_KERNEL", raising=False)
+        reset_registry()
+    assert outs["xla"][0] == outs["segscan"][0] == outs["doubling"][0]
+    assert outs["xla"][1] == outs["segscan"][1] == outs["doubling"][1]
+
+
+def test_attribution_invariant_under_trace():
+    """RCA_TRACE must not move an attribution bit: a traced session and
+    a null-tracer session produce identical per-tick digests."""
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.observability.spans import Tracer
+
+    def run(tracer):
+        world = synthetic_cascade_world(16, n_roots=1, seed=5)
+        sess = LiveStreamingSession(
+            MockClusterClient(world), "synthetic", k=5,
+            tracer=tracer, explain=True,
+        )
+        return [sess.poll().get("attribution_digest") for _ in range(4)]
+
+    traced = run(Tracer(seed=2))
+    untraced = run(None)  # the RCA_TRACE=0 null default
+    assert all(traced) and traced == untraced
+
+
+# -- serve + gateway surfaces -------------------------------------------------
+
+def test_serve_explain_response_and_metrics():
+    from rca_tpu.serve import ServeClient, ServeLoop
+
+    case = synthetic_cascade_arrays(48, n_roots=1, seed=3)
+    loop = ServeLoop(engine=GraphEngine())
+    with loop:
+        client = ServeClient(loop)
+        r_explained = client.analyze(
+            case.features, case.dep_src, case.dep_dst,
+            names=case.names, tenant="t1", explain=True,
+        )
+        r_plain = client.analyze(
+            case.features, case.dep_src, case.dep_dst,
+            names=case.names, tenant="t1",
+        )
+    assert r_explained.ok and r_plain.ok
+    assert r_explained.provenance is not None
+    assert r_explained.provenance["schema"] == 1
+    assert r_explained.provenance["attribution"]["candidates"]
+    assert r_plain.provenance is None
+    tenants = loop.metrics.summary()["tenants"]
+    assert tenants["t1"]["explain_requests"] == 1
+    # rankings are unaffected by the explain flag
+    assert r_explained.ranked == r_plain.ranked
+
+
+def test_gateway_explain_query_endpoint_and_metrics():
+    import http.client
+
+    from rca_tpu.gateway import GatewayServer
+    from rca_tpu.gateway.wire import encode_analyze
+    from rca_tpu.serve import ServeLoop
+
+    case = synthetic_cascade_arrays(32, n_roots=1, seed=3)
+    loop = ServeLoop(engine=GraphEngine()).start()
+    gw = GatewayServer(loop, port=0).start()
+    try:
+        conn = http.client.HTTPConnection(gw.host, gw.port, timeout=60)
+        body = json.dumps(encode_analyze(
+            case.features, case.dep_src, case.dep_dst,
+            names=list(case.names),
+        )).encode()
+        conn.request("POST", "/v1/analyze?explain=1", body,
+                     {"X-RCA-Tenant": "wire-t"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200, out
+        assert out["provenance"]["schema"] == 1
+        # retained for the follow-up read, keyed by request id (tracing
+        # is off here) — and a miss is an honest 404
+        conn.request("GET", f"/v1/explain/{out['request_id']}")
+        r2 = conn.getresponse()
+        o2 = json.loads(r2.read())
+        assert r2.status == 200
+        assert o2["provenance"] == out["provenance"]
+        conn.request("GET", "/v1/explain/absent")
+        r3 = conn.getresponse()
+        r3.read()
+        assert r3.status == 404
+        # body-field twin of the query param
+        body2 = json.dumps(encode_analyze(
+            case.features, case.dep_src, case.dep_dst,
+            names=list(case.names), explain=True,
+        )).encode()
+        conn.request("POST", "/v1/analyze", body2,
+                     {"X-RCA-Tenant": "wire-t"})
+        r4 = conn.getresponse()
+        o4 = json.loads(r4.read())
+        assert o4["provenance"]["schema"] == 1
+        # un-explained requests carry no provenance
+        conn.request("POST", "/v1/analyze", body)
+        r5 = conn.getresponse()
+        o5 = json.loads(r5.read())
+        assert "provenance" not in o5
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        assert 'rca_explain_requests_total{tenant="wire-t"} 2' in text
+    finally:
+        gw.close()
+        loop.stop()
+
+
+def test_wire_decode_explain():
+    from rca_tpu.gateway.wire import WireError, decode_analyze
+
+    base = {
+        "features": [[0.0, 1.0]], "dep_src": [], "dep_dst": [],
+    }
+    assert decode_analyze(dict(base))["explain"] is False
+    assert decode_analyze({**base, "explain": True})["explain"] is True
+    with pytest.raises(WireError):
+        decode_analyze({**base, "explain": "yes"})
+
+
+# -- findings / coordinator / rca why ----------------------------------------
+
+def test_correlate_jax_attaches_provenance(monkeypatch):
+    from rca_tpu.agents.base import AnalysisContext
+    from rca_tpu.cluster.fixtures import NS, five_service_world
+    from rca_tpu.cluster.snapshot import ClusterSnapshot
+    from rca_tpu.coordinator.correlate import correlate_jax
+
+    monkeypatch.setenv("RCA_EXPLAIN", "1")
+    client = MockClusterClient(five_service_world())
+    snap = ClusterSnapshot.capture(client, NS)
+    ctx = AnalysisContext(snapshot=snap)
+    out = correlate_jax({}, ctx, top_k=5)
+    assert out["provenance"]["schema"] == 1
+    assert out["provenance"]["attribution"]["candidates"]
+    monkeypatch.delenv("RCA_EXPLAIN")
+    out2 = correlate_jax({}, ctx, top_k=5)
+    assert "provenance" not in out2
+
+
+def test_attach_provenance_schema_checked():
+    from rca_tpu.findings import attach_provenance
+
+    assert attach_provenance({}, None) == {}
+    with pytest.raises(ValueError):
+        attach_provenance({}, {"not": "versioned"})
+    out = attach_provenance({}, {"schema": 1, "attribution": {}})
+    assert out["provenance"]["schema"] == 1
+
+
+def test_rca_why_renders_blame_tree(tmp_path, capsys):
+    """The end-to-end `rca why` path: an explained serve request naming
+    an investigation stamps provenance into the store; the CLI renders
+    the blame tree from it."""
+    from rca_tpu.cli import main as cli_main
+    from rca_tpu.serve import ServeClient, ServeLoop
+    from rca_tpu.store import InvestigationStore
+
+    root = str(tmp_path / "logs")
+    store = InvestigationStore(root=root)
+    inv = store.create_investigation("causelens test", namespace="synthetic")
+    case = synthetic_cascade_arrays(48, n_roots=1, seed=3)
+    loop = ServeLoop(engine=GraphEngine(), store=store)
+    with loop:
+        resp = ServeClient(loop).analyze(
+            case.features, case.dep_src, case.dep_dst, names=case.names,
+            tenant="t1", explain=True, investigation_id=inv["id"],
+        )
+    assert resp.ok
+    assert store.get_provenance(inv["id"]) is not None
+    assert cli_main(["why", inv["id"], "--log-dir", root]) == 0
+    text = capsys.readouterr().out
+    assert "blame path" in text
+    assert resp.ranked[0]["component"] in text
+    # --json prints the raw block
+    assert cli_main(["why", inv["id"], "--log-dir", root, "--json"]) == 0
+    block = json.loads(capsys.readouterr().out)
+    assert block["schema"] == 1
+    # missing provenance / missing investigation are loud
+    inv2 = store.create_investigation("empty", namespace="synthetic")
+    assert cli_main(["why", inv2["id"], "--log-dir", root]) == 1
+    capsys.readouterr()
+    assert cli_main(["why", "nope", "--log-dir", root]) == 1
+    capsys.readouterr()
+
+
+# -- replay parity ------------------------------------------------------------
+
+def test_replay_explain_parity_and_requires_digests(tmp_path):
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.replay import Recorder, load_recording, replay_stream
+
+    def record(path, explain):
+        world = synthetic_cascade_world(16, n_roots=1, seed=5)
+        rec = Recorder(path, mode="stream")
+        sess = LiveStreamingSession(
+            MockClusterClient(world), "synthetic", k=5, recorder=rec,
+            explain=explain,
+        )
+        for _ in range(5):
+            out = sess.poll()
+            if explain:
+                assert out.get("attribution_digest")
+        rec.close()
+
+    explained = str(tmp_path / "explained")
+    record(explained, explain=True)
+    rec = load_recording(explained)
+    assert all(
+        fr.get("attribution_digest") for fr in rec.ticks.values()
+    )
+    report = replay_stream(explained, explain=True)
+    assert report["parity_ok"]
+    assert report["attribution_ticks_compared"] == 5
+    assert report["attribution_mismatched_ticks"] == []
+    # digests present in the tape are compared even WITHOUT the flag
+    report2 = replay_stream(explained)
+    assert report2["attribution_ticks_compared"] == 5
+    # --explain against an unexplained recording is an honest failure
+    plain = str(tmp_path / "plain")
+    record(plain, explain=False)
+    report3 = replay_stream(plain, explain=True)
+    assert not report3["parity_ok"]
+    assert "attribution" in report3["attribution_error"]
+    # ...and without the flag the unexplained recording still passes
+    assert replay_stream(plain)["parity_ok"]
+
+
+def test_chaos_soak_explained_40_ticks(tmp_path, monkeypatch):
+    """The 40-tick chaos leg: with RCA_EXPLAIN=1 every tick — degraded
+    ones included — carries a finite attribution digest, the recording
+    replays with attribution parity, and poll() never raises."""
+    from rca_tpu.replay import load_recording
+    from rca_tpu.resilience.chaos import ChaosConfig, run_chaos_soak
+
+    monkeypatch.setenv("RCA_EXPLAIN", "1")
+    rec_path = str(tmp_path / "rec")
+    summary = run_chaos_soak(
+        lambda: synthetic_cascade_world(20, n_roots=1, seed=11),
+        "synthetic", seed=14, ticks=40, config=ChaosConfig(seed=14),
+        record_path=rec_path,
+    )
+    assert summary["uncaught_exceptions"] == 0
+    assert summary["parity_ok"]
+    assert summary["replay"]["parity_ok"]
+    assert summary["replay"]["attribution_ticks_compared"] == 40
+    assert summary["replay"]["attribution_parity_ok"]
+    rec = load_recording(rec_path)
+    assert len(rec.ticks) == 40
+    for fr in rec.ticks.values():
+        # present AND finite on every tick, degraded or not (a digest
+        # only exists when the block json-serialized finitely)
+        assert fr.get("attribution_digest")
+
+
+def test_explain_config_knobs(monkeypatch):
+    from rca_tpu.config import explain_enabled, explain_paths, explain_topm
+
+    assert explain_enabled() is False
+    monkeypatch.setenv("RCA_EXPLAIN", "1")
+    assert explain_enabled() is True
+    monkeypatch.setenv("RCA_EXPLAIN_PATHS", "6")
+    monkeypatch.setenv("RCA_EXPLAIN_TOPM", "16")
+    assert explain_paths() == 6
+    assert explain_topm() == 16
+    monkeypatch.setenv("RCA_EXPLAIN_TOPM", "1000")
+    with pytest.raises(ValueError):
+        explain_topm()
+    monkeypatch.setenv("RCA_EXPLAIN", "maybe")
+    with pytest.raises(ValueError):
+        explain_enabled()
+
+
+def test_explain_knobs_shape_the_block():
+    case = synthetic_cascade_arrays(64, n_roots=1, seed=4)
+    res = GraphEngine().analyze_arrays(
+        case.features, case.dep_src, case.dep_dst, case.names, k=3,
+    )
+    prov = res.attribution(paths=2, topm=3)
+    block = prov["attribution"]
+    assert block["topm"] == 3 and block["paths"] == 2
+    assert len(block["evidence_rows"]) == 3
+    for cand in block["candidates"]:
+        assert len(cand["counterfactuals"]) == 3
+        assert len(cand["blame_path"]) <= 2
+
+
+def test_render_blame_tree_shapes():
+    from rca_tpu.observability.causelens import render_blame_tree
+
+    _case, res = _analyze(n=48, seed=3)
+    text = render_blame_tree(res.attribution())
+    assert "causelens v1" in text
+    assert "blame path" in text
+    assert "counterfactuals" in text
+    # empty block renders, not crashes
+    empty = {"schema": 1, "candidates": [], "k": 0}
+    assert "no ranked candidates" in render_blame_tree(
+        {"attribution": empty, "schema": 1, "digest": None}
+    )

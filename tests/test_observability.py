@@ -574,7 +574,6 @@ def test_profile_capture(tmp_path):
     summary = profile_ticks(str(tmp_path / "prof"), ticks=2, services=16,
                             seed=7, tracer=tracer)
     assert summary["ticks"] == 2
-    assert summary["noisyor_path"] in ("xla", "pallas")
     assert list(summary["kernel_by_shape"].values())[0] in (
         "xla", "pallas",
     )

@@ -192,7 +192,11 @@ def test_live_pipeline_60_tick_bit_parity():
     # serial health record advertises the serial contract
     assert serial[5][1]["pipeline_depth"] == 1
     assert serial[5][1]["result_lag"] == 0
-    assert serial[5][1]["noisyor_path"] in ("xla", "pallas")
+    # per-shape kernel attribution (the retired process-level
+    # noisyor_path stamp is gone — ISSUE 14 satellite)
+    assert serial[5][1]["kernel_path"] in (
+        "xla", "pallas", "segscan", "quantized", "doubling",
+    )
 
 
 def test_live_pipeline_under_chaos_never_raises_and_drains():

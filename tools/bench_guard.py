@@ -51,6 +51,16 @@ HEADLINE_METRICS = {
     "live_sweep_capture_ms_10k": ("live_sweep_capture_ms_10k",),
 }
 
+#: metrics gated TIGHTER than the default threshold, name -> (path,
+#: threshold).  causelens (ISSUE 14): attribution is lazy, so explain-off
+#: serving must be within 5% of the previous round — a bigger delta means
+#: the default path grew attribution work it was promised not to carry.
+TIGHT_METRICS = {
+    "attribution_explain_off_p50": (
+        ("attribution", "explain_off_request_ms_p50"), 0.05,
+    ),
+}
+
 DEFAULT_THRESHOLD = 0.15
 
 #: a kernel winner flip must be backed by at least this fractional
@@ -168,7 +178,14 @@ def compare(current: Dict[str, Any], baseline: Dict[str, Any],
     the kernel table records an unjustified winner flip."""
     metrics: Dict[str, Dict[str, Any]] = {}
     ok = True
-    for name, path in HEADLINE_METRICS.items():
+    named = [
+        (name, path, threshold)
+        for name, path in HEADLINE_METRICS.items()
+    ] + [
+        (name, path, tight)
+        for name, (path, tight) in TIGHT_METRICS.items()
+    ]
+    for name, path, gate in named:
         cur = _dig(current, path)
         base = _dig(baseline, path)
         if cur is None or base is None or base <= 0:
@@ -180,13 +197,14 @@ def compare(current: Dict[str, Any], baseline: Dict[str, Any],
             }
             continue
         change = (float(cur) - float(base)) / float(base)
-        regressed = change > threshold
+        regressed = change > gate
         if regressed:
             ok = False
         metrics[name] = {
             "status": "regressed" if regressed else "ok",
             "current": round(float(cur), 3),
             "baseline": round(float(base), 3),
+            "threshold_pct": round(gate * 100.0, 1),
             "change_pct": round(change * 100.0, 1),
         }
     report = {
