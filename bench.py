@@ -1284,6 +1284,208 @@ def serve_pool_metrics(
     }
 
 
+def serve_federation_metrics(
+    workers: int = 3,
+    concurrency: int = 8,
+    n_requests: int = 48,
+    services: int = 256,
+    seed: int = 0,
+) -> dict:
+    """``serve_federation`` (ISSUE 15): the cross-process serving plane
+    — ``workers`` localhost worker PROCESSES behind one control plane —
+    vs the single-process ServeLoop on the same host, closed loop at
+    ``concurrency``.  Three legs:
+
+    - **throughput**: request p50/p99 over a multi-bucket mix, single
+      process vs federation (the federation pays one wire hop +
+      JSON codec per request; on a 1-core host the worker processes
+      also contend for the CPU — ``host_cores`` is printed so the
+      number reads honestly);
+    - **kill**: SIGKILL one worker mid-wave — asserts every request
+      terminal, ``double_completions == 0``, and reports
+      ``recovery_ms`` (kill → all terminal);
+    - **liveness**: the lease-expiry detection lag observed for the
+      killed worker (EOF path) and the configured TTL.
+    """
+    import threading
+    import time
+
+    import numpy as np
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine.runner import GraphEngine
+    from rca_tpu.serve.federation import FederationPlane
+    from rca_tpu.serve.loop import ServeLoop
+    from rca_tpu.serve.request import ServeRequest
+
+    cases = [
+        synthetic_cascade_arrays(services, n_roots=1, seed=seed + i)
+        for i in range(4)
+    ]
+    rng = np.random.default_rng(seed)
+    plan = []
+    for i in range(n_requests):
+        case = cases[i % len(cases)]
+        feats = np.clip(
+            case.features + rng.uniform(
+                0, 0.05, case.features.shape
+            ).astype(np.float32),
+            0, 1,
+        )
+        plan.append((case, feats))
+
+    def closed_loop(submit, kill_at=None, kill_fn=None):
+        """Closed-loop wave: ``concurrency`` submitters each walk their
+        slice serially.  Returns (wall_s, per-request ms, responses,
+        kill timestamp)."""
+        latencies = [0.0] * len(plan)
+        responses = [None] * len(plan)
+        killed_at = [None]
+        lock = threading.Lock()
+        done_count = [0]
+
+        def worker_thread(w):
+            for i in range(w, len(plan), concurrency):
+                case, feats = plan[i]
+                with lock:
+                    n = done_count[0]
+                    if (kill_at is not None and n >= kill_at
+                            and killed_at[0] is None):
+                        killed_at[0] = time.perf_counter()
+                        kill_fn()
+                t0 = time.perf_counter()
+                req = ServeRequest(
+                    tenant=f"bench-{w % 4}", features=feats,
+                    dep_src=case.dep_src, dep_dst=case.dep_dst,
+                    names=case.names, k=3,
+                )
+                submit(req)
+                responses[i] = req.result(300.0)
+                latencies[i] = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    done_count[0] += 1
+
+        threads = [
+            threading.Thread(target=worker_thread, args=(w,), daemon=True)
+            for w in range(concurrency)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return (time.perf_counter() - t0, latencies, responses,
+                killed_at[0])
+
+    def pcts(lat):
+        s = sorted(lat)
+        return (round(s[len(s) // 2], 2),
+                round(s[int(len(s) * 0.99) - 1], 2))
+
+    # single-process baseline (same plan, same closed loop)
+    solo_loop = ServeLoop(engine=GraphEngine())
+    with solo_loop:
+        # warm every bucket's executable out of the measurement
+        for case, feats in plan[:len(cases)]:
+            req = ServeRequest(tenant="warm", features=feats,
+                               dep_src=case.dep_src, dep_dst=case.dep_dst,
+                               names=case.names, k=3)
+            solo_loop.submit(req)
+            req.result(300.0)
+        _, solo_lat, solo_resps, _ = closed_loop(solo_loop.submit)
+    solo_p50, solo_p99 = pcts(solo_lat)
+    assert all(r is not None and r.ok for r in solo_resps)
+
+    # federation throughput leg
+    plane = FederationPlane(workers=workers, heartbeat_s=0.2)
+    with plane:
+        ready = plane.wait_ready(workers, timeout_s=120.0)
+        assert ready, f"federation bench: workers failed to join"
+        startup_s = None
+        for case, feats in plan[:len(cases)]:
+            req = ServeRequest(tenant="warm", features=feats,
+                               dep_src=case.dep_src, dep_dst=case.dep_dst,
+                               names=case.names, k=3)
+            plane.submit(req)
+            req.result(300.0)
+        wall_s, fed_lat, fed_resps, _ = closed_loop(plane.submit)
+        assert all(r is not None for r in fed_resps)
+        fed_ok = sum(1 for r in fed_resps if r.ok)
+        fed_double = plane.sink.double_completions
+    fed_p50, fed_p99 = pcts(fed_lat)
+
+    # kill leg: fresh fleet, SIGKILL one worker mid-wave
+    plane2 = FederationPlane(workers=workers, heartbeat_s=0.2)
+    with plane2:
+        assert plane2.wait_ready(workers, timeout_s=120.0)
+        for case, feats in plan[:len(cases)]:
+            req = ServeRequest(tenant="warm", features=feats,
+                               dep_src=case.dep_src, dep_dst=case.dep_dst,
+                               names=case.names, k=3)
+            plane2.submit(req)
+            req.result(300.0)
+
+        def kill_one():
+            live = plane2.live_workers()
+            if live:
+                plane2.kill_worker(live[0])
+
+        t_wave0 = time.perf_counter()
+        _, kill_lat, kill_resps, t_kill = closed_loop(
+            plane2.submit, kill_at=n_requests // 3, kill_fn=kill_one,
+        )
+        t_all_terminal = time.perf_counter()
+        # the federation kill contract, ASSERTED in the bench itself:
+        # nothing hung, nothing double-completed
+        assert all(r is not None for r in kill_resps), \
+            "federation kill leg: a request never completed"
+        assert plane2.sink.double_completions == 0, \
+            "federation kill leg: double completion"
+        kill_status: dict = {}
+        for r in kill_resps:
+            kill_status[r.status] = kill_status.get(r.status, 0) + 1
+        detect = [
+            e.get("detect_lag_ms") for e in plane2.events
+            if e["event"] == "worker_down"
+        ]
+        stale2 = plane2.stale_responses
+        ttl_s = plane2.leases.ttl_s
+
+    return {
+        "workers": workers,
+        "concurrency": concurrency,
+        "requests": n_requests,
+        "host_cores": len(os.sched_getaffinity(0)),
+        "solo_request_ms_p50": solo_p50,
+        "solo_request_ms_p99": solo_p99,
+        "request_ms_p50": fed_p50,
+        "request_ms_p99": fed_p99,
+        "wire_hop_overhead_ms_p50": round(fed_p50 - solo_p50, 2),
+        "throughput_rps": round(n_requests / max(wall_s, 1e-9), 1),
+        "ok_responses": fed_ok,
+        "double_completions": fed_double,
+        "kill_leg": {
+            "recovery_ms": round(
+                (t_all_terminal - t_kill) * 1e3, 1
+            ) if t_kill is not None else None,
+            "by_status": kill_status,
+            "all_terminal": True,      # asserted above
+            "double_completions": 0,   # asserted above
+            "stale_responses": stale2,
+        },
+        # the kill-leg recovery wall doubles as the guard metric
+        "recovery_ms": round(
+            (t_all_terminal - t_kill) * 1e3, 1
+        ) if t_kill is not None else None,
+        "lease": {
+            "ttl_s": ttl_s,
+            "detect_lag_ms": [
+                round(d, 1) for d in detect if d is not None
+            ],
+        },
+    }
+
+
 def main(skip_accuracy: bool = False, with_chaos: bool = False,
          guard: bool = False) -> int:
     """Stdout-hygiene wrapper: the whole measurement body runs with
@@ -1829,6 +2031,15 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
     except Exception as exc:
         gateway_line = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- serve federation (ISSUE 15): cross-process plane — worker
+    # processes over the wire protocol vs the single-process loop, the
+    # SIGKILL kill leg (all-terminal + 0 double completions asserted
+    # in-run), and lease-expiry detection latency
+    try:
+        serve_federation_line = serve_federation_metrics()
+    except Exception as exc:
+        serve_federation_line = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- observability (ISSUE 11): tracing overhead on/off at
     # concurrency 16, span drop rate under saturation, profile capture
     # cost for a 20-tick window
@@ -2074,6 +2285,9 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         # wire front door + canary (ISSUE 9): loopback overhead p50/p99,
         # 429 shed rate at 2x capacity, canary replay throughput
         "gateway": gateway_line,
+        # cross-process federation (ISSUE 15): wire-hop overhead vs the
+        # single-process loop, kill-leg recovery_ms, lease detect lag
+        "serve_federation": serve_federation_line,
         # tracing (ISSUE 11): overhead on/off, drop rate, profile cost
         "observability": observability_line,
         "tick_ms_10k": round(tick_ms_10k, 3),

@@ -19,6 +19,15 @@ attributable to its owner.  Library-internal sockets (``http.client``,
 the HTTP server's accepted connections) are stdlib code, out of scope
 by construction.
 
+The discipline covers long-lived CHILD PROCESSES too (ISSUE 15): raw
+``subprocess.Popen(...)``, ``os.fork()``, and any ``multiprocessing``
+construction outside ``rca_tpu/util/procs.py`` is a finding — the serve
+federation supervises worker processes, and a child spawned outside the
+seam has no owner name, no output capture, and no termination ladder,
+which is how orphaned workers and pipe-deadlocked chaos runs are born.
+One-shot ``subprocess.run``/``check_output`` calls (kubectl, git) stay
+legal: they own no life cycle to supervise.
+
 Subclassing ``threading.Thread`` stays legal (the subclass calls
 ``super().__init__(name=..., daemon=...)`` — it IS a named, explicit
 thread, and the model roots its ``run``); ``threading.Event`` stays
@@ -35,9 +44,11 @@ from rca_tpu.analysis.core import FileContext, Finding, Rule, register
 
 SEAM = "rca_tpu/util/threads.py"
 NET_SEAM = "rca_tpu/util/net.py"
+PROC_SEAM = "rca_tpu/util/procs.py"
 #: the rsan shim wraps the raw primitives by definition
 EXEMPT = (SEAM, "rca_tpu/analysis/concurrency/rsan.py")
 NET_EXEMPT = (NET_SEAM,)
+PROC_EXEMPT = (PROC_SEAM,)
 
 BANNED = {
     "Thread", "Lock", "RLock", "Condition", "Semaphore",
@@ -46,6 +57,12 @@ BANNED = {
 
 #: socket-constructing callables (module attribute form: socket.<name>)
 NET_BANNED = {"socket", "create_server", "create_connection"}
+
+#: long-lived child-process constructors: subprocess.Popen and os.fork
+#: (subprocess.run/call/check_output are one-shots and stay legal);
+#: multiprocessing is banned wholesale — ANY attribute call on the
+#: module (Process, Pool, fork helpers) builds unsupervised children
+PROC_BANNED = {("subprocess", "Popen"), ("os", "fork")}
 
 MESSAGE = (
     "raw `threading.{name}(...)` construction outside {seam} — use "
@@ -60,25 +77,37 @@ NET_MESSAGE = (
     "is decided once, and bind failures are attributable"
 )
 
+PROC_MESSAGE = (
+    "raw `{name}(...)` child-process construction outside {seam} — use "
+    "spawn_worker so the child is named, its output is drained into "
+    "bounded buffers, and it dies through the SIGTERM→SIGKILL ladder "
+    "(one-shot subprocess.run stays legal)"
+)
+
 
 @register
 class ThreadDisciplineRule(Rule):
     name = "thread-discipline"
     summary = ("threading.Thread/Lock/... constructed only via "
                "rca_tpu/util/threads.py (named, rsan-shimmable); "
-               "socket.socket only via rca_tpu/util/net.py")
-    why = ("an anonymous raw thread, lock, or listening socket is "
-           "invisible to gravelock's root discovery, the rsan "
-           "cross-check, and fd attribution — the analyses are only as "
-           "sound as the constructor seams are complete")
+               "socket.socket only via rca_tpu/util/net.py; "
+               "subprocess.Popen/os.fork/multiprocessing only via "
+               "rca_tpu/util/procs.py")
+    why = ("an anonymous raw thread, lock, listening socket, or child "
+           "process is invisible to gravelock's root discovery, the "
+           "rsan cross-check, and fd/pid attribution — the analyses "
+           "are only as sound as the constructor seams are complete")
 
     def applies_to(self, relpath: str) -> bool:
         return relpath.startswith("rca_tpu/") and relpath not in EXEMPT
 
     def scan(self, ctx: FileContext) -> List[Finding]:
-        # names imported straight from threading/socket count as raw too
+        # names imported straight from threading/socket/subprocess/os
+        # count as raw too
         from_threading = set()
         from_socket = set()
+        from_proc = set()
+        mp_aliases = {"multiprocessing"}
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ImportFrom):
                 if node.module == "threading":
@@ -89,8 +118,20 @@ class ThreadDisciplineRule(Rule):
                     for alias in node.names:
                         if alias.name in NET_BANNED:
                             from_socket.add(alias.asname or alias.name)
+                elif node.module in ("subprocess", "os"):
+                    for alias in node.names:
+                        if (node.module, alias.name) in PROC_BANNED:
+                            from_proc.add(alias.asname or alias.name)
+                elif node.module == "multiprocessing":
+                    for alias in node.names:
+                        from_proc.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "multiprocessing":
+                        mp_aliases.add(alias.asname or alias.name)
 
         net_applies = ctx.relpath not in NET_EXEMPT
+        proc_applies = ctx.relpath not in PROC_EXEMPT
         hits: List[Finding] = []
 
         def walk(node: ast.AST, func: str) -> None:
@@ -100,17 +141,24 @@ class ThreadDisciplineRule(Rule):
                 f = node.func
                 bad = None
                 bad_net = None
+                bad_proc = None
                 if (isinstance(f, ast.Attribute)
                         and isinstance(f.value, ast.Name)):
                     if f.value.id == "threading" and f.attr in BANNED:
                         bad = f.attr
                     elif f.value.id == "socket" and f.attr in NET_BANNED:
                         bad_net = f.attr
+                    elif (f.value.id, f.attr) in PROC_BANNED:
+                        bad_proc = f"{f.value.id}.{f.attr}"
+                    elif f.value.id in mp_aliases:
+                        bad_proc = f"{f.value.id}.{f.attr}"
                 elif isinstance(f, ast.Name):
                     if f.id in from_threading:
                         bad = f.id
                     elif f.id in from_socket:
                         bad_net = f.id
+                    elif f.id in from_proc:
+                        bad_proc = f.id
                 if bad is not None:
                     hits.append(ctx.finding(
                         self, node.lineno,
@@ -120,6 +168,12 @@ class ThreadDisciplineRule(Rule):
                     hits.append(ctx.finding(
                         self, node.lineno,
                         NET_MESSAGE.format(name=bad_net, seam=NET_SEAM),
+                        func=func,
+                    ))
+                elif bad_proc is not None and proc_applies:
+                    hits.append(ctx.finding(
+                        self, node.lineno,
+                        PROC_MESSAGE.format(name=bad_proc, seam=PROC_SEAM),
                         func=func,
                     ))
             for child in ast.iter_child_nodes(node):

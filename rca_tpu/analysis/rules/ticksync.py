@@ -43,6 +43,13 @@ TICK_MODULES = {
     # BatchDispatcher.fetch — including the steal path's orphan fetch
     "rca_tpu/serve/replica.py": set(),
     "rca_tpu/serve/pool.py": set(),
+    # federation (ISSUE 15): the coordinator routes WIRE frames and the
+    # worker agent parks on req.result() — neither may ever touch the
+    # device; each worker's own ServeLoop keeps fetch as its one sync
+    "rca_tpu/serve/federation.py": set(),
+    "rca_tpu/serve/worker.py": set(),
+    "rca_tpu/serve/fedwire.py": set(),
+    "rca_tpu/util/procs.py": set(),
     # gateway (ISSUE 9): the wire front door never touches the device —
     # handlers park on req.result() like any in-process submitter, so
     # fetch stays the serve path's ONE sync point even under wire load

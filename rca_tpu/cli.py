@@ -427,12 +427,27 @@ def cmd_chaos(args) -> int:
         record_path=args.record,
         pipeline_depth=getattr(args, "pipeline_depth", None),
     )
+    # federation chaos leg (ISSUE 15): the three PROCESS-level fault
+    # classes — seeded process_kill, worker_hang, coordinator_partition
+    # — driven against a live worker fleet under wire load, gated on
+    # all-terminal + zero double completions + every class observed +
+    # rejoin.  Short exploratory runs (--ticks < 100) skip it, same
+    # policy as the all-classes-observed gate above.
+    fed_ok = True
+    if not getattr(args, "no_federation", False) and args.ticks >= 100:
+        from rca_tpu.serve.federation import run_federation_chaos
+
+        summary["federation"] = run_federation_chaos(
+            seed=seed, workers=args.federation_workers,
+        )
+        fed_ok = summary["federation"]["ok"]
     print(json.dumps(summary, indent=None if args.compact else 2))
     scope = summary.get("kernelscope", {})
     ok = (
         summary["uncaught_exceptions"] == 0
         and summary["parity_ok"]
         and (summary["all_classes_observed"] or args.ticks < 100)
+        and fed_ok
         # --record adds the record→replay parity leg to the contract
         and summary.get("replay", {}).get("parity_ok", True)
         # kernelscope gates (ISSUE 12): zero post-warmup recompiles on
@@ -471,6 +486,25 @@ def cmd_serve(args) -> int:
     config = ServeConfig.from_env(**overrides)
     if args.listen:
         return _serve_listen(args, config)
+    if args.federation is not None or args.kill_worker:
+        # cross-process federation selftest (ISSUE 15): N real worker
+        # processes, wire load, optional SIGKILL mid-wave — exit 0 only
+        # when every request is terminal, federation rankings are
+        # bit-identical to the single-process engine, and
+        # double_completions == 0
+        from rca_tpu.serve.federation import federation_selftest
+
+        summary = federation_selftest(
+            workers=args.federation or 3,
+            n_requests=args.requests,
+            seed=args.seed,
+            kill_worker=args.kill_worker,
+            submitters=args.submitters,
+            config=config,
+        )
+        print(json.dumps(summary, indent=None if args.compact else 2,
+                         default=str))
+        return 0 if summary["ok"] else 1
     if args.selftest:
         from rca_tpu.serve import serve_selftest
 
@@ -575,13 +609,35 @@ def _serve_listen(args, config) -> int:
     # wire requests carrying an investigation_id land store notes +
     # recording_ref exactly like in-process submissions
     store = InvestigationStore(root=args.log_dir)
+    federated = getattr(args, "federation", None)
     pooled = len(config.replica_specs()) > 1
-    if pooled:
+    if federated and recorder is not None:
+        raise SystemExit(
+            "--record is not supported with --federation yet: serve "
+            "frames live in the worker processes (use `rca canary "
+            "--listen-url` to mint recordings off the live gateway)"
+        )
+    if federated:
+        # the TLS+authn front door over a whole worker fleet (ISSUE 15)
+        from rca_tpu.serve.federation import FederationPlane
+
+        loop = FederationPlane(
+            workers=federated, config=config, store=store,
+        )
+        loop.start()
+        if not loop.wait_ready(federated, timeout_s=120.0):
+            loop.stop()
+            raise SystemExit(
+                f"federation: only {len(loop.live_workers())}/"
+                f"{federated} workers joined"
+            )
+    elif pooled:
         loop = ServePool(config=config, recorder=recorder, store=store)
+        loop.start()
     else:
         loop = ServeLoop(engine=make_engine(), config=config,
                          recorder=recorder, store=store)
-    loop.start()
+        loop.start()
     gw = GatewayServer(loop, host=host, port=port)
     gw.start()
     stop = threading.Event()
@@ -593,7 +649,10 @@ def _serve_listen(args, config) -> int:
     signal.signal(signal.SIGINT, _on_signal)
     print(json.dumps({
         "listening": gw.address,
-        "replicas": len(loop.replicas) if pooled else 1,
+        **({"workers": len(loop.live_workers())} if federated else
+           {"replicas": len(loop.replicas) if pooled else 1}),
+        "tls": gw.tls_context is not None,
+        "authn": bool(gw.tokens),
         "max_body": gw.max_body,
         "endpoints": ["/v1/analyze", "/v1/subscribe", "/v1/traces",
                       "/metrics", "/healthz"],
@@ -669,6 +728,9 @@ def cmd_canary(args) -> int:
         corpus=corpus,
         store=store,
         serve_requests=args.requests,
+        listen_url=args.listen_url,
+        token=args.token,
+        ca_file=args.ca_file,
     )
     print(json.dumps(report, indent=None if args.compact else 2,
                      default=str))
@@ -1149,6 +1211,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--pipeline-depth", type=int, default=None,
                     dest="pipeline_depth",
                     help="tick pipeline depth for the soaked session")
+    sp.add_argument("--no-federation", action="store_true",
+                    dest="no_federation",
+                    help="skip the federation chaos leg (worker process "
+                    "kill/hang/partition over a live 3-worker fleet)")
+    sp.add_argument("--federation-workers", type=int, default=3,
+                    dest="federation_workers",
+                    help="worker processes in the federation chaos leg")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_chaos)
 
@@ -1199,6 +1268,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="selftest chaos: kill replica 0 mid-wave and "
                     "assert the steal protocol drops nothing "
                     "(implies a pool of >= 2 replicas)")
+    sp.add_argument("--federation", type=int, default=None,
+                    metavar="N",
+                    help="cross-process federation (SERVING.md "
+                    "§Federation): N worker PROCESSES under one control "
+                    "plane.  Alone: run the federation selftest "
+                    "(all-answered-or-shed, pool-vs-federation bit "
+                    "parity, zero double completions).  With --listen: "
+                    "the gateway fronts the federation instead of an "
+                    "in-process plane")
+    sp.add_argument("--kill-worker", action="store_true",
+                    dest="kill_worker",
+                    help="federation selftest chaos: SIGKILL one worker "
+                    "process mid-wave and assert drain-and-reroute "
+                    "leaves every request terminal with zero double "
+                    "completions")
     sp.add_argument("--record", default=None, metavar="PATH",
                     help="flight-record every served request to PATH "
                     "(load-demo and --listen modes); re-check with "
@@ -1252,6 +1336,19 @@ def build_parser() -> argparse.ArgumentParser:
                     "investigations (bisect names the exact tick), "
                     "serve waves (first divergent request index), or "
                     "both")
+    sp.add_argument("--listen-url", default=None, dest="listen_url",
+                    metavar="URL",
+                    help="sample through a RUNNING gateway "
+                    "(http[s]://host:port) instead of in-process — the "
+                    "live plane behind it (pool or federation) mints "
+                    "the corpus; overrides --mode")
+    sp.add_argument("--token", default=None,
+                    help="bearer token for a --listen-url gateway with "
+                    "RCA_GATEWAY_TOKENS set")
+    sp.add_argument("--ca-file", default=None, dest="ca_file",
+                    metavar="PEM",
+                    help="verify a --listen-url TLS gateway against "
+                    "this cert (self-signed deployments pin their own)")
     sp.add_argument("--top", type=int, default=5)
     sp.add_argument("--candidate-engine", default="auto",
                     dest="candidate_engine",

@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import re
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 VALID_BACKENDS = ("jax", "deterministic", "llm")
 
@@ -84,6 +84,14 @@ def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
     no validation beyond centralizing the read.  None when unset."""
     value = os.environ.get(name)
     return default if value is None else value
+
+
+def environ_copy() -> "Dict[str, str]":
+    """A snapshot of the FULL environment — for spawning child processes
+    (the federation's workers inherit the parent's RCA_*/JAX_* knobs and
+    overlay their own).  Reading it here keeps env-discipline honest:
+    the one non-knob environ consumer is named, not scattered."""
+    return dict(os.environ)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -426,6 +434,82 @@ def canary_sample_rate() -> float:
     return env_float("RCA_CANARY_SAMPLE_RATE", 1.0, 0.0, 1.0)
 
 
+# -- gateway TLS + authn (ISSUE 15) ------------------------------------------
+# env knobs for the hardened front door (SERVING.md §Gateway security):
+#
+#   RCA_GATEWAY_TLS_CERT  PEM certificate chain file; with
+#   RCA_GATEWAY_TLS_KEY   the PEM private key, the gateway listener is
+#                         wrapped in TLS (util/net.py seam, TLS 1.2+).
+#                         Setting one without the other fails loudly —
+#                         a half-configured TLS gateway silently serving
+#                         plaintext is the worst outcome.
+#   RCA_GATEWAY_TOKENS    bearer-token authn + the token→tenant map:
+#                         comma-separated ``token:tenant[:expires_unix]``
+#                         entries.  When set, every request (except
+#                         /healthz) needs ``Authorization: Bearer <tok>``
+#                         — checked constant-time BEFORE the body is
+#                         read — and the token's tenant BINDS the
+#                         request: an X-RCA-Tenant header naming a
+#                         different tenant is a spoof attempt (403).
+
+
+def gateway_tls_files() -> Optional[Tuple[str, str]]:
+    """``RCA_GATEWAY_TLS_CERT``/``RCA_GATEWAY_TLS_KEY`` as a validated
+    pair: both set → ``(cert, key)``; neither → None (plaintext); one
+    without the other raises."""
+    cert = (env_raw("RCA_GATEWAY_TLS_CERT") or "").strip()
+    key = (env_raw("RCA_GATEWAY_TLS_KEY") or "").strip()
+    if not cert and not key:
+        return None
+    if not (cert and key):
+        raise ValueError(
+            "RCA_GATEWAY_TLS_CERT and RCA_GATEWAY_TLS_KEY must be set "
+            "together (a half-configured TLS gateway would silently "
+            "serve plaintext)"
+        )
+    return cert, key
+
+
+def parse_gateway_tokens(spec: str) -> "Dict[str, Tuple[str, Optional[float]]]":
+    """``RCA_GATEWAY_TOKENS`` → ``{token: (tenant, expires_unix|None)}``.
+
+    Syntax: comma-separated ``token:tenant[:expires_unix]``.  Tokens and
+    tenants must be non-empty and tokens unique; a malformed spec fails
+    loudly — a typo'd token list silently running the gateway OPEN would
+    fake away the authn the operator asked for."""
+    out: Dict[str, Tuple[str, Optional[float]]] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) not in (2, 3) or not fields[0] or not fields[1]:
+            raise ValueError(
+                f"RCA_GATEWAY_TOKENS entry {part!r}: expected "
+                "'token:tenant[:expires_unix]'"
+            )
+        expires: Optional[float] = None
+        if len(fields) == 3:
+            try:
+                expires = float(fields[2])
+            except ValueError:
+                raise ValueError(
+                    f"RCA_GATEWAY_TOKENS entry {part!r}: expiry "
+                    f"{fields[2]!r} is not a number"
+                )
+        if fields[0] in out:
+            raise ValueError(
+                f"RCA_GATEWAY_TOKENS: duplicate token {fields[0][:4]}…"
+            )
+        out[fields[0]] = (fields[1], expires)
+    return out
+
+
+def gateway_tokens() -> "Dict[str, Tuple[str, Optional[float]]]":
+    """``RCA_GATEWAY_TOKENS`` parsed; empty dict = authn disabled."""
+    return parse_gateway_tokens(env_raw("RCA_GATEWAY_TOKENS") or "")
+
+
 def gateway_tenant_rps() -> float:
     """``RCA_GATEWAY_TENANT_RPS``: per-tenant token-bucket rate limit at
     the gateway, requests/second ([0, 1e6]; 0 = disabled, the default).
@@ -436,6 +520,47 @@ def gateway_tenant_rps() -> float:
     and excess requests are refused at the door with 429 + Retry-After
     before touching the serve queue."""
     return env_float("RCA_GATEWAY_TENANT_RPS", 0.0, 0.0, 1e6)
+
+
+# -- serve federation (ISSUE 15) ---------------------------------------------
+# env knobs for the cross-process serving plane (rca_tpu/serve/federation.py,
+# SERVING.md §Federation), each validated here so a typo'd value fails loudly:
+#
+#   RCA_FED_WORKERS      [1, 64]  worker processes the federation control
+#                        plane supervises (default 2); each worker runs a
+#                        full ServeLoop/ServePool over its own devices
+#   RCA_FED_HEARTBEAT_S  [0.01, 60.0]  worker heartbeat interval, seconds
+#                        (default 0.5); the lease TTL is
+#                        heartbeat_s * RCA_FED_LEASE_MISSES, so ONE late
+#                        heartbeat never kills a worker
+#   RCA_FED_LEASE_MISSES [2, 100]  consecutive missed heartbeats before a
+#                        worker's lease expires and its work reroutes
+#                        (default 3)
+#   RCA_FED_WINDOW       [1, 4096]  per-worker outstanding-request window
+#                        the router enforces (default 64): stickiness
+#                        spills to the next ring worker past it, so one
+#                        hot bucket cannot wedge the whole plane behind
+#                        one process
+
+
+def fed_workers() -> int:
+    """``RCA_FED_WORKERS``: worker processes under the federation."""
+    return env_int("RCA_FED_WORKERS", 2, 1, 64)
+
+
+def fed_heartbeat_s() -> float:
+    """``RCA_FED_HEARTBEAT_S``: worker heartbeat interval (seconds)."""
+    return env_float("RCA_FED_HEARTBEAT_S", 0.5, 0.01, 60.0)
+
+
+def fed_lease_misses() -> int:
+    """``RCA_FED_LEASE_MISSES``: missed heartbeats before lease expiry."""
+    return env_int("RCA_FED_LEASE_MISSES", 3, 2, 100)
+
+
+def fed_window() -> int:
+    """``RCA_FED_WINDOW``: per-worker outstanding-request window."""
+    return env_int("RCA_FED_WINDOW", 64, 1, 4096)
 
 
 # -- tracing + SLO telemetry (ISSUE 11) --------------------------------------

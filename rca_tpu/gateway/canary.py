@@ -119,6 +119,63 @@ def _sample_stream(tmp: str, out_path: str, ticks: int, services: int,
     return {"mode": "stream", "ticks": stats["ticks"]}
 
 
+def _sample_gateway(tmp: str, out_path: str, url: str, requests: int,
+                    services: int, seed: int, k: int,
+                    token: Optional[str] = None,
+                    ca_file: Optional[str] = None) -> Dict[str, Any]:
+    """One serve wave sampled THROUGH a RUNNING gateway (ISSUE 15, PR
+    9's named leftover) instead of an in-process loop — so the live
+    plane behind that gateway (a pool, a whole federation) is what
+    minted the rankings.  The canary itself writes the serve frames:
+    it knows the exact inputs it sent and the rankings that came back,
+    and a serve frame is self-contained by design (PR 5), so the minted
+    recording replays against a candidate exactly like an in-process
+    one — the federation path now feeds the regression corpus too."""
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.gateway.client import GatewayClient
+    from rca_tpu.replay import Recorder, mint_recording
+    from rca_tpu.serve.request import ServeRequest
+
+    client = GatewayClient.from_url(url, token=token, ca_file=ca_file)
+    case = synthetic_cascade_arrays(services, n_roots=1, seed=seed)
+    rng = np.random.default_rng(seed)
+    recorder = Recorder(os.path.join(tmp, "gateway"), mode="serve")
+    sampled = 0
+    statuses: Dict[str, int] = {}
+    for i in range(requests):
+        feats = np.clip(
+            case.features + rng.uniform(
+                0, 0.05, case.features.shape
+            ).astype(np.float32),
+            0, 1,
+        )
+        code, body, _hdrs = client.analyze(
+            feats, case.dep_src, case.dep_dst, names=case.names, k=k,
+            tenant=None if token else f"canary-{i % 2}", retries=2,
+        )
+        status = str(body.get("status", f"http_{code}"))
+        statuses[status] = statuses.get(status, 0) + 1
+        if code == 200 and status == "ok":
+            # a local ServeRequest twin of what went over the wire: the
+            # arrays are bit-identical (float32→JSON→float32 identity)
+            req = ServeRequest(
+                tenant=str(body.get("tenant") or "canary"),
+                features=feats, dep_src=case.dep_src,
+                dep_dst=case.dep_dst, names=case.names, k=k,
+            )
+            recorder.record_serve(req, [dict(r) for r in body["ranked"]])
+            sampled += 1
+    recorder.close()
+    if sampled == 0:
+        raise RuntimeError(
+            f"gateway canary: no ok responses from {url} "
+            f"({statuses}) — nothing to mint"
+        )
+    stats = mint_recording(recorder.path, out_path)
+    return {"mode": "gateway", "url": url, "requests": stats["serve"],
+            "statuses": statuses}
+
+
 def _sample_serve(tmp: str, out_path: str, requests: int, services: int,
                   seed: int, k: int) -> Dict[str, Any]:
     """One recorded serve wave, minted to ``out_path``."""
@@ -201,19 +258,29 @@ def run_canary(
     corpus: Optional[List[str]] = None,
     store=None,
     serve_requests: int = 8,
+    listen_url: Optional[str] = None,
+    token: Optional[str] = None,
+    ca_file: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Sample → mint → replay-against-candidate; ``ok`` iff every
     replayed recording holds bit parity.
 
     ``mode``: ``stream`` | ``serve`` | ``both`` — what each sampling
-    round records.  ``corpus`` adds pre-existing recordings (e.g. minted
-    by an earlier canary, or a recorded gateway session) to the replay
-    gate without re-sampling them.  ``store`` (an
-    :class:`rca_tpu.store.InvestigationStore`) gets one investigation
-    per sampled recording with its ``recording_ref`` pointing at the
-    minted file — the corpus is replayable by investigation id."""
+    round records.  ``listen_url`` (``rca canary --listen-url``,
+    ISSUE 15) points sampling at a RUNNING gateway instead of an
+    in-process plane: every round samples real wire traffic (``token``
+    / ``ca_file`` for TLS+authn gateways), so a federated plane's
+    answers mint the regression corpus too.  ``corpus`` adds
+    pre-existing recordings (e.g. minted by an earlier canary, or a
+    recorded gateway session) to the replay gate without re-sampling
+    them.  ``store`` (an :class:`rca_tpu.store.InvestigationStore`)
+    gets one investigation per sampled recording with its
+    ``recording_ref`` pointing at the minted file — the corpus is
+    replayable by investigation id."""
     if mode not in ("stream", "serve", "both"):
         raise ValueError(f"mode must be stream|serve|both, got {mode!r}")
+    if listen_url is not None:
+        mode = "gateway"
     if sample_rate is None:
         from rca_tpu.config import canary_sample_rate
 
@@ -241,6 +308,12 @@ def run_canary(
                     info = _sample_stream(
                         tmp, out_path, ticks=ticks, services=services,
                         seed=seed + i, k=k,
+                    )
+                elif leg == "gateway":
+                    info = _sample_gateway(
+                        tmp, out_path, listen_url,
+                        requests=serve_requests, services=services,
+                        seed=seed + i, k=k, token=token, ca_file=ca_file,
                     )
                 else:
                     info = _sample_serve(

@@ -37,9 +37,11 @@ replay-adjacent — its recordings must stay host-independent).
 from __future__ import annotations
 
 import collections
+import hmac
 import itertools
 import json
 import queue
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
@@ -50,9 +52,12 @@ from rca_tpu.config import (
     gateway_max_body,
     gateway_port,
     gateway_tenant_rps,
+    gateway_tls_files,
+    gateway_tokens,
 )
 from rca_tpu.gateway.export import render_metrics_text
 from rca_tpu.gateway.wire import (
+    RETRY_AFTER_MS_HEADER,
     TENANT_HEADER,
     WireError,
     decode_analyze,
@@ -94,6 +99,7 @@ class GatewayMetrics:
         self._stream_events = 0
         self._body_rejections = 0
         self._rate_limited = 0
+        self._auth_rejections = 0
 
     def response(self, route: str, code: int, ms: float) -> None:
         with self._lock:
@@ -117,6 +123,12 @@ class GatewayMetrics:
         with self._lock:
             self._rate_limited += 1
 
+    def auth_rejected(self) -> None:
+        """One request refused at the authn door (401/403) — BEFORE the
+        body was read or the serve queue was touched."""
+        with self._lock:
+            self._auth_rejections += 1
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             requests = dict(self._requests)
@@ -125,6 +137,7 @@ class GatewayMetrics:
             stream_events = self._stream_events
             body_rejections = self._body_rejections
             rate_limited = self._rate_limited
+            auth_rejections = self._auth_rejections
         return {
             "requests": requests,
             "latency": {
@@ -138,6 +151,7 @@ class GatewayMetrics:
             "stream_events": stream_events,
             "body_rejections": body_rejections,
             "rate_limited": rate_limited,
+            "auth_rejections": auth_rejections,
         }
 
 
@@ -281,6 +295,17 @@ class _GatewayHTTPServer(HTTPServer):
         # wire weather, not a server fault; record it in the bounded
         # fault log, never crash the acceptor or spam stderr
         with suppressed("gateway.connection"):
+            if self.gateway.tls_context is not None:
+                # TLS handshake happens HERE, on the connection thread —
+                # never on the acceptor (a slow or plaintext client must
+                # not block accept).  A failed handshake (plaintext to a
+                # TLS gateway, bad protocol) raises, is recorded in the
+                # fault log, and the connection dies having touched
+                # nothing: rejected before the serve queue by
+                # construction.
+                request = self.gateway.tls_context.wrap_socket(
+                    request, server_side=True
+                )
             self.finish_request(request, client_address)
         self.shutdown_request(request)
 
@@ -306,13 +331,23 @@ class _Handler(BaseHTTPRequestHandler):
         self, code: int, body: Dict[str, Any],
         retry_after: Optional[int] = None,
         trace: Optional[str] = None,
+        www_authenticate: bool = False,
     ) -> None:
         payload = json.dumps(body).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
         if retry_after is not None:
-            self.send_header("Retry-After", str(retry_after))
+            # seeded jitter (ISSUE 15 small fix): a constant Retry-After
+            # resynchronizes every shed client onto the same retry
+            # instant — the NEXT shed storm arrives as one wave.  The
+            # standard header stays integer seconds; the ms header
+            # carries the jittered value GatewayClient honors.
+            seconds, ms = self.gateway.jittered_retry_after(retry_after)
+            self.send_header("Retry-After", str(seconds))
+            self.send_header(RETRY_AFTER_MS_HEADER, str(ms))
+        if www_authenticate:
+            self.send_header("WWW-Authenticate", "Bearer")
         if trace is not None:
             # the header contract: context in, context out — the caller
             # can stitch its own spans onto the gateway's
@@ -328,6 +363,59 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
+
+    def _authorize(self) -> Tuple[Optional[int], Optional[str]]:
+        """The authn door (ISSUE 15): with ``RCA_GATEWAY_TOKENS`` set,
+        every route except ``/healthz`` needs ``Authorization: Bearer``.
+
+        Returns ``(already_sent_code | None, bound_tenant | None)``.
+        Runs BEFORE any body read — a rejected request costs the
+        gateway headers only, and the connection is closed (the unread
+        body would desynchronize keep-alive).  Token comparison is
+        constant-time against EVERY configured token, no early exit.
+        The matched token's tenant BINDS the request: an
+        ``X-RCA-Tenant`` header naming anyone else is a spoof (403)."""
+        gw = self.gateway
+        if not gw.tokens:
+            return None, None
+        header = self.headers.get("Authorization") or ""
+        token = header[7:] if header.startswith("Bearer ") else ""
+        bound: Optional[Tuple[str, Optional[float]]] = None
+        matched = False
+        for tok, binding in gw.tokens.items():
+            if hmac.compare_digest(
+                token.encode("utf-8"), tok.encode("utf-8")
+            ):
+                matched = True
+                bound = binding
+        if not matched:
+            gw.metrics.auth_rejected()
+            self.close_connection = True
+            self._send_json(401, {
+                "status": "error",
+                "detail": "missing or invalid bearer token "
+                          "(RCA_GATEWAY_TOKENS)",
+            }, www_authenticate=True)
+            return 401, None
+        tenant, expires = bound  # type: ignore[misc]
+        if expires is not None and gw.wall() >= expires:
+            gw.metrics.auth_rejected()
+            self.close_connection = True
+            self._send_json(401, {
+                "status": "error", "detail": "token expired",
+            }, www_authenticate=True)
+            return 401, None
+        hdr = self.headers.get(TENANT_HEADER)
+        if hdr and hdr != tenant:
+            gw.metrics.auth_rejected()
+            self.close_connection = True
+            self._send_json(403, {
+                "status": "error",
+                "detail": f"token is bound to tenant {tenant!r}; "
+                          f"X-RCA-Tenant {hdr!r} is not yours to claim",
+            })
+            return 403, None
+        return None, tenant
 
     def _route(self, handler: Callable[[], int], route: str) -> None:
         gw = self.gateway
@@ -400,6 +488,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _post_analyze(self, query: Optional[Dict[str, list]] = None) -> int:
         gw = self.gateway
+        # authn FIRST (ISSUE 15): a 401/403 costs headers only — the
+        # body stays unread, the serve queue untouched
+        auth_code, bound_tenant = self._authorize()
+        if auth_code is not None:
+            return auth_code
         t0 = gw.clock()
         # trace context enters here (ISSUE 11): parse the caller's
         # X-RCA-Trace (malformed = absent), mint THIS request's gateway
@@ -439,7 +532,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             body = json.loads(raw.decode("utf-8"))
             kwargs = decode_analyze(
-                body, header_tenant=self.headers.get(TENANT_HEADER)
+                # the token's tenant binds the request when authn is on
+                # (the spoof case already 403'd in _authorize); auth-less
+                # gateways keep the ISSUE-9 header tagging
+                body, header_tenant=(
+                    bound_tenant or self.headers.get(TENANT_HEADER)
+                ),
             )
         except (WireError, UnicodeDecodeError,
                 json.JSONDecodeError) as exc:
@@ -501,6 +599,9 @@ class _Handler(BaseHTTPRequestHandler):
         return code
 
     def _get_metrics(self) -> int:
+        auth_code, _ = self._authorize()
+        if auth_code is not None:
+            return auth_code
         gw = self.gateway
         scope_fn = getattr(gw.loop, "kernelscope_summary", None)
         text = render_metrics_text(
@@ -527,6 +628,9 @@ class _Handler(BaseHTTPRequestHandler):
         Perfetto-loadable Chrome trace JSON object instead of NDJSON.
         With ``RCA_TRACE=0`` the buffer is empty — 200 with zero lines,
         plus an X-RCA-Trace-Enabled header saying why."""
+        auth_code, _ = self._authorize()
+        if auth_code is not None:
+            return auth_code
         gw = self.gateway
         trace_id = (query.get("trace_id") or [None])[0]
         fmt = (query.get("format") or ["ndjson"])[0]
@@ -560,7 +664,14 @@ class _Handler(BaseHTTPRequestHandler):
         cache is bounded (oldest drop), so a 404 means expired OR never
         explained; the analyze response body carried the block either
         way."""
+        auth_code, bound_tenant = self._authorize()
+        if auth_code is not None:
+            return auth_code
         record = self.gateway.lookup_explain(key)
+        if (record is not None and bound_tenant is not None
+                and record.get("tenant") != bound_tenant):
+            # a token sees only its OWN tenant's provenance
+            record = None
         if record is None:
             self._send_json(404, {
                 "status": "error",
@@ -576,8 +687,15 @@ class _Handler(BaseHTTPRequestHandler):
         filters; ``max`` (default 0 = unbounded) ends the stream after N
         events; ``idle_s`` (default 30) ends it after that long with no
         event.  The stream also ends when the gateway shuts down."""
+        auth_code, bound_tenant = self._authorize()
+        if auth_code is not None:
+            return auth_code
         gw = self.gateway
         tenant = (query.get("tenant") or [None])[0]
+        if bound_tenant is not None:
+            # an authenticated subscriber sees its OWN tenant's events
+            # only — the token binds the filter, not the query string
+            tenant = bound_tenant
         try:
             max_events = int((query.get("max") or ["0"])[0])
             idle_s = float((query.get("idle_s") or ["30"])[0])
@@ -645,10 +763,39 @@ class GatewayServer:
         tenant_rps: Optional[float] = None,
         tracer=None,
         wall: Callable[[], float] = time.time,
+        tls: Optional[Tuple[str, str]] = None,
+        tokens: Optional[Dict[str, Tuple[str, Optional[float]]]] = None,
+        retry_jitter_s: float = 2.0,
+        retry_jitter_seed: Optional[int] = None,
     ):
         self.loop = loop
         self.client = ServeClient(loop)
         self.clock = clock
+        # TLS + authn front door (ISSUE 15).  ``tls`` is a (cert, key)
+        # PEM pair — default from RCA_GATEWAY_TLS_CERT/KEY; the context
+        # is built once through the util/net seam and each connection
+        # handshakes on its own thread.  ``tokens`` maps bearer token →
+        # (tenant, expires) — default from RCA_GATEWAY_TOKENS; empty =
+        # authn off (the ISSUE-9 auth-less behavior, loopback territory).
+        tls_pair = tls if tls is not None else gateway_tls_files()
+        if tls_pair is not None:
+            from rca_tpu.util.net import make_tls_server_context
+
+            self.tls_context = make_tls_server_context(
+                "gateway", tls_pair[0], tls_pair[1]
+            )
+        else:
+            self.tls_context = None
+        self.tokens = dict(tokens) if tokens is not None else (
+            gateway_tokens()
+        )
+        # seeded Retry-After jitter (ISSUE 15 small fix): deterministic
+        # per gateway, different ACROSS gateways (the default seed is
+        # the bound port), so a shed storm's retries de-synchronize
+        # instead of arriving back as one wave
+        self._retry_jitter_s = float(retry_jitter_s)
+        self._retry_lock = make_lock("GatewayServer._retry_lock")
+        self._retry_seed = retry_jitter_seed
         # wall-clock seam for /metrics gauge timestamps (exposition
         # format wants ms-since-epoch; the injectable reference keeps
         # nondet-discipline — no direct wall read on any handler path)
@@ -684,8 +831,21 @@ class GatewayServer:
             "gateway", host, port if port is not None else gateway_port()
         )
         self.host, self.port = bound_address(sock)
+        self._retry_rng = random.Random(
+            self._retry_seed if self._retry_seed is not None else self.port
+        )
         self._httpd = _GatewayHTTPServer(sock, _Handler, self)
         self._thread = None
+
+    def jittered_retry_after(self, base_s: int) -> Tuple[int, int]:
+        """``(retry_after_seconds, retry_after_ms)`` for one 429/503:
+        base + a seeded uniform draw in [0, retry_jitter_s).  The ms
+        value is the honest hint; the seconds value is its ceiling so
+        standard clients never retry EARLIER than our own."""
+        with self._retry_lock:
+            jitter = self._retry_rng.uniform(0.0, self._retry_jitter_s)
+        total = float(base_s) + jitter
+        return max(1, int(total + 0.999)), max(1, int(total * 1000.0))
 
     #: explained responses retained for GET /v1/explain/<id> (per key)
     EXPLAIN_CACHE_CAP = 256
@@ -711,10 +871,14 @@ class GatewayServer:
 
     # -- health (breaker-fed, ISSUE 9) ---------------------------------------
     def health(self) -> Dict[str, Any]:
-        """Liveness from breaker state: a pool is healthy while ANY
-        replica is routable (alive, breaker not open); a single loop
-        while its breaker is not open."""
+        """Liveness from breaker state: a federation is healthy while
+        ANY worker process holds a live lease; a pool while ANY replica
+        is routable (alive, breaker not open); a single loop while its
+        breaker is not open."""
         loop = self.loop
+        if hasattr(loop, "workers") and hasattr(loop, "health"):
+            # federation plane (ISSUE 15): lease-fed liveness
+            return loop.health()
         if hasattr(loop, "replicas"):
             states = {
                 str(r.replica_id): (

@@ -45,14 +45,22 @@ from rca_tpu.serve.request import (
     ServeResponse,
 )
 
-#: tenant tagging is auth-less by design (ISSUE 9): the header names the
-#: tenant, the scheduler's weighted-fair queue does the isolation
+#: tenant tagging header.  Auth-less by default (ISSUE 9); with
+#: ``RCA_GATEWAY_TOKENS`` set (ISSUE 15) the bearer token BINDS the
+#: tenant and a mismatching header is a spoof attempt (403)
 TENANT_HEADER = "X-RCA-Tenant"
 DEFAULT_TENANT = "default"
 
 #: Retry-After seconds suggested on 429/503 — queue pressure on this
 #: scheduler drains in well under a second; 1s is the floor HTTP allows
 RETRY_AFTER_S = 1
+
+#: millisecond-precision jittered retry hint (ISSUE 15 small fix): the
+#: integer Retry-After header resynchronizes every shed client onto the
+#: same retry instant; this companion header carries the seeded-jitter
+#: delay our GatewayClient honors, defeating the thundering herd while
+#: the standard header stays spec-shaped for everyone else
+RETRY_AFTER_MS_HEADER = "X-RCA-Retry-After-Ms"
 
 _PRIORITIES = {
     "high": PRIORITY_HIGH, "normal": PRIORITY_NORMAL,

@@ -21,6 +21,11 @@ layer (SERVING.md):
   replicas (dense/sharded mix over carved device groups) behind the
   shared queue, shape-bucket-sticky routing, per-replica breakers, and
   work-stealing failover with exactly-once completion;
+- :mod:`rca_tpu.serve.federation` / :mod:`rca_tpu.serve.worker` /
+  :mod:`rca_tpu.serve.fedwire` — the CROSS-PROCESS plane (ISSUE 15):
+  worker processes with lease-based liveness, consistent-hash routing
+  on graph digest, and drain-and-reroute on process death holding the
+  same exactly-once contract across the wire (SERVING.md §Federation);
 - :mod:`rca_tpu.serve.client` — in-process client, the coordinator's
   EngineAPI facade, and the ``rca serve --selftest`` harness;
 - :mod:`rca_tpu.serve.metrics` — per-tenant queue/occupancy metrics.
@@ -35,6 +40,12 @@ and ranking as a self-contained frame, replayable solo via
 from rca_tpu.serve.batcher import ShapeBucketBatcher
 from rca_tpu.serve.client import ServeClient, ServeEngineAdapter, serve_selftest
 from rca_tpu.serve.dispatcher import BatchDispatcher, BatchHandle
+from rca_tpu.serve.federation import (
+    FED_FAULT_CLASSES,
+    FederationPlane,
+    HashRing,
+    LeaseTable,
+)
 from rca_tpu.serve.loop import ServeLoop
 from rca_tpu.serve.metrics import ServeMetrics
 from rca_tpu.serve.pool import ServePool
@@ -56,6 +67,10 @@ from rca_tpu.serve.request import (
 __all__ = [
     "ShapeBucketBatcher",
     "ServePool",
+    "FederationPlane",
+    "FED_FAULT_CLASSES",
+    "HashRing",
+    "LeaseTable",
     "ReplicaWorker",
     "CompletionSink",
     "build_replica_engines",

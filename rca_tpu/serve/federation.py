@@ -1,0 +1,1320 @@
+"""Cross-process serve federation: the control plane (ISSUE 15).
+
+The serve pool (PR 8) is N replica threads in ONE process — a single
+process death takes the whole plane down.  :class:`FederationPlane`
+closes that gap: it supervises N worker PROCESSES (each running a full
+:class:`rca_tpu.serve.loop.ServeLoop` or :class:`rca_tpu.serve.pool.
+ServePool` over its own devices, bootstrapped through the
+:mod:`rca_tpu.parallel.distributed` seam so a cross-host mesh is a
+rules change, not a rewrite), connected over the length-prefixed wire
+protocol in :mod:`rca_tpu.serve.fedwire`.
+
+**Liveness is a lease, not a socket.**  Each worker's hello is answered
+with a lease (``ttl = heartbeat_s × lease_misses``); every heartbeat
+renews it.  One late heartbeat never kills a worker; ``lease_misses``
+consecutive misses expire the lease and the worker is marked dead even
+if its socket is still open — which is exactly the ``worker_hang``
+failure (a wedged process holds its fds).  A worker whose process dies
+outright (``process_kill``) is detected faster, at socket EOF.  A
+worker presenting a STALE lease (it hung, was declared dead, then woke
+up) is rejected and must re-hello for a fresh lease — the rejoin path.
+
+**Exactly-once across process death.**  Every routed request lives in
+the coordinator's pending table, keyed by request id and OWNED by one
+worker.  On worker death the entries it owned are reclaimed and
+re-placed on survivors (drain-and-reroute); a late answer from the
+dead worker no longer matches the owner and is dropped as a STALE
+response — counted in ``stale_responses``, never delivered.  Delivery
+itself goes through the pool's :class:`rca_tpu.serve.replica.
+CompletionSink`, so ``double_completions`` stays 0 by construction and
+is asserted 0 under concurrent kill chaos (tests, selftest, bench).
+
+**Routing is consistent hashing on the graph digest** (rendezvous /
+highest-random-weight): a graph key maps to the same worker wherever it
+is submitted, so hot graphs keep their resident delta-scatter path
+across processes; when one of N workers dies, ONLY the keys it owned
+move (bounded handoff — property-tested).  Stickiness is best-effort:
+past the per-worker outstanding window (``RCA_FED_WINDOW``) a request
+spills to the next worker on its ring so one hot bucket cannot wedge
+the plane behind one process.
+
+Concurrency discipline (gravelock): all threads named via
+:mod:`rca_tpu.util.threads`; ``FederationPlane._lock`` guards the
+worker table, ring, and pending map and is never held across a socket
+write that can block long (sends are to local buffers; the frame lock
+inside :class:`FrameConn` is a leaf).  Lock order:
+``FederationPlane._lock`` → ``FrameConn._wlock``;
+``CompletionSink._lock`` / ``ServeMetrics._lock`` are leaves.  Timing
+goes through the injectable ``clock`` seam (nondet-discipline — the
+whole serve package is replay-covered).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import itertools
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from rca_tpu.config import (
+    ServeConfig,
+    fed_heartbeat_s,
+    fed_lease_misses,
+    fed_window,
+    fed_workers,
+)
+from rca_tpu.observability.spans import default_tracer
+from rca_tpu.serve.fedwire import (
+    FrameConn,
+    FrameError,
+    PROTO,
+    WireResult,
+    encode_request,
+)
+from rca_tpu.serve.queue import RequestQueue
+from rca_tpu.serve.replica import CompletionSink
+from rca_tpu.serve.request import ServeRequest, ServeResponse
+from rca_tpu.serve.metrics import ServeMetrics
+from rca_tpu.util.net import bound_address, make_server_socket
+from rca_tpu.util.threads import make_lock, spawn
+
+#: the federation's fault classes — what the chaos gate must observe
+FED_FAULT_CLASSES = ("process_kill", "worker_hang", "coordinator_partition")
+
+#: router idle park while nothing is queued / routable
+_ROUTE_IDLE_S = 0.02
+
+#: events kept for observability (oldest dropped)
+_EVENT_CAP = 512
+
+
+# ---------------------------------------------------------------------------
+# Lease-based liveness
+# ---------------------------------------------------------------------------
+
+
+class Lease:
+    """One worker's liveness lease: granted at hello, renewed by every
+    heartbeat, expired after ``ttl_s`` without one."""
+
+    __slots__ = ("lease_id", "worker_id", "granted_at", "renewed_at",
+                 "ttl_s", "renewals")
+
+    def __init__(self, worker_id: int, now: float, ttl_s: float,
+                 lease_id: Optional[str] = None):
+        self.lease_id = lease_id or uuid.uuid4().hex[:16]
+        self.worker_id = int(worker_id)
+        self.granted_at = now
+        self.renewed_at = now
+        self.ttl_s = float(ttl_s)
+        self.renewals = 0
+
+    def expires_at(self) -> float:
+        return self.renewed_at + self.ttl_s
+
+    def expired(self, now: float) -> bool:
+        return now >= self.expires_at()
+
+
+class LeaseTable:
+    """The liveness source of truth, on an injectable clock.
+
+    ``ttl_s = heartbeat_s × lease_misses``: missing ONE heartbeat keeps
+    a worker alive (the miss-one-keep-alive property the tests pin);
+    missing ``lease_misses`` in a row expires it.  A renewal carrying a
+    lease id that is not the CURRENT lease for that worker — the worker
+    was declared dead and a fresh lease was (or will be) minted — is
+    refused: the holder must re-hello, which is what makes a recovered
+    hung worker's rejoin explicit instead of a silent resurrection."""
+
+    def __init__(self, heartbeat_s: float, lease_misses: int,
+                 clock: Callable[[], float] = time.monotonic):
+        if heartbeat_s <= 0:
+            raise ValueError(f"heartbeat_s must be > 0, got {heartbeat_s}")
+        if lease_misses < 2:
+            raise ValueError(
+                f"lease_misses must be >= 2 (one late heartbeat must "
+                f"never kill a worker), got {lease_misses}"
+            )
+        self.heartbeat_s = float(heartbeat_s)
+        self.ttl_s = float(heartbeat_s) * int(lease_misses)
+        self.clock = clock
+        self._lock = make_lock("LeaseTable._lock")
+        self._leases: Dict[int, Lease] = {}
+
+    def grant(self, worker_id: int, now: Optional[float] = None) -> Lease:
+        """A FRESH lease (any previous lease for this worker becomes
+        stale the moment this one exists)."""
+        if now is None:
+            now = self.clock()
+        lease = Lease(worker_id, now, self.ttl_s)
+        with self._lock:
+            self._leases[int(worker_id)] = lease
+        return lease
+
+    def renew(self, worker_id: int, lease_id: str,
+              now: Optional[float] = None) -> bool:
+        """Heartbeat renewal; False when the lease is stale (not the
+        current one), unknown, or already expired — the worker must
+        re-hello."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            lease = self._leases.get(int(worker_id))
+            if (lease is None or lease.lease_id != lease_id
+                    or lease.expired(now)):
+                return False
+            lease.renewed_at = now
+            lease.renewals += 1
+            return True
+
+    def alive(self, worker_id: int, now: Optional[float] = None) -> bool:
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            lease = self._leases.get(int(worker_id))
+            return lease is not None and not lease.expired(now)
+
+    def expired_workers(
+        self, now: Optional[float] = None
+    ) -> List[Tuple[int, float]]:
+        """``(worker_id, overdue_s)`` for every held lease past its TTL
+        — ``overdue_s`` is the detection lag the bench reports."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            return [
+                (wid, now - lease.expires_at())
+                for wid, lease in self._leases.items()
+                if lease.expired(now)
+            ]
+
+    def revoke(self, worker_id: int) -> None:
+        with self._lock:
+            self._leases.pop(int(worker_id), None)
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash routing (rendezvous)
+# ---------------------------------------------------------------------------
+
+
+class HashRing:
+    """Rendezvous (highest-random-weight) hashing over worker ids.
+
+    Chosen over a vnode ring for its EXACT remap property: when a node
+    leaves, the only keys that move are the keys it owned — survivors'
+    keys never reshuffle, which is the bounded-handoff contract the
+    resident delta path depends on (a surviving worker's hot graphs
+    stay hot through any topology change)."""
+
+    def __init__(self) -> None:
+        self._nodes: Tuple[int, ...] = ()
+
+    def add(self, node: int) -> None:
+        if int(node) not in self._nodes:
+            self._nodes = tuple(sorted(self._nodes + (int(node),)))
+
+    def remove(self, node: int) -> None:
+        self._nodes = tuple(n for n in self._nodes if n != int(node))
+
+    @property
+    def nodes(self) -> Tuple[int, ...]:
+        return self._nodes
+
+    @staticmethod
+    def _score(node: int, key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(f"{node}|{key}".encode("utf-8")).digest()[:8],
+            "big",
+        )
+
+    def ranked(self, key: str) -> List[int]:
+        """All nodes, preference order for ``key`` (owner first; the
+        tail is the deterministic spill order under saturation)."""
+        return sorted(
+            self._nodes, key=lambda n: self._score(n, key), reverse=True
+        )
+
+    def owner(self, key: str) -> Optional[int]:
+        ranked = self.ranked(key)
+        return ranked[0] if ranked else None
+
+
+def graph_route_key(graph_key: Tuple) -> str:
+    """The routing key for a request's shape bucket: the graph identity
+    tuple, digest included — the same identity the dispatcher's
+    prepared-graph cache is keyed by, so ring ownership and resident
+    stickiness agree by construction."""
+    return "/".join(str(p) for p in graph_key)
+
+
+# ---------------------------------------------------------------------------
+# Worker handles
+# ---------------------------------------------------------------------------
+
+
+class _WorkerHandle:
+    """Coordinator-side state for one worker (connection + lease +
+    outstanding accounting).  Mutated only under FederationPlane._lock
+    except the FrameConn (its own write lock) and plain reads."""
+
+    def __init__(self, worker_id: int):
+        self.worker_id = int(worker_id)
+        self.conn: Optional[FrameConn] = None
+        self.lease: Optional[Lease] = None
+        self.live = False
+        self.proc = None                  # util.procs.WorkerProc | None
+        self.hello: Dict[str, Any] = {}
+        self.outstanding = 0
+        self.partitioned_until = 0.0
+        self.partition_dropped = 0
+        self.served = 0
+        self.state = "connecting"
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "worker_id": self.worker_id,
+            "state": self.state,
+            "live": self.live,
+            "outstanding": self.outstanding,
+            "served": self.served,
+            "pid": self.hello.get("pid"),
+            "engine": self.hello.get("engine"),
+            "lease_renewals": (
+                self.lease.renewals if self.lease is not None else 0
+            ),
+        }
+
+
+class _Pending:
+    __slots__ = ("req", "worker_id", "sent_at", "moves")
+
+    def __init__(self, req: ServeRequest, worker_id: int, sent_at: float):
+        self.req = req
+        self.worker_id = worker_id
+        self.sent_at = sent_at
+        self.moves = 0
+
+
+# ---------------------------------------------------------------------------
+# The control plane
+# ---------------------------------------------------------------------------
+
+
+class FederationPlane:
+    """Coordinator for N worker processes behind one admission queue.
+
+    Presents the same surface the gateway and ``ServeClient`` expect of
+    a serving plane (``submit`` / ``clock`` / ``metrics`` / ``queue`` /
+    ``start`` / ``stop``), so ``GatewayServer(plane)`` is the TLS+authn
+    front door over a whole fleet.
+
+    ``workers``: how many processes to spawn (via the
+    :mod:`rca_tpu.util.procs` seam; each runs ``python -m
+    rca_tpu.serve.worker`` connected back here).  ``spawn_workers=False``
+    opens the control port without spawning — tests connect their own
+    (fake or real) workers, and external workers on other hosts join the
+    same way."""
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        config: Optional[ServeConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_s: Optional[float] = None,
+        lease_misses: Optional[int] = None,
+        window: Optional[int] = None,
+        spawn_workers: bool = True,
+        worker_env: Optional[Dict[str, str]] = None,
+        store=None,
+        tracer=None,
+        steal: Optional[bool] = None,
+    ):
+        self.config = config or ServeConfig.from_env()
+        self.clock = clock
+        self.n_workers = int(workers) if workers is not None else fed_workers()
+        self.heartbeat_s = (
+            float(heartbeat_s) if heartbeat_s is not None
+            else fed_heartbeat_s()
+        )
+        self.lease_misses = (
+            int(lease_misses) if lease_misses is not None
+            else fed_lease_misses()
+        )
+        self.window = int(window) if window is not None else fed_window()
+        self.steal = bool(self.config.steal if steal is None else steal)
+        self.spawn_workers = bool(spawn_workers)
+        self.worker_env = worker_env
+        self.tracer = tracer if tracer is not None else default_tracer()
+        self.metrics = ServeMetrics()
+        self.queue = RequestQueue(self.config.queue_cap, clock=clock)
+        self.sink = CompletionSink(
+            self.metrics, clock, store=store, tracer=self.tracer,
+        )
+        self.leases = LeaseTable(
+            self.heartbeat_s, self.lease_misses, clock=clock
+        )
+        self.ring = HashRing()
+        self._lock = make_lock("FederationPlane._lock")
+        self.workers: Dict[int, _WorkerHandle] = {}
+        self._pending: Dict[str, _Pending] = {}
+        self._overflow: "collections.deque[ServeRequest]" = (
+            collections.deque()
+        )
+        self.events: List[Dict[str, Any]] = []
+        self.stale_responses = 0
+        self.reroutes = 0
+        self._conn_counter = itertools.count()
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._threads: List[threading.Thread] = []
+        sock = make_server_socket("federation", host, port)
+        self.host, self.port = bound_address(sock)
+        self._server_sock = sock
+
+    # -- introspection --------------------------------------------------------
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def live_workers(self) -> List[int]:
+        with self._lock:
+            return [w.worker_id for w in self.workers.values() if w.live]
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending) + len(self._overflow)
+
+    def worker_table(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                self.workers[wid].summary()
+                for wid in sorted(self.workers)
+            ]
+
+    def _event(self, kind: str, worker_id: Optional[int] = None,
+               **extra: Any) -> None:
+        with self._lock:
+            self.events.append({
+                "event": kind, "worker_id": worker_id,
+                "t": self.clock(), **extra,
+            })
+            while len(self.events) > _EVENT_CAP:
+                self.events.pop(0)
+
+    def fault_classes_observed(self) -> List[str]:
+        with self._lock:
+            return sorted({
+                e["class"] for e in self.events
+                if e["event"] == "worker_down" and e.get("class")
+            })
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "FederationPlane":
+        self._stop.clear()
+        self._threads = [
+            spawn(self._accept_loop, name="rca-fed-accept", daemon=True),
+            spawn(self._route_loop, name="rca-fed-route", daemon=True),
+            spawn(self._monitor_loop, name="rca-fed-monitor", daemon=True),
+        ]
+        if self.spawn_workers:
+            for i in range(self.n_workers):
+                self.spawn_worker(i)
+        return self
+
+    def spawn_worker(self, worker_id: int):
+        """Spawn (or respawn) one worker process through the procs seam;
+        it connects back to the control port and hellos."""
+        from rca_tpu.config import environ_copy
+        from rca_tpu.util.procs import python_argv, spawn_worker
+
+        env = environ_copy()
+        if self.worker_env:
+            env.update(self.worker_env)
+        proc = spawn_worker(
+            f"fed-worker{worker_id}",
+            python_argv(
+                "rca_tpu.serve.worker",
+                "--connect", self.address,
+                "--worker-id", str(worker_id),
+            ),
+            env=env,
+        )
+        with self._lock:
+            handle = self.workers.setdefault(
+                int(worker_id), _WorkerHandle(worker_id)
+            )
+            handle.proc = proc
+        self._event("worker_spawned", worker_id, pid=proc.pid)
+        return proc
+
+    def wait_ready(self, n: Optional[int] = None,
+                   timeout_s: float = 60.0) -> bool:
+        """Block until ``n`` (default: all spawned) workers hold leases.
+        False on timeout — callers decide whether a partial fleet is a
+        failure (selftest) or a degraded start (demo)."""
+        want = int(n) if n is not None else self.n_workers
+        deadline = self.clock() + timeout_s
+        while self.clock() < deadline:
+            if len(self.live_workers()) >= want:
+                return True
+            if self._stop.wait(0.05):
+                return False
+        return len(self.live_workers()) >= want
+
+    def stop(self, timeout: float = 15.0) -> None:
+        deadline = self.clock() + timeout
+        # drain: workers finish in flight, answer, and exit
+        with self._lock:
+            conns = [
+                w.conn for w in self.workers.values()
+                if w.live and w.conn is not None
+            ]
+        for conn in conns:
+            conn.send({"t": "drain"})
+        while self.pending_count() > 0 and self.clock() < deadline:
+            if self._stop.wait(0.02):
+                break
+        self._stop.set()
+        self.queue.kick()
+        # complete everything still in the system — a stopped plane must
+        # not leave submitters parked
+        with self._lock:
+            leftovers = [p.req for p in self._pending.values()]
+            self._pending.clear()
+            leftovers.extend(self._overflow)
+            self._overflow.clear()
+        while True:
+            with self._lock:
+                req = self.queue.pop()
+            if req is None:
+                break
+            leftovers.append(req)
+        for req in leftovers:
+            self.sink.error(req, "federation stopped")
+        with self._lock:
+            handles = list(self.workers.values())
+        for w in handles:
+            if w.conn is not None:
+                w.conn.close()
+            if w.proc is not None:
+                w.proc.terminate(grace_s=3.0)
+        try:
+            self._server_sock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(5.0)
+        self._threads = []
+
+    def __enter__(self) -> "FederationPlane":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission (ServeLoop/ServePool submit contract) ----------------------
+    def submit(self, req: ServeRequest) -> bool:
+        now = self.clock()
+        if self.tracer.enabled and req.trace is None:
+            req.trace = self.tracer.new_context(parent=req.trace_parent)
+        if req.expired(now):
+            self.sink.shed(req, detail="expired_at_admission")
+            return False
+        if not self.queue.submit(req):
+            self.metrics.rejected(req.tenant)
+            req.complete(ServeResponse(
+                status="queue_full", request_id=req.request_id,
+                tenant=req.tenant,
+                detail=f"queue at capacity ({self.queue.cap})",
+            ))
+            return False
+        self.metrics.submitted(req.tenant, len(self.queue))
+        return True
+
+    # -- chaos seams ----------------------------------------------------------
+    def kill_worker(self, worker_id: int) -> bool:
+        """SIGKILL one worker process (the ``process_kill`` fault; procs
+        seam).  For fake/externally-connected workers the connection is
+        severed instead — same failure shape at this layer."""
+        with self._lock:
+            w = self.workers.get(int(worker_id))
+            proc = w.proc if w is not None else None
+            conn = w.conn if w is not None else None
+        if proc is not None:
+            proc.kill()
+            return True
+        if conn is not None:
+            conn.close()
+            return True
+        return False
+
+    def hang_worker(self, worker_id: int, for_s: float) -> bool:
+        """Tell one worker to stop heartbeating for ``for_s`` seconds
+        while keeping its socket open — the ``worker_hang`` fault."""
+        with self._lock:
+            w = self.workers.get(int(worker_id))
+            conn = w.conn if w is not None and w.live else None
+        return conn is not None and conn.send(
+            {"t": "hang", "for_s": float(for_s)}
+        )
+
+    def partition(self, worker_id: int, for_s: float) -> bool:
+        """Drop every frame from (and ack to) one worker for ``for_s``
+        seconds — the ``coordinator_partition`` fault: both sides are
+        healthy, the control channel is not."""
+        now = self.clock()
+        with self._lock:
+            w = self.workers.get(int(worker_id))
+            if w is None:
+                return False
+            w.partitioned_until = now + float(for_s)
+        self._event("partition_start", worker_id, for_s=float(for_s))
+        return True
+
+    # -- connection handling --------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _addr = self._server_sock.accept()
+            except OSError:
+                return   # socket closed = shutdown
+            conn = FrameConn(client, name="fed-coord")
+            spawn(
+                self._conn_loop,
+                name=f"rca-fed-conn{next(self._conn_counter)}",
+                daemon=True, args=(conn,),
+            )
+
+    def _register(self, conn: FrameConn,
+                  hello: Dict[str, Any]) -> Optional[_WorkerHandle]:
+        """Handle one hello: proto + lease staleness checks, then grant.
+        Returns the registered handle, or None when rejected."""
+        if int(hello.get("proto", -1)) != PROTO:
+            conn.send({"t": "reject", "reason": "bad_proto"})
+            return None
+        worker_id = int(hello.get("worker_id", -1))
+        if worker_id < 0:
+            conn.send({"t": "reject", "reason": "bad_worker_id"})
+            return None
+        presented = hello.get("lease_id")
+        if presented is not None and not self.leases.renew(
+            worker_id, presented
+        ):
+            # rejoin with a stale lease: refused — the worker re-hellos
+            # WITHOUT a lease and gets a fresh grant (tested)
+            self._event("stale_lease_rejected", worker_id)
+            conn.send({"t": "reject", "reason": "stale_lease"})
+            return None
+        lease = self.leases.grant(worker_id)
+        with self._lock:
+            w = self.workers.setdefault(worker_id, _WorkerHandle(worker_id))
+            # a rejoin is any hello from a worker that held a lease
+            # before — whether on a fresh connection (restart) or the
+            # SAME one (a hung/partitioned worker whose stale lease was
+            # just rejected)
+            rejoin = w.lease is not None
+            old_conn = (
+                w.conn if (w.conn is not None and w.conn is not conn)
+                else None
+            )
+            w.conn = conn
+            w.lease = lease
+            w.hello = dict(hello)
+            w.live = True
+            w.state = "live"
+            w.partitioned_until = 0.0
+            self.ring.add(worker_id)
+        if old_conn is not None:
+            old_conn.close()
+        self._event("rejoin" if rejoin else "worker_joined", worker_id,
+                    lease_id=lease.lease_id)
+        conn.send({
+            "t": "lease", "lease_id": lease.lease_id,
+            "ttl_s": self.leases.ttl_s,
+            "heartbeat_s": self.heartbeat_s,
+        })
+        self.queue.kick()    # routable capacity appeared
+        return w
+
+    def _conn_loop(self, conn: FrameConn) -> None:
+        """One connection's read loop: hello/handshake, then heartbeats,
+        responses, and drain acks until EOF (EOF = process death)."""
+        handle: Optional[_WorkerHandle] = None
+        while not self._stop.is_set():
+            try:
+                msg = conn.recv()
+            except FrameError:
+                msg = None   # poisoned stream: treat as death
+            if msg is None:
+                break
+            now = self.clock()
+            if handle is not None and now < handle.partitioned_until:
+                # coordinator_partition chaos: frames are dropped on the
+                # floor — no renewals, no acks, no responses delivered
+                with self._lock:
+                    handle.partition_dropped += 1
+                continue
+            t = msg.get("t")
+            if t == "hello":
+                got = self._register(conn, msg)
+                if got is not None:
+                    handle = got
+            elif t == "hb" and handle is not None:
+                if self.leases.renew(
+                    handle.worker_id, str(msg.get("lease_id"))
+                ):
+                    conn.send({"t": "hb_ack", "seq": msg.get("seq", 0)})
+                else:
+                    # stale/expired lease: the worker was declared dead;
+                    # make it re-hello explicitly
+                    conn.send({"t": "reject", "reason": "stale_lease"})
+            elif t == "resp" and handle is not None:
+                self._on_response(handle, msg)
+            elif t == "drained" and handle is not None:
+                self._event("worker_drained", handle.worker_id)
+        if handle is not None:
+            self._worker_down(handle.worker_id, eof=True)
+
+    # -- completion (exactly-once across the wire) ----------------------------
+    def _on_response(self, w: _WorkerHandle, msg: Dict[str, Any]) -> None:
+        rid = str(msg.get("request_id"))
+        with self._lock:
+            entry = self._pending.get(rid)
+            if entry is None or entry.worker_id != w.worker_id:
+                # reassigned or already completed: a late answer from a
+                # declared-dead worker must not double-complete
+                self.stale_responses += 1
+                stale = True
+            else:
+                del self._pending[rid]
+                w.outstanding = max(0, w.outstanding - 1)
+                w.served += 1
+                stale = False
+        if stale:
+            return
+        req = entry.req
+        status = str(msg.get("status", "error"))
+        if status == "ok":
+            ranked = [dict(r) for r in msg.get("ranked") or []]
+            self.sink.remember(req.graph_key, ranked)
+            queue_ms = max(0.0, (entry.sent_at - req.enqueued_at) * 1e3)
+            self.metrics.answered(req.tenant, queue_ms)
+            self.metrics.record_batch(int(msg.get("batch_size") or 1))
+            self.sink._complete(req, ServeResponse(
+                status="ok", request_id=req.request_id, tenant=req.tenant,
+                ranked=ranked, queue_ms=round(queue_ms, 3),
+                batch_size=int(msg.get("batch_size") or 1),
+                deadline_missed=req.expired(self.clock()),
+                result=WireResult(ranked, str(msg.get("engine") or "")),
+            ))
+        elif status == "shed":
+            self.sink.shed(req, detail=str(msg.get("detail") or "shed"))
+        elif status in ("degraded", "error", "queue_full"):
+            # honest forwarding: the worker's ladder already ran; a
+            # queue_full from a saturated worker degrades here (the
+            # coordinator's ladder may still hold a last-known ranking)
+            self.sink.degraded(
+                req,
+                detail=f"worker{w.worker_id}:{status}:"
+                       f"{msg.get('detail') or ''}",
+            )
+        else:
+            self.sink.error(req, f"worker{w.worker_id}:bad_status:{status}")
+        self.queue.kick()    # window room appeared
+
+    # -- death, drain-and-reroute ---------------------------------------------
+    def _worker_down(self, worker_id: int, eof: bool = False) -> None:
+        """Mark one worker dead and reclaim everything it owned.  The
+        fault class is derived from HOW it died: socket EOF means the
+        process is gone (``process_kill``); lease expiry with the socket
+        open during a partition window is ``coordinator_partition``;
+        lease expiry with an open socket otherwise is ``worker_hang``."""
+        now = self.clock()
+        with self._lock:
+            w = self.workers.get(int(worker_id))
+            if w is None or not w.live:
+                return
+            w.live = False
+            w.state = "dead"
+            self.ring.remove(worker_id)
+            lease = w.lease
+            overdue = (
+                max(0.0, now - lease.expires_at())
+                if lease is not None else 0.0
+            )
+            if eof:
+                fault = "process_kill"
+            elif w.partitioned_until > 0.0:
+                fault = "coordinator_partition"
+            else:
+                fault = "worker_hang"
+            reclaimed = [
+                p for p in self._pending.values()
+                if p.worker_id == w.worker_id
+            ]
+            for p in reclaimed:
+                del self._pending[p.req.request_id]
+            w.outstanding = 0
+        self.leases.revoke(worker_id)
+        self._event(
+            "worker_down", worker_id, **{
+                "class": fault, "reclaimed": len(reclaimed),
+                "detect_lag_ms": round(overdue * 1e3, 3),
+            },
+        )
+        for p in reclaimed:
+            if not self.steal:
+                self.sink.degraded(
+                    p.req, detail=f"worker_unavailable:{fault}"
+                )
+                continue
+            p.moves += 1
+            with self._lock:
+                self.reroutes += 1
+                self._overflow.append(p.req)
+        self.queue.kick()
+
+    # -- routing --------------------------------------------------------------
+    def _pick_worker(self, req: ServeRequest) -> Optional[_WorkerHandle]:
+        """Ring owner first; spill down the preference order past the
+        outstanding window.  None while nothing live has room (the
+        router parks) — and None with NOTHING live at all (the ladder
+        answers).  Called under the plane lock."""
+        key = graph_route_key(req.graph_key)
+        for wid in self.ring.ranked(key):
+            w = self.workers.get(wid)
+            if (w is not None and w.live and w.conn is not None
+                    and w.outstanding < self.window):
+                return w
+        return None
+
+    def _route_one(self, req: ServeRequest, now: float) -> bool:
+        """Place one popped request.  True when it reached a worker (or
+        terminally completed); False = no capacity right now, the router
+        holds it in overflow."""
+        if req.expired(now):
+            self.sink.shed(req, detail="expired_in_router")
+            return True
+        conn = None
+        with self._lock:
+            target = self._pick_worker(req)
+            if target is not None:
+                self._pending[req.request_id] = _Pending(
+                    req, target.worker_id, now
+                )
+                target.outstanding += 1
+                conn = target.conn
+        if target is None:
+            if not self.live_workers():
+                # no fleet: ride the degradation ladder, never hang
+                self.sink.degraded(req, detail="no_worker_available")
+                return True
+            return False
+        if self.tracer.enabled and req.trace is not None:
+            self.tracer.record(
+                "serve.queue", req.enqueued_at, now, parent=req.trace,
+                attrs={"tenant": req.tenant, "priority": req.priority,
+                       "worker": target.worker_id},
+            )
+        if not conn.send(encode_request(req)):
+            # died between pick and send: reclaim immediately and retry
+            with self._lock:
+                entry = self._pending.pop(req.request_id, None)
+                if entry is not None:
+                    target.outstanding = max(0, target.outstanding - 1)
+            self._worker_down(target.worker_id, eof=True)
+            if entry is not None:
+                with self._lock:
+                    self._overflow.append(req)
+            return True
+        return True
+
+    def _route_loop(self) -> None:
+        while not self._stop.is_set():
+            now = self.clock()
+            worked = False
+            for req in self.queue.shed_expired(now):
+                self.sink.shed(req, detail="expired_in_queue")
+                worked = True
+            with self._lock:
+                held = self._overflow.popleft() if self._overflow else None
+            if held is not None:
+                if self._route_one(held, now):
+                    worked = True
+                else:
+                    with self._lock:
+                        self._overflow.appendleft(held)
+                    self._stop.wait(_ROUTE_IDLE_S)
+                    continue
+            with self._lock:
+                req = self.queue.pop()
+            if req is not None:
+                if self._route_one(req, now):
+                    worked = True
+                else:
+                    with self._lock:
+                        self._overflow.appendleft(req)
+                    self._stop.wait(_ROUTE_IDLE_S)
+                    continue
+            if not worked and req is None:
+                self.queue.wait_for_work(_ROUTE_IDLE_S)
+
+    # -- liveness monitor ------------------------------------------------------
+    def check_leases(self, now: Optional[float] = None) -> List[int]:
+        """One liveness sweep (the monitor thread's body; also driven
+        directly by fake-clock tests): expire overdue leases → mark
+        workers down → drain-and-reroute.  Returns the worker ids
+        expired this sweep."""
+        if now is None:
+            now = self.clock()
+        downed = []
+        for worker_id, _overdue in self.leases.expired_workers(now):
+            with self._lock:
+                w = self.workers.get(worker_id)
+                live = w is not None and w.live
+            if live:
+                self._worker_down(worker_id)
+                downed.append(worker_id)
+            else:
+                self.leases.revoke(worker_id)
+        return downed
+
+    def _monitor_loop(self) -> None:
+        interval = max(0.01, self.heartbeat_s / 2.0)
+        while not self._stop.wait(interval):
+            self.check_leases()
+            # belt and braces: a worker whose PROCESS is gone but whose
+            # socket teardown is lagging gets downed here too
+            with self._lock:
+                gone = [
+                    w.worker_id for w in self.workers.values()
+                    if w.live and w.proc is not None and not w.proc.alive()
+                ]
+            for wid in gone:
+                self._worker_down(wid, eof=True)
+
+    # -- health (gateway /healthz) --------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        with self._lock:
+            states = {
+                str(w.worker_id): w.state for w in self.workers.values()
+            }
+            ok = any(w.live for w in self.workers.values())
+        return {
+            "ok": bool(ok), "workers": states,
+            "queue_depth": len(self.queue),
+            "pending": self.pending_count(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Selftest (CLI `rca serve --federation N [--kill-worker]`)
+# ---------------------------------------------------------------------------
+
+
+def federation_selftest(
+    workers: int = 3,
+    n_requests: int = 36,
+    seed: int = 0,
+    kill_worker: bool = False,
+    submitters: int = 6,
+    config: Optional[ServeConfig] = None,
+    services: Tuple[int, ...] = (24, 60, 120),
+    heartbeat_s: float = 0.15,
+    timeout_s: float = 180.0,
+    ready_timeout_s: float = 90.0,
+) -> Dict[str, Any]:
+    """End-to-end federation contract check, the cross-process twin of
+    :func:`rca_tpu.serve.client.serve_selftest`:
+
+    - ``workers`` real worker PROCESSES under one control plane, wire
+      load from ``submitters`` concurrent threads over several shape
+      buckets and tenants;
+    - ``kill_worker``: SIGKILL one worker mid-wave (the procs seam's
+      ``process_kill``) — every request must still end terminal
+      (ok/shed/degraded, none hung), with ZERO double completions;
+    - POOL-vs-FEDERATION bit parity: every ok ranking must equal a solo
+      single-process analysis of the same request, bit for bit — the
+      wire codec's float32→JSON→float32 identity plus the serve
+      coalesced-vs-solo contract, now across process boundaries.
+    """
+    import threading as _threading   # Event only (signal, not a lock)
+
+    import numpy as np
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine.runner import GraphEngine
+    from rca_tpu.util.threads import make_thread
+
+    cases = [
+        synthetic_cascade_arrays(n, n_roots=1, seed=seed + i)
+        for i, n in enumerate(services)
+    ]
+    tenants = [f"tenant-{c}" for c in "abcd"]
+    rng = np.random.default_rng(seed)
+    specs = []
+    for i in range(n_requests):
+        case = cases[i % len(cases)]
+        feats = np.clip(
+            case.features + rng.uniform(
+                0, 0.05, case.features.shape
+            ).astype(np.float32),
+            0, 1,
+        )
+        specs.append({
+            "case": case, "features": feats,
+            "tenant": tenants[i % len(tenants)],
+            # a few requests arrive already expired: the shed contract
+            # must hold across the wire too
+            "deadline_expired": i % 11 == 10,
+        })
+
+    plane = FederationPlane(
+        workers=workers, config=config, heartbeat_s=heartbeat_s,
+    )
+    requests: List[Optional[ServeRequest]] = [None] * n_requests
+    kill_at: Dict[str, Any] = {"t": None, "worker": None}
+    kill_lock = make_lock("federation_selftest.kill_lock")
+    killed = _threading.Event()
+    t0 = plane.clock()
+    with plane:
+        if not plane.wait_ready(workers, timeout_s=ready_timeout_s):
+            table = plane.worker_table()
+            diag = [
+                {**w.summary(), "stderr_tail": (
+                    w.proc.output()[1][-2000:] if w.proc else ""
+                )}
+                for w in plane.workers.values()
+            ]
+            raise RuntimeError(
+                f"federation selftest: only {len(plane.live_workers())}"
+                f"/{workers} workers joined within {ready_timeout_s}s: "
+                f"{table}; {diag}"
+            )
+        startup_s = plane.clock() - t0
+
+        def submitter(worker: int) -> None:
+            for i in range(worker, n_requests, submitters):
+                s = specs[i]
+                if (kill_worker and not killed.is_set()
+                        and i >= n_requests // 2):
+                    with kill_lock:
+                        fire = not killed.is_set()
+                        if fire:
+                            killed.set()
+                    if fire:
+                        victims = plane.live_workers()
+                        victim = victims[0] if victims else 0
+                        kill_at["t"] = plane.clock()
+                        kill_at["worker"] = victim
+                        plane.kill_worker(victim)
+                req = ServeRequest(
+                    tenant=s["tenant"], features=s["features"],
+                    dep_src=s["case"].dep_src, dep_dst=s["case"].dep_dst,
+                    names=s["case"].names, k=3,
+                    deadline_s=(plane.clock() - 1.0
+                                if s["deadline_expired"] else None),
+                )
+                requests[i] = req
+                plane.submit(req)
+
+        threads = [
+            make_thread(submitter, name=f"fed-selftest-submit-{w}",
+                        daemon=True, args=(w,))
+            for w in range(submitters)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        responses = [r.result(timeout_s) for r in requests]  # type: ignore
+        all_terminal_at = plane.clock()
+        events = list(plane.events)
+        worker_table = plane.worker_table()
+        double = plane.sink.double_completions
+        stale = plane.stale_responses
+        reroutes = plane.reroutes
+
+    by_status: Dict[str, int] = {}
+    for resp in responses:
+        by_status[resp.status] = by_status.get(resp.status, 0) + 1
+    # parity oracles: per ENGINE KIND, like the pool selftest — a
+    # worker on a multi-device host auto-shards (RCA_SHARD default),
+    # and dense-vs-sharded float differences must not masquerade as
+    # federation-parity failures.  The wire response names its engine
+    # tag; the solo rerun uses the SAME kind (and the same dp/sp
+    # layout, parsed from the tag).
+    import re as _re
+
+    solo_cache: Dict[str, Any] = {}
+
+    def _oracle(tag: str):
+        if tag not in solo_cache:
+            m = _re.search(r"sharded\(dp=(\d+),sp=(\d+)\)", tag or "")
+            if m:
+                from rca_tpu.engine.sharded_runner import (
+                    ShardedGraphEngine,
+                )
+
+                solo_cache[tag] = ShardedGraphEngine(
+                    spec=f"dp={m.group(1)},sp={m.group(2)}"
+                )
+            else:
+                solo_cache[tag] = GraphEngine()
+        return solo_cache[tag]
+
+    parity_checked = 0
+    parity_ok = True
+    for spec, resp in zip(specs, responses):
+        if not resp.ok:
+            continue
+        tag = getattr(resp.result, "engine", "") or ""
+        ref = _oracle(tag).analyze_arrays(
+            spec["features"], spec["case"].dep_src,
+            spec["case"].dep_dst, spec["case"].names, k=3,
+        )
+        parity_checked += 1
+        if [dict(r) for r in ref.ranked] != resp.ranked:
+            parity_ok = False
+    expected_shed = sum(1 for s in specs if s["deadline_expired"])
+    all_resolved = all(r is not None and r.done() for r in requests)
+    terminal_ok = all(
+        r.status in ("ok", "shed", "degraded", "queue_full")
+        for r in responses
+    ) if not kill_worker else all(
+        r.status in ("ok", "shed", "degraded", "error", "queue_full")
+        for r in responses
+    )
+    fault_classes = sorted({
+        e["class"] for e in events
+        if e["event"] == "worker_down" and e.get("class")
+    })
+    ok = (
+        all_resolved
+        and parity_ok
+        and double == 0
+        and terminal_ok
+        and by_status.get("shed", 0) >= expected_shed
+        and (not kill_worker or "process_kill" in fault_classes)
+        and (kill_worker or (
+            by_status.get("error", 0) == 0
+            and by_status.get("ok", 0)
+            == n_requests - by_status.get("shed", 0)
+        ))
+    )
+    out = {
+        "ok": bool(ok),
+        "workers": workers,
+        "requests": n_requests,
+        "kill_worker": bool(kill_worker),
+        "startup_s": round(startup_s, 3),
+        "by_status": by_status,
+        "expected_shed_min": expected_shed,
+        "all_resolved": bool(all_resolved),
+        "parity_checked": parity_checked,
+        "parity_ok": bool(parity_ok),
+        "double_completions": double,
+        "stale_responses": stale,
+        "reroutes": reroutes,
+        "fault_classes_observed": fault_classes,
+        "worker_table": worker_table,
+    }
+    if kill_worker and kill_at["t"] is not None:
+        out["killed_worker"] = kill_at["worker"]
+        out["recovery_ms"] = round(
+            (all_terminal_at - kill_at["t"]) * 1e3, 1
+        )
+        down = [
+            e for e in events
+            if e["event"] == "worker_down"
+            and e["worker_id"] == kill_at["worker"]
+        ]
+        if down:
+            out["detect_ms"] = round(
+                (down[0]["t"] - kill_at["t"]) * 1e3, 1
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Chaos harness (CLI `rca chaos` federation leg)
+# ---------------------------------------------------------------------------
+
+
+def run_federation_chaos(
+    seed: int = 7,
+    workers: int = 3,
+    heartbeat_s: float = 0.12,
+    services: int = 32,
+    timeout_s: float = 240.0,
+    ready_timeout_s: float = 90.0,
+) -> Dict[str, Any]:
+    """Drive all three federation fault classes against one live fleet
+    under continuous wire load, and score the contract:
+
+    1. **worker_hang**: a seeded-chosen worker is told to stop
+       heartbeating past the lease TTL (socket stays open) → lease
+       expiry → drain-and-reroute; when the hang ends, its stale lease
+       is REJECTED and it re-hellos — the rejoin path;
+    2. **coordinator_partition**: the coordinator drops another
+       worker's frames for a window → same expiry/reroute; on heal the
+       worker rejoins the same way;
+    3. **process_kill**: a third worker is SIGKILLed (procs seam) and
+       stays dead — survivors absorb its keys.
+
+    Exit contract: every submitted request terminal, ZERO double
+    completions (stale late answers from hung/partitioned workers are
+    dropped and counted), all three classes observed, and at least one
+    rejoin."""
+    import random as _random
+
+    import numpy as np
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.util.threads import make_thread
+
+    rng = _random.Random(seed)
+    case = synthetic_cascade_arrays(services, n_roots=1, seed=seed)
+    nprng = np.random.default_rng(seed)
+    plane = FederationPlane(workers=workers, heartbeat_s=heartbeat_s)
+    ttl = plane.leases.ttl_s
+    submitted: List[ServeRequest] = []
+    stop_load = threading.Event()
+
+    def load() -> None:
+        i = 0
+        while not stop_load.is_set():
+            feats = np.clip(
+                case.features + nprng.uniform(
+                    0, 0.05, case.features.shape
+                ).astype(np.float32),
+                0, 1,
+            )
+            req = ServeRequest(
+                tenant=f"chaos-{i % 3}", features=feats,
+                dep_src=case.dep_src, dep_dst=case.dep_dst,
+                names=case.names, k=3,
+            )
+            submitted.append(req)
+            plane.submit(req)
+            i += 1
+            stop_load.wait(0.03)
+
+    def wait_event(pred, deadline: float) -> bool:
+        while plane.clock() < deadline:
+            if any(pred(e) for e in list(plane.events)):
+                return True
+            stop_load.wait(0.05)
+        return False
+
+    phases: List[Dict[str, Any]] = []
+    with plane:
+        if not plane.wait_ready(workers, timeout_s=ready_timeout_s):
+            raise RuntimeError(
+                "federation chaos: workers failed to join: "
+                f"{plane.worker_table()}"
+            )
+        loader = make_thread(load, name="fed-chaos-load", daemon=True)
+        loader.start()
+
+        def downed(wid, klass):
+            return lambda e: (
+                e["event"] == "worker_down"
+                and e["worker_id"] == wid and e.get("class") == klass
+            )
+
+        def rejoined(wid, after):
+            return lambda e: (
+                e["event"] == "rejoin" and e["worker_id"] == wid
+                and e["t"] >= after
+            )
+
+        # 1. worker_hang → expiry → rejoin
+        victims = plane.live_workers()
+        hang_w = victims[rng.randrange(len(victims))]
+        t_h = plane.clock()
+        plane.hang_worker(hang_w, for_s=ttl * 2.5)
+        hang_seen = wait_event(
+            downed(hang_w, "worker_hang"), plane.clock() + timeout_s / 4
+        )
+        hang_rejoin = wait_event(
+            rejoined(hang_w, t_h), plane.clock() + timeout_s / 4
+        )
+        phases.append({"fault": "worker_hang", "worker": hang_w,
+                       "observed": hang_seen, "rejoined": hang_rejoin})
+
+        # 2. coordinator_partition → expiry → heal → rejoin
+        candidates = [
+            w for w in plane.live_workers() if w != hang_w
+        ] or plane.live_workers()
+        part_w = candidates[rng.randrange(len(candidates))]
+        t_p = plane.clock()
+        plane.partition(part_w, for_s=ttl * 2.5)
+        part_seen = wait_event(
+            downed(part_w, "coordinator_partition"),
+            plane.clock() + timeout_s / 4,
+        )
+        part_rejoin = wait_event(
+            rejoined(part_w, t_p), plane.clock() + timeout_s / 4
+        )
+        phases.append({"fault": "coordinator_partition", "worker": part_w,
+                       "observed": part_seen, "rejoined": part_rejoin})
+
+        # 3. process_kill — permanent; survivors absorb the keys
+        live = plane.live_workers()
+        kill_w = live[rng.randrange(len(live))]
+        plane.kill_worker(kill_w)
+        kill_seen = wait_event(
+            downed(kill_w, "process_kill"), plane.clock() + timeout_s / 4
+        )
+        phases.append({"fault": "process_kill", "worker": kill_w,
+                       "observed": kill_seen})
+
+        # let the plane settle under load, then stop
+        stop_load.wait(ttl)
+        stop_load.set()
+        loader.join(10.0)
+        responses = [r.result(timeout_s / 2) for r in submitted]
+        double = plane.sink.double_completions
+        stale = plane.stale_responses
+        reroutes = plane.reroutes
+        classes = plane.fault_classes_observed()
+        events = list(plane.events)
+
+    by_status: Dict[str, int] = {}
+    for r in responses:
+        by_status[r.status] = by_status.get(r.status, 0) + 1
+    detect = [
+        e["detect_lag_ms"] for e in events
+        if e["event"] == "worker_down" and "detect_lag_ms" in e
+    ]
+    all_terminal = all(r.done() for r in submitted)
+    ok = (
+        all_terminal
+        and double == 0
+        and all(p["observed"] for p in phases)
+        and all(p.get("rejoined", True) for p in phases)
+        and set(classes) >= set(FED_FAULT_CLASSES)
+    )
+    return {
+        "ok": bool(ok),
+        "workers": workers,
+        "requests": len(submitted),
+        "by_status": by_status,
+        "all_terminal": bool(all_terminal),
+        "double_completions": double,
+        "stale_responses": stale,
+        "reroutes": reroutes,
+        "fault_classes_observed": classes,
+        "phases": phases,
+        "lease_ttl_s": ttl,
+        "detect_lag_ms_max": round(max(detect), 3) if detect else None,
+        "rejoins": sum(1 for e in events if e["event"] == "rejoin"),
+    }

@@ -1,0 +1,308 @@
+"""Federation worker: one serve process in the fleet (ISSUE 15).
+
+``python -m rca_tpu.serve.worker --connect HOST:PORT --worker-id N``
+runs ONE slice of the federated serving plane: a full
+:class:`rca_tpu.serve.loop.ServeLoop` (or, when ``RCA_SERVE_REPLICAS``
+/ ``RCA_SERVE_REPLICA_MIX`` names more than one replica, a whole
+:class:`rca_tpu.serve.pool.ServePool`) over this process's own JAX
+devices, fronted by a control-channel connection back to the
+:class:`rca_tpu.serve.federation.FederationPlane`.
+
+Bootstrap goes through the :mod:`rca_tpu.parallel.distributed` seam
+first — on a TPU pod every worker host runs this same program and the
+mesh axes come from ``GRAPH_RULES`` exactly as in-process replicas do,
+so a cross-host deployment is an environment change, not new code.  The
+hello frame carries the bootstrap topology so the coordinator can see
+what it federates.
+
+Protocol behavior (see :mod:`rca_tpu.serve.fedwire`):
+
+- hello → lease; heartbeats on the granted cadence renew it;
+- a ``reject`` (stale lease — this worker was declared dead while it
+  was hung or partitioned) triggers an explicit RE-HELLO for a fresh
+  lease: rejoin is loud, never a silent resurrection;
+- ``req`` frames become local :class:`ServeRequest` submissions; each
+  completion is answered with a ``resp`` frame.  A request that was
+  rerouted while this worker was presumed dead may still complete here
+  — the coordinator drops that answer as stale (ITS pending table is
+  the exactly-once arbiter, not this process);
+- ``hang`` (chaos seam) suspends heartbeats for a window while leaving
+  the socket — and local serving — untouched: the ``worker_hang``
+  fault class from the inside;
+- ``drain`` stops intake, finishes in flight, answers ``drained``, and
+  exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+from rca_tpu.serve.fedwire import (
+    FrameConn,
+    FrameError,
+    PROTO,
+    decode_request_kwargs,
+    encode_response,
+)
+from rca_tpu.serve.request import ServeRequest
+from rca_tpu.util.net import make_client_socket, parse_hostport
+from rca_tpu.util.threads import make_lock, spawn
+
+#: bound on one request's local serve time before the worker answers
+#: ``error`` for it (the coordinator's deadline machinery is the real
+#: latency policy; this only prevents a wedged local plane from
+#: accumulating parked waiter threads forever)
+REQUEST_TIMEOUT_S = 120.0
+
+
+class WorkerAgent:
+    """The control-channel client around one local serving plane.
+
+    ``loop`` is a STARTED ServeLoop/ServePool; the agent owns only the
+    wire conversation.  The clock is injectable (nondet-discipline);
+    heartbeat cadence comes from the coordinator's lease grant."""
+
+    def __init__(
+        self,
+        worker_id: int,
+        host: str,
+        port: int,
+        loop,
+        clock: Callable[[], float] = time.monotonic,
+        connect_timeout_s: float = 30.0,
+        engine_tag: str = "",
+    ):
+        self.worker_id = int(worker_id)
+        self.loop = loop
+        self.clock = clock
+        self.engine_tag = engine_tag
+        sock = make_client_socket(
+            f"fed-worker{worker_id}", host, port,
+            timeout_s=connect_timeout_s,
+        )
+        self.conn = FrameConn(sock, name=f"fed-worker{worker_id}")
+        self._lock = make_lock("WorkerAgent._lock")
+        self.lease_id: Optional[str] = None
+        self.heartbeat_s = 0.5
+        self.hang_until = 0.0
+        self.draining = False
+        self.inflight = 0
+        self.served = 0
+        self.acks = 0
+        self._hb_seq = 0
+        self._hb_thread = None
+
+    # -- handshake ------------------------------------------------------------
+    def _hello(self, with_lease: bool = True) -> bool:
+        from rca_tpu.parallel.distributed import initialize_distributed
+
+        boot = initialize_distributed()
+        msg = {
+            "t": "hello", "proto": PROTO, "worker_id": self.worker_id,
+            "pid": os.getpid(),
+            "engine": self.engine_tag,
+            "process_count": boot.get("process_count"),
+            "process_index": boot.get("process_index"),
+            "local_devices": boot.get("local_device_count"),
+        }
+        with self._lock:
+            if with_lease and self.lease_id is not None:
+                msg["lease_id"] = self.lease_id
+        return self.conn.send(msg)
+
+    # -- heartbeats -----------------------------------------------------------
+    def _hb_loop(self) -> None:
+        """Fine-grained scheduler: wake at a fraction of the cadence and
+        send when due, so the FIRST heartbeat lands well inside the
+        lease TTL even when the granted cadence is much faster than the
+        default (the coordinator, not this process, owns the cadence)."""
+        last_sent = 0.0
+        while True:
+            with self._lock:
+                lease, hung = self.lease_id, self.hang_until
+                cadence = self.heartbeat_s
+                if self.draining or self.conn.closed:
+                    return
+            now = self.clock()
+            if (lease is not None and now >= hung
+                    and now - last_sent >= cadence):
+                # between leases or hung (chaos): stay quiet instead
+                self._hb_seq += 1
+                if not self.conn.send({
+                    "t": "hb", "worker_id": self.worker_id,
+                    "lease_id": lease, "seq": self._hb_seq,
+                }):
+                    return   # coordinator gone; read loop sees EOF too
+                last_sent = now
+            time.sleep(max(0.005, cadence / 4.0))
+
+    # -- request handling -----------------------------------------------------
+    def _serve_one(self, request_id: str, req: ServeRequest) -> None:
+        """Waiter-thread body: park on the local plane's completion and
+        answer over the wire.  Send failures are ignored — a vanished
+        coordinator re-places the request elsewhere; its pending table
+        arbitrates exactly-once, not this send."""
+        try:
+            resp = req.result(REQUEST_TIMEOUT_S)
+        except TimeoutError:
+            from rca_tpu.serve.request import ServeResponse
+
+            resp = ServeResponse(
+                status="error", request_id=req.request_id,
+                tenant=req.tenant,
+                detail=f"worker timeout after {REQUEST_TIMEOUT_S}s",
+            )
+        self.conn.send(encode_response(request_id, resp, self.engine_tag))
+        with self._lock:
+            self.inflight -= 1
+            self.served += 1
+
+    def _on_request(self, msg) -> None:
+        request_id = str(msg.get("request_id"))
+        try:
+            kwargs = decode_request_kwargs(msg)
+            req = ServeRequest(**kwargs)
+        except Exception as exc:  # noqa: BLE001 - answer, never wedge
+            self.conn.send({
+                "t": "resp", "request_id": request_id, "status": "error",
+                "ranked": [], "batch_size": 0, "engine": self.engine_tag,
+                "detail": f"bad request frame: {type(exc).__name__}: {exc}",
+            })
+            return
+        with self._lock:
+            if self.draining:
+                self.conn.send({
+                    "t": "resp", "request_id": request_id,
+                    "status": "shed", "ranked": [], "batch_size": 0,
+                    "engine": self.engine_tag, "detail": "worker draining",
+                })
+                return
+            self.inflight += 1
+        self.loop.submit(req)
+        spawn(
+            self._serve_one,
+            name=f"rca-fedw{self.worker_id}-wait{request_id[:8]}",
+            daemon=True, args=(request_id, req),
+        )
+
+    # -- main loop ------------------------------------------------------------
+    def run(self) -> int:
+        """Connect → hello → serve until drain or coordinator loss.
+        Returns the process exit code."""
+        if not self._hello(with_lease=False):
+            return 2
+        self._hb_thread = spawn(
+            self._hb_loop, name=f"rca-fedw{self.worker_id}-hb",
+            daemon=True,
+        )
+        while True:
+            try:
+                msg = self.conn.recv()
+            except FrameError:
+                return 2
+            if msg is None:
+                # coordinator gone: nothing to answer to — exit; the
+                # supervisor (or operator) restarts the fleet member
+                return 0 if self.draining else 3
+            t = msg.get("t")
+            if t == "lease":
+                with self._lock:
+                    self.lease_id = str(msg.get("lease_id"))
+                    self.heartbeat_s = float(
+                        msg.get("heartbeat_s") or self.heartbeat_s
+                    )
+            elif t == "reject":
+                if str(msg.get("reason")) == "stale_lease":
+                    # declared dead while hung/partitioned: rejoin with
+                    # an explicit fresh hello (stale lease dropped)
+                    with self._lock:
+                        self.lease_id = None
+                    if not self._hello(with_lease=False):
+                        return 3
+                else:
+                    return 2
+            elif t == "hb_ack":
+                self.acks += 1
+            elif t == "req":
+                self._on_request(msg)
+            elif t == "hang":
+                with self._lock:
+                    self.hang_until = self.clock() + float(
+                        msg.get("for_s") or 0.0
+                    )
+            elif t == "drain":
+                with self._lock:
+                    self.draining = True
+                deadline = self.clock() + REQUEST_TIMEOUT_S
+                while self.clock() < deadline:
+                    with self._lock:
+                        if self.inflight == 0:
+                            break
+                    time.sleep(0.01)
+                self.conn.send({"t": "drained", "served": self.served})
+                return 0
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+def build_local_plane(config=None):
+    """The worker's serving plane from its OWN environment: one dense
+    engine by default; a replica mix (``RCA_SERVE_REPLICAS`` /
+    ``RCA_SERVE_REPLICA_MIX``) builds a full in-process pool over this
+    worker's devices — federation of pools, not just loops."""
+    from rca_tpu.config import ServeConfig
+    from rca_tpu.engine import make_engine
+    from rca_tpu.serve.loop import ServeLoop
+    from rca_tpu.serve.pool import ServePool
+
+    cfg = config or ServeConfig.from_env()
+    if len(cfg.replica_specs()) > 1:
+        return ServePool(config=cfg), "serve+pool"
+    engine = make_engine()
+    return ServeLoop(engine=engine, config=cfg), getattr(
+        engine, "engine_tag", type(engine).__name__
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rca_tpu.serve.worker",
+        description="federation serve worker (SERVING.md §Federation)",
+    )
+    parser.add_argument("--connect", required=True, metavar="HOST:PORT",
+                        help="coordinator control address")
+    parser.add_argument("--worker-id", type=int, required=True,
+                        dest="worker_id")
+    args = parser.parse_args(argv)
+    host, port = parse_hostport(args.connect, 0)
+    loop, tag = build_local_plane()
+    loop.start()
+    agent = WorkerAgent(args.worker_id, host, port, loop, engine_tag=tag)
+    # the one stdout line: machine-parseable liveness for the procs
+    # seam's capture (everything else goes to stderr)
+    print(json.dumps({
+        "worker": args.worker_id,
+        "pid": os.getpid(),
+        "coordinator": args.connect,
+        "engine": tag,
+    }), flush=True)
+    try:
+        code = agent.run()
+    finally:
+        agent.close()
+        loop.stop()
+    print(json.dumps({
+        "worker": args.worker_id, "exit": code,
+        "served": agent.served,
+    }), file=sys.stderr, flush=True)
+    return code
+
+
+if __name__ == "__main__":   # pragma: no cover - subprocess entry
+    sys.exit(main())

@@ -23,7 +23,8 @@ reasons threads and locks are built in :mod:`rca_tpu.util.threads`:
 from __future__ import annotations
 
 import socket
-from typing import Tuple
+import ssl
+from typing import Optional, Tuple
 
 
 def make_server_socket(
@@ -49,6 +50,70 @@ def make_server_socket(
         sock.close()
         raise OSError(f"{name}: cannot listen on {host}:{port}: {exc}") from exc
     return sock
+
+
+def make_client_socket(
+    name: str,
+    host: str,
+    port: int,
+    timeout_s: Optional[float] = None,
+) -> socket.socket:
+    """A CONNECTED TCP socket named for its owner — the outbound twin of
+    :func:`make_server_socket` (the federation worker's control-channel
+    connection is built here; ``http.client`` internals stay stdlib
+    territory).  ``timeout_s`` bounds the connect; the socket is
+    returned in blocking mode (callers set their own read deadlines)."""
+    try:
+        sock = socket.create_connection(
+            (host, int(port)), timeout=timeout_s
+        )
+    except OSError as exc:
+        raise OSError(
+            f"{name}: cannot connect to {host}:{port}: {exc}"
+        ) from exc
+    sock.settimeout(None)
+    return sock
+
+
+# -- TLS (ISSUE 15: the gateway front door) ----------------------------------
+
+def make_tls_server_context(
+    name: str, certfile: str, keyfile: str,
+) -> ssl.SSLContext:
+    """A server-side TLS context over the one seam, so cert loading
+    failures are attributable and protocol floors are decided once
+    (TLS 1.2+; everything older is disabled by the default context)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    try:
+        ctx.load_cert_chain(certfile=certfile, keyfile=keyfile)
+    except (OSError, ssl.SSLError) as exc:
+        raise ValueError(
+            f"{name}: cannot load TLS cert/key "
+            f"({certfile!r}, {keyfile!r}): {exc}"
+        ) from exc
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    return ctx
+
+
+def make_tls_client_context(
+    name: str, ca_file: Optional[str] = None,
+) -> ssl.SSLContext:
+    """Client-side twin: with ``ca_file`` the server cert is VERIFIED
+    against it (self-signed deployments pin their own cert); without,
+    verification is off — encryption without authentication, loopback
+    test territory only, and the caller had to ask for it by name."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if ca_file:
+        try:
+            ctx.load_verify_locations(cafile=ca_file)
+        except (OSError, ssl.SSLError) as exc:
+            raise ValueError(
+                f"{name}: cannot load CA file {ca_file!r}: {exc}"
+            ) from exc
+    else:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx
 
 
 def bound_address(sock: socket.socket) -> Tuple[str, int]:

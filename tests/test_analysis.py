@@ -146,7 +146,10 @@ class Pair:
                 pass
 """, 1),
     "thread-discipline": ("rca_tpu/serve/bad_threads.py", """\
+import multiprocessing
+import os
 import socket
+import subprocess
 import threading
 
 def main(fn):
@@ -157,7 +160,13 @@ def main(fn):
 
 def listener():
     return socket.socket()         # raw socket outside util/net.py
-""", 3),
+
+def children(argv):
+    p = subprocess.Popen(argv)     # raw child outside util/procs.py
+    pid = os.fork()                # ditto
+    w = multiprocessing.Process(target=main)  # multiprocessing wholesale
+    return p, pid, w
+""", 6),
     "env-discipline": ("rca_tpu/engine/bad_env.py", """\
 import os
 
@@ -352,6 +361,30 @@ from rca_tpu.util.net import make_server_socket
 
 def listen(host, port):
     return make_server_socket("gateway", host, port)  # the seam itself
+"""),
+        ("rca_tpu/serve/good_procs.py", """\
+import subprocess
+
+from rca_tpu.util.procs import python_argv, spawn_worker
+
+def launch(worker_id, addr):
+    # long-lived children go through the seam...
+    return spawn_worker(
+        f"fed-worker{worker_id}",
+        python_argv("rca_tpu.serve.worker", "--connect", addr),
+    )
+
+def one_shot(cmd):
+    # ...one-shot subprocess.run stays legal (no life cycle to own)
+    return subprocess.run(cmd, capture_output=True, timeout=30)
+"""),
+        ("rca_tpu/util/procs.py", """\
+import subprocess
+
+def spawn_worker(name, argv, env=None):
+    # legal ONLY in the procs seam
+    return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, env=env)
 """),
         ("rca_tpu/util/net.py", """\
 import socket

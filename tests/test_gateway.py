@@ -793,3 +793,360 @@ def test_subprocess_listen_wire_parity(tmp_path, engine, case):
             proc.kill()
             proc.wait(10.0)
     assert proc.returncode == 0
+
+
+# -- TLS + bearer authn (ISSUE 15) -------------------------------------------
+
+CERT = os.path.join(REPO_ROOT, "tests", "fixtures", "gateway_cert.pem")
+KEY = os.path.join(REPO_ROOT, "tests", "fixtures", "gateway_key.pem")
+
+
+def _unstarted_loop(engine, cap=2):
+    """A saturatable, never-running plane: authn outcomes complete at
+    (or before) admission, no device work."""
+    return ServeLoop(engine=engine, config=ServeConfig(queue_cap=cap))
+
+
+def test_gateway_tokens_parse_and_reject(monkeypatch):
+    from rca_tpu.config import gateway_tokens, parse_gateway_tokens
+
+    parsed = parse_gateway_tokens("tokA:acme,tokB:beta:1900000000")
+    assert parsed == {"tokA": ("acme", None),
+                      "tokB": ("beta", 1900000000.0)}
+    for bad in ("lonetoken", "a:b,a:c", ":t", "tok:tenant:soon"):
+        with pytest.raises(ValueError):
+            parse_gateway_tokens(bad)
+    monkeypatch.setenv("RCA_GATEWAY_TOKENS", "s3kr1t:solo")
+    assert gateway_tokens() == {"s3kr1t": ("solo", None)}
+    monkeypatch.delenv("RCA_GATEWAY_TOKENS")
+    assert gateway_tokens() == {}
+
+
+def test_gateway_tls_files_pair_enforced(monkeypatch):
+    from rca_tpu.config import gateway_tls_files
+
+    monkeypatch.delenv("RCA_GATEWAY_TLS_CERT", raising=False)
+    monkeypatch.delenv("RCA_GATEWAY_TLS_KEY", raising=False)
+    assert gateway_tls_files() is None
+    monkeypatch.setenv("RCA_GATEWAY_TLS_CERT", CERT)
+    with pytest.raises(ValueError):
+        gateway_tls_files()          # half-configured TLS fails loudly
+    monkeypatch.setenv("RCA_GATEWAY_TLS_KEY", KEY)
+    assert gateway_tls_files() == (CERT, KEY)
+
+
+def test_authn_rejects_before_body_and_queue(engine):
+    """Missing/bad/expired token → 401, spoofed tenant → 403 — all
+    BEFORE the serve queue: the saturable loop's queue stays EMPTY
+    through every rejected request, and a huge declared body is never
+    read."""
+    loop = _unstarted_loop(engine)
+    wall = [1000.0]
+    gw = GatewayServer(
+        loop, port=0,
+        tokens={"tok-a": ("tenant-a", None),
+                "tok-old": ("tenant-o", 999.0)},
+        wall=lambda: wall[0],
+    )
+    gw.start()
+    try:
+        feats = np.zeros((4, 4), np.float32)
+        # missing token
+        code, body, _ = GatewayClient(gw.host, gw.port).analyze(
+            feats, [0], [1]
+        )
+        assert code == 401 and "bearer" in body["detail"].lower()
+        # bad token
+        code, body, _ = GatewayClient(
+            gw.host, gw.port, token="wrong"
+        ).analyze(feats, [0], [1])
+        assert code == 401
+        # expired token (wall seam is past the token's expiry)
+        code, body, _ = GatewayClient(
+            gw.host, gw.port, token="tok-old"
+        ).analyze(feats, [0], [1])
+        assert code == 401 and "expired" in body["detail"]
+        # spoofed tenant header on a valid token
+        code, body, _ = GatewayClient(
+            gw.host, gw.port, token="tok-a"
+        ).analyze(feats, [0], [1], tenant="tenant-b")
+        assert code == 403
+        # none of the rejects touched the queue or read the body
+        assert len(loop.queue) == 0
+        assert gw.metrics.snapshot()["auth_rejections"] == 4
+        # 401 happens even with a huge DECLARED body: headers only
+        conn = http.client.HTTPConnection(gw.host, gw.port, timeout=10)
+        try:
+            conn.putrequest("POST", "/v1/analyze")
+            conn.putheader("Content-Length", str(1 << 30))
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 401
+            resp.read()
+        finally:
+            conn.close()
+        # GET surfaces are gated too; /healthz stays open for probes
+        code, _, _hdrs = _raw_get(gw, "/metrics")
+        assert code == 401
+        code, _, _hdrs = _raw_get(gw, "/healthz")
+        assert code in (200, 503)
+    finally:
+        gw.close()
+
+
+def _raw_get(gw, path, headers=None):
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=10)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def test_token_binds_tenant_end_to_end(engine, case):
+    """A valid token serves — and the response tenant is the TOKEN's,
+    whatever the body claimed (the header spoof already 403s; the body
+    tenant is silently overridden, same precedence as the header)."""
+    loop = ServeLoop(engine=engine).start()
+    try:
+        gw = GatewayServer(loop, port=0,
+                           tokens={"tok-a": ("tenant-a", None)})
+        gw.start()
+        try:
+            cl = GatewayClient(gw.host, gw.port, token="tok-a")
+            code, body, _ = cl.analyze(
+                case.features, case.dep_src, case.dep_dst,
+                names=case.names, k=3,
+            )
+            assert code == 200 and body["status"] == "ok"
+            assert body["tenant"] == "tenant-a"
+            # matching header is fine (not a spoof)
+            code, body, _ = cl.analyze(
+                case.features, case.dep_src, case.dep_dst,
+                names=case.names, k=3, tenant="tenant-a",
+            )
+            assert code == 200
+        finally:
+            gw.close()
+    finally:
+        loop.stop()
+
+
+def test_tls_handshake_and_plaintext_rejection(engine, case):
+    """TLS gateway: a verified HTTPS client round-trips bit-identical
+    rankings; a plaintext client dies at the handshake — rejected
+    before the serve queue by construction."""
+    loop = ServeLoop(engine=engine).start()
+    try:
+        gw = GatewayServer(loop, port=0, tls=(CERT, KEY))
+        gw.start()
+        try:
+            cl = GatewayClient(gw.host, gw.port, tls=True, ca_file=CERT)
+            code, body, _ = cl.analyze(
+                case.features, case.dep_src, case.dep_dst,
+                names=case.names, k=3,
+            )
+            assert code == 200 and body["status"] == "ok"
+            solo = engine.analyze_arrays(
+                case.features, case.dep_src, case.dep_dst, case.names,
+                k=3,
+            )
+            assert body["ranked"] == solo.ranked   # parity through TLS
+            # plaintext to the TLS port: dead at the handshake
+            with pytest.raises((OSError, http.client.HTTPException)):
+                conn = http.client.HTTPConnection(
+                    gw.host, gw.port, timeout=5
+                )
+                try:
+                    conn.request("GET", "/healthz")
+                    conn.getresponse().read()
+                finally:
+                    conn.close()
+            # unverified-but-encrypted client (no ca_file) also works —
+            # the caller had to ask for no-verify by name
+            code, _ = GatewayClient(
+                gw.host, gw.port, tls=True
+            ).healthz()
+            assert code == 200
+        finally:
+            gw.close()
+    finally:
+        loop.stop()
+
+
+def test_tls_authn_stack_over_federation_plane(engine, case):
+    """The ISSUE 15 front-door acceptance shape: TLS + tokens over a
+    FEDERATION plane (fake in-process worker speaking the real wire
+    protocol) — https analyze round-trips; plaintext and token-less
+    requests never reach the plane's queue."""
+    from rca_tpu.serve.federation import FederationPlane
+    from rca_tpu.serve.fedwire import FrameConn, PROTO
+    from rca_tpu.util.net import make_client_socket
+    from rca_tpu.util.threads import spawn
+
+    plane = FederationPlane(workers=1, spawn_workers=False,
+                            heartbeat_s=0.05)
+    plane.start()
+
+    def fake_worker():
+        sock = make_client_socket("fed-test", plane.host, plane.port)
+        conn = FrameConn(sock, "fed-test")
+        conn.send({"t": "hello", "proto": PROTO, "worker_id": 0,
+                   "pid": 0, "engine": "fake"})
+        lease = [None]
+
+        def hb():
+            import time as _t
+            seq = 0
+            while not conn.closed:
+                _t.sleep(0.05)
+                if lease[0]:
+                    seq += 1
+                    if not conn.send({"t": "hb", "worker_id": 0,
+                                      "lease_id": lease[0],
+                                      "seq": seq}):
+                        return
+        spawn(hb, name="fed-test-hb", daemon=True)
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                return
+            if msg["t"] == "lease":
+                lease[0] = msg["lease_id"]
+            elif msg["t"] == "req":
+                conn.send({
+                    "t": "resp", "request_id": msg["request_id"],
+                    "status": "ok",
+                    "ranked": [{"component": "svc-0", "score": 1.0}],
+                    "batch_size": 1, "engine": "fake",
+                })
+            elif msg["t"] == "drain":
+                conn.send({"t": "drained"})
+                return
+
+    spawn(fake_worker, name="fed-test-worker", daemon=True)
+    assert plane.wait_ready(1, timeout_s=10.0)
+    try:
+        gw = GatewayServer(plane, port=0, tls=(CERT, KEY),
+                           tokens={"fed-tok": ("fed-tenant", None)})
+        gw.start()
+        try:
+            cl = GatewayClient(gw.host, gw.port, tls=True,
+                               ca_file=CERT, token="fed-tok")
+            code, body, _ = cl.analyze(
+                case.features, case.dep_src, case.dep_dst, k=3,
+            )
+            assert code == 200 and body["tenant"] == "fed-tenant"
+            # /healthz reads the plane's lease-fed health
+            code, health = cl.healthz()
+            assert code == 200 and health["ok"]
+            assert health["workers"] == {"0": "live"}
+            # token-less HTTPS request: 401 before the plane's queue
+            code, body, _ = GatewayClient(
+                gw.host, gw.port, tls=True
+            ).analyze(case.features, case.dep_src, case.dep_dst)
+            assert code == 401
+            assert len(plane.queue) == 0
+        finally:
+            gw.close()
+    finally:
+        plane.stop()
+
+
+# -- Retry-After jitter + client retries (ISSUE 15 small fix) ----------------
+
+
+def test_retry_after_jitter_breaks_thundering_herd(engine):
+    """Six consecutive 429s carry DISTINCT jittered ms hints (seeded —
+    deterministic per gateway), while the integer Retry-After stays a
+    spec-shaped ceiling of the hint."""
+    loop = _unstarted_loop(engine)
+    for i in range(2):
+        assert loop.submit(_req(seed=i))     # saturate
+    with GatewayServer(loop, port=0, retry_jitter_seed=7) as gw:
+        cl = GatewayClient(gw.host, gw.port)
+        hints = []
+        for _ in range(6):
+            code, _body, headers = cl.analyze(
+                np.zeros((4, 4), np.float32), [0], [1]
+            )
+            assert code == 429
+            ms = int(headers["X-RCA-Retry-After-Ms"])
+            secs = int(headers["Retry-After"])
+            assert 1000 <= ms < 3001
+            assert secs >= ms / 1000.0       # ceiling, never earlier
+            hints.append(ms)
+        assert len(set(hints)) >= 5          # de-synchronized retries
+
+
+def test_client_retries_honor_jittered_hint(engine):
+    """GatewayClient sleeps the SERVER's jittered hint between retries
+    and lands the request once capacity returns."""
+    loop = _unstarted_loop(engine, cap=1)
+    assert loop.submit(_req(seed=0))         # saturate cap=1
+    sleeps: list = []
+    # the gateway's own wait bound is tight so the RETRIED (admitted,
+    # never served — the loop doesn't run) request answers 504 fast
+    with GatewayServer(loop, port=0, retry_jitter_seed=3,
+                       timeout_s=1.0) as gw:
+        def sleeper(s: float) -> None:
+            sleeps.append(s)
+            # free the queue on the first backoff: the retry must land
+            if len(sleeps) == 1:
+                loop.queue.pop()
+
+        cl = GatewayClient(gw.host, gw.port, sleeper=sleeper)
+        code, body, _ = cl.analyze(
+            np.zeros((4, 4), np.float32), [0], [1], retries=3,
+        )
+        # exactly one backoff (the jittered hint), then ADMITTED —
+        # proven by the queue depth; the 504 is the gateway's honest
+        # bound on the never-running stub loop
+        assert len(sleeps) == 1
+        assert 1.0 <= sleeps[0] <= 3.001     # the jittered hint
+        assert code == 504
+        assert len(loop.queue) == 1
+    loop.queue.pop()
+
+
+def test_retry_delay_prefers_ms_header():
+    assert GatewayClient.retry_delay_s(
+        {"X-RCA-Retry-After-Ms": "1750", "Retry-After": "2"}
+    ) == 1.75
+    assert GatewayClient.retry_delay_s({"Retry-After": "3"}) == 3.0
+    assert GatewayClient.retry_delay_s({}) == 1.0
+
+
+# -- canary off a live gateway (ISSUE 15 satellite) --------------------------
+
+
+def test_canary_samples_through_live_gateway(engine, tmp_path):
+    """`rca canary --listen-url`: sampling goes over the WIRE of a
+    running (token-authed) gateway; the minted recording replays with
+    bit parity against the current build — the federation path now
+    mints regression corpora too."""
+    from rca_tpu.gateway.canary import run_canary
+
+    loop = ServeLoop(engine=engine).start()
+    try:
+        gw = GatewayServer(loop, port=0,
+                           tokens={"can-tok": ("canary", None)})
+        gw.start()
+        try:
+            report = run_canary(
+                str(tmp_path / "corpus"),
+                rounds=2, services=20, seed=0, serve_requests=3, k=3,
+                listen_url=f"http://{gw.host}:{gw.port}",
+                token="can-tok",
+            )
+            assert report["ok"], report
+            assert report["mode"] == "gateway"
+            assert report["sampled"] == 2
+            for rec in report["recordings"]:
+                assert rec["parity_ok"]
+                assert rec["mode"] == "serve"
+        finally:
+            gw.close()
+    finally:
+        loop.stop()

@@ -49,6 +49,13 @@ HEADLINE_METRICS = {
     "tick_ms_10k": ("tick_ms_10k",),
     "serve_request_ms_p50": ("serve_throughput_2k", "request_ms_p50"),
     "live_sweep_capture_ms_10k": ("live_sweep_capture_ms_10k",),
+    # federation (ISSUE 15): cross-process serve p50 and the kill-leg
+    # recovery wall — a regression in either means the fleet story
+    # (wire hop, drain-and-reroute) got slower
+    "federation_request_ms_p50": (
+        "serve_federation", "request_ms_p50",
+    ),
+    "federation_recovery_ms": ("serve_federation", "recovery_ms"),
 }
 
 #: metrics gated TIGHTER than the default threshold, name -> (path,
