@@ -1486,6 +1486,41 @@ def serve_federation_metrics(
     }
 
 
+def serve_autoscale_metrics(seed: int = 0) -> dict:
+    """``serve_autoscale`` (ISSUE 16): the elastic fleet's load-ramp
+    soak as a measurement — thread-mode workers walk 2→8→2 under
+    continuous closed-loop traffic with the exactly-once and
+    all-terminal contracts ASSERTED in-run.  Reports the request
+    p50/p99 THROUGH both transitions (the elastic tax a fixed fleet
+    never pays), the windowed queue p99 right after the up-ramp,
+    ramp walls, the controller's per-sweep decision latency, and the
+    shape-aware placement hit rate (0.0 on hosts whose kernel registry
+    has no autotuned timings to advertise — rendezvous fallback)."""
+    from rca_tpu.serve.autoscale import run_scale_ramp_soak
+
+    out = run_scale_ramp_soak(seed=seed, min_workers=2, max_workers=8)
+    assert out["all_terminal"], "autoscale soak: a request never completed"
+    assert out["double_completions"] == 0, "autoscale soak: double completion"
+    return {
+        "ok": out["ok"],
+        "min_workers": out["min_workers"],
+        "max_workers": out["max_workers"],
+        "requests": out["requests"],
+        "host_cores": len(os.sched_getaffinity(0)),
+        "ramp_request_ms_p50": out["request_ms_p50"],
+        "ramp_request_ms_p99": out["request_ms_p99"],
+        "queue_ms_p99_after_up": out["queue_ms_p99_after_up"],
+        "ramp_up_s": out["ramp_up_s"],
+        "ramp_down_s": out["ramp_down_s"],
+        "scale_ups": out["scale_ups"],
+        "scale_downs": out["scale_downs"],
+        "scale_decision_ms_p50": out["scale_decision_ms_p50"],
+        "placement_hit_rate": out["placement_hit_rate"],
+        "stale_responses": out["stale_responses"],
+        "by_status": out["by_status"],
+    }
+
+
 def main(skip_accuracy: bool = False, with_chaos: bool = False,
          guard: bool = False) -> int:
     """Stdout-hygiene wrapper: the whole measurement body runs with
@@ -2040,6 +2075,15 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
     except Exception as exc:
         serve_federation_line = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- serve autoscale (ISSUE 16): the elastic fleet's 2→8→2 ramp
+    # soak — request p50/p99 through both scale transitions, controller
+    # decision latency, placement hit rate (exactly-once asserted
+    # in-run)
+    try:
+        serve_autoscale_line = serve_autoscale_metrics()
+    except Exception as exc:
+        serve_autoscale_line = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- observability (ISSUE 11): tracing overhead on/off at
     # concurrency 16, span drop rate under saturation, profile capture
     # cost for a 20-tick window
@@ -2288,6 +2332,9 @@ def _bench_main(real_stdout, skip_accuracy: bool = False,
         # cross-process federation (ISSUE 15): wire-hop overhead vs the
         # single-process loop, kill-leg recovery_ms, lease detect lag
         "serve_federation": serve_federation_line,
+        # elastic fleet (ISSUE 16): 2→8→2 ramp latency through the
+        # transitions, scale-decision latency, placement hit rate
+        "serve_autoscale": serve_autoscale_line,
         # tracing (ISSUE 11): overhead on/off, drop rate, profile cost
         "observability": observability_line,
         "tick_ms_10k": round(tick_ms_10k, 3),

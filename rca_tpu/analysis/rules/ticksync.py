@@ -49,6 +49,9 @@ TICK_MODULES = {
     "rca_tpu/serve/federation.py": set(),
     "rca_tpu/serve/worker.py": set(),
     "rca_tpu/serve/fedwire.py": set(),
+    # elasticmesh (ISSUE 16): scale decisions are pure control-plane
+    # arithmetic over already-exported telemetry — never a device sync
+    "rca_tpu/serve/autoscale.py": set(),
     "rca_tpu/util/procs.py": set(),
     # gateway (ISSUE 9): the wire front door never touches the device —
     # handlers park on req.result() like any in-process submitter, so

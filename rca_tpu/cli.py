@@ -441,6 +441,22 @@ def cmd_chaos(args) -> int:
             seed=seed, workers=args.federation_workers,
         )
         fed_ok = summary["federation"]["ok"]
+    # scaling_storm leg (ISSUE 16): forced scale transitions racing the
+    # federation fault seams — scale-up vs SIGKILL, rejoin vs drain,
+    # partition during scale-down — gated on all-terminal + zero double
+    # completions + bounded stale drops + every race observed
+    auto_ok = True
+    if (not getattr(args, "no_federation", False)
+            and not getattr(args, "no_autoscale", False)
+            and args.ticks >= 100):
+        from rca_tpu.serve.autoscale import run_scaling_storm
+
+        summary["autoscale"] = run_scaling_storm(seed=seed)
+        auto_ok = (
+            summary["autoscale"]["ok"]
+            and "scaling_storm"
+            in summary["autoscale"]["fault_classes_observed"]
+        )
     print(json.dumps(summary, indent=None if args.compact else 2))
     scope = summary.get("kernelscope", {})
     ok = (
@@ -448,6 +464,7 @@ def cmd_chaos(args) -> int:
         and summary["parity_ok"]
         and (summary["all_classes_observed"] or args.ticks < 100)
         and fed_ok
+        and auto_ok
         # --record adds the record→replay parity leg to the contract
         and summary.get("replay", {}).get("parity_ok", True)
         # kernelscope gates (ISSUE 12): zero post-warmup recompiles on
@@ -456,6 +473,22 @@ def cmd_chaos(args) -> int:
         and scope.get("memory_gate", {}).get("ok", True)
     )
     return 0 if ok else 1
+
+
+def _parse_autoscale(spec: str):
+    """``MIN:MAX`` → (min, max) with loud validation (SERVING.md
+    §Autoscaling)."""
+    m = re.fullmatch(r"(\d+):(\d+)", (spec or "").strip())
+    if not m:
+        raise SystemExit(
+            f"--autoscale wants MIN:MAX (e.g. 2:8), got {spec!r}"
+        )
+    mn, mx = int(m.group(1)), int(m.group(2))
+    if not 1 <= mn <= mx:
+        raise SystemExit(
+            f"--autoscale {spec!r}: need 1 <= MIN <= MAX"
+        )
+    return mn, mx
 
 
 def cmd_serve(args) -> int:
@@ -486,6 +519,21 @@ def cmd_serve(args) -> int:
     config = ServeConfig.from_env(**overrides)
     if args.listen:
         return _serve_listen(args, config)
+    if args.autoscale and args.federation is None:
+        # `rca serve --autoscale MIN:MAX` (no listener): the load-ramp
+        # soak — a thread-mode fleet scales MIN→MAX→MIN under
+        # continuous traffic, gated on all-terminal + exactly-once +
+        # bounded windowed p99 through both transitions
+        from rca_tpu.serve.autoscale import run_scale_ramp_soak
+
+        mn, mx = _parse_autoscale(args.autoscale)
+        summary = run_scale_ramp_soak(
+            seed=args.seed, min_workers=mn, max_workers=mx,
+            config=config,
+        )
+        print(json.dumps(summary, indent=None if args.compact else 2,
+                         default=str))
+        return 0 if summary["ok"] else 1
     if args.federation is not None or args.kill_worker:
         # cross-process federation selftest (ISSUE 15): N real worker
         # processes, wire load, optional SIGKILL mid-wave — exit 0 only
@@ -501,6 +549,7 @@ def cmd_serve(args) -> int:
             kill_worker=args.kill_worker,
             submitters=args.submitters,
             config=config,
+            bind_external=getattr(args, "bind_external", False),
         )
         print(json.dumps(summary, indent=None if args.compact else 2,
                          default=str))
@@ -617,12 +666,28 @@ def _serve_listen(args, config) -> int:
             "frames live in the worker processes (use `rca canary "
             "--listen-url` to mint recordings off the live gateway)"
         )
+    autoscale_spec = getattr(args, "autoscale", None)
+    if autoscale_spec and not federated:
+        raise SystemExit(
+            "--autoscale with --listen needs --federation N (an elastic "
+            "fleet is a federation property; in-process pools resize "
+            "via RCA_SERVE_REPLICAS)"
+        )
+    controller = None
     if federated:
         # the TLS+authn front door over a whole worker fleet (ISSUE 15)
         from rca_tpu.serve.federation import FederationPlane
 
+        plane_kwargs = {}
+        if getattr(args, "bind_external", False):
+            from rca_tpu.util.net import primary_host_ip
+
+            plane_kwargs.update(
+                host="0.0.0.0", advertise_host=primary_host_ip(),
+            )
         loop = FederationPlane(
             workers=federated, config=config, store=store,
+            **plane_kwargs,
         )
         loop.start()
         if not loop.wait_ready(federated, timeout_s=120.0):
@@ -631,6 +696,24 @@ def _serve_listen(args, config) -> int:
                 f"federation: only {len(loop.live_workers())}/"
                 f"{federated} workers joined"
             )
+        if autoscale_spec:
+            # elasticmesh (ISSUE 16): the controller watches queue-time
+            # p99 / SLO-burn / occupancy and walks the fleet inside
+            # MIN..MAX through SCALE_RULES; --federation N is the
+            # starting width and must sit inside the bounds
+            from rca_tpu.serve.autoscale import AutoscaleController
+
+            mn, mx = _parse_autoscale(autoscale_spec)
+            if not mn <= federated <= mx:
+                loop.stop()
+                raise SystemExit(
+                    f"--autoscale {autoscale_spec}: --federation "
+                    f"{federated} is outside [{mn}, {mx}]"
+                )
+            controller = AutoscaleController(
+                loop, min_workers=mn, max_workers=mx,
+            )
+            controller.start(spawn_min=False)
     elif pooled:
         loop = ServePool(config=config, recorder=recorder, store=store)
         loop.start()
@@ -651,6 +734,10 @@ def _serve_listen(args, config) -> int:
         "listening": gw.address,
         **({"workers": len(loop.live_workers())} if federated else
            {"replicas": len(loop.replicas) if pooled else 1}),
+        **({"control": loop.address} if federated else {}),
+        **({"autoscale": f"{controller.min_workers}:"
+                         f"{controller.max_workers}"}
+           if controller is not None else {}),
         "tls": gw.tls_context is not None,
         "authn": bool(gw.tokens),
         "max_body": gw.max_body,
@@ -662,6 +749,8 @@ def _serve_listen(args, config) -> int:
         while not stop.is_set():
             stop.wait(0.5)
     finally:
+        if controller is not None:
+            controller.stop()
         gw.close()
         loop.stop()
         if recorder is not None:
@@ -676,6 +765,64 @@ def _serve_listen(args, config) -> int:
             "metrics": loop.metrics.summary(),
         }, default=str), file=sys.stderr)
     return 0
+
+
+def cmd_fleet(args) -> int:
+    """``rca fleet URL`` (SERVING.md §Autoscaling): the operator's view
+    of a RUNNING elastic federation — one /healthz call rendered as a
+    worker table (state, outstanding, served, placement evidence) plus
+    the controller's bounds and last decision.  ``--json`` prints the
+    raw health body instead."""
+    from rca_tpu.gateway.client import GatewayClient
+
+    client = GatewayClient.from_url(
+        args.url, token=args.token, ca_file=args.ca_file,
+        cert_file=args.cert_file, key_file=args.key_file,
+        timeout_s=args.timeout,
+    )
+    status, body = client.healthz()
+    if args.json:
+        print(json.dumps(body, indent=2, default=str))
+        return 0 if status == 200 else 1
+    fleet = body.get("fleet")
+    if fleet is None:
+        print(json.dumps({
+            "error": "not a federation gateway (no fleet in /healthz)",
+            "health": body,
+        }, indent=2, default=str))
+        return 1
+    cols = ("worker", "state", "outstanding", "served", "shapes",
+            "mem_bytes", "engine", "pid")
+    rows = [
+        (str(w.get("worker_id")), str(w.get("state")),
+         str(w.get("outstanding")), str(w.get("served")),
+         str(w.get("shapes_known")), str(w.get("mem_bytes") or "-"),
+         str(w.get("engine") or "-"), str(w.get("pid") or "-"))
+        for w in fleet
+    ]
+    widths = [
+        max(len(cols[i]), *(len(r[i]) for r in rows)) if rows
+        else len(cols[i])
+        for i in range(len(cols))
+    ]
+    print("  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)))
+    for r in rows:
+        print("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
+    line = {
+        "ok": body.get("ok"),
+        "queue_depth": body.get("queue_depth"),
+        "pending": body.get("pending"),
+    }
+    auto = body.get("autoscale")
+    if auto:
+        line["autoscale"] = {
+            "bounds": f"{auto.get('min')}:{auto.get('max')}",
+            "running": auto.get("running"),
+            "decisions": auto.get("decisions"),
+            "last": (auto.get("last_decision") or {}).get("action"),
+        }
+    print(json.dumps(line, default=str))
+    return 0 if status == 200 and body.get("ok") else 1
 
 
 def cmd_canary(args) -> int:
@@ -1218,6 +1365,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--federation-workers", type=int, default=3,
                     dest="federation_workers",
                     help="worker processes in the federation chaos leg")
+    sp.add_argument("--no-autoscale", action="store_true",
+                    dest="no_autoscale",
+                    help="skip the scaling_storm chaos leg (forced scale "
+                    "transitions racing kill/hang/partition)")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_chaos)
 
@@ -1283,6 +1434,20 @@ def build_parser() -> argparse.ArgumentParser:
                     "process mid-wave and assert drain-and-reroute "
                     "leaves every request terminal with zero double "
                     "completions")
+    sp.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                    help="elastic fleet bounds (SERVING.md §Autoscaling). "
+                    "Alone: run the 2→8→2-style load-ramp soak (thread "
+                    "fleet scales MIN→MAX→MIN under continuous traffic; "
+                    "exit 0 only on all-terminal + exactly-once + "
+                    "bounded p99).  With --listen --federation N: attach "
+                    "the SCALE_RULES controller to the live fleet")
+    sp.add_argument("--bind-external", action="store_true",
+                    dest="bind_external",
+                    help="bind the federation control port on 0.0.0.0 "
+                    "and advertise this host's primary IP, so workers "
+                    "on OTHER hosts can join via --connect (selftest: "
+                    "workers join through the advertised non-loopback "
+                    "address; SERVING.md §Deploy)")
     sp.add_argument("--record", default=None, metavar="PATH",
                     help="flight-record every served request to PATH "
                     "(load-demo and --listen modes); re-check with "
@@ -1300,6 +1465,33 @@ def build_parser() -> argparse.ArgumentParser:
                     "append serve notes there)")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "fleet",
+        help="status table for a running elastic federation: one "
+        "/healthz call rendered as worker rows + autoscale bounds "
+        "and last decision (SERVING.md §Autoscaling)",
+    )
+    sp.add_argument("url", metavar="URL",
+                    help="gateway address, http[s]://host:port")
+    sp.add_argument("--token", default=None,
+                    help="bearer token for a gateway with "
+                    "RCA_GATEWAY_TOKENS set")
+    sp.add_argument("--ca-file", default=None, dest="ca_file",
+                    metavar="PEM",
+                    help="verify a TLS gateway against this cert")
+    sp.add_argument("--cert-file", default=None, dest="cert_file",
+                    metavar="PEM",
+                    help="client certificate for an mTLS gateway "
+                    "(RCA_GATEWAY_TLS_CLIENT_CA)")
+    sp.add_argument("--key-file", default=None, dest="key_file",
+                    metavar="PEM",
+                    help="client key (defaults to the cert file)")
+    sp.add_argument("--timeout", type=float, default=10.0)
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw /healthz body instead of the "
+                    "rendered table")
+    sp.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser(
         "canary",

@@ -451,6 +451,13 @@ def canary_sample_rate() -> float:
 #                         read — and the token's tenant BINDS the
 #                         request: an X-RCA-Tenant header naming a
 #                         different tenant is a spoof attempt (403).
+#   RCA_GATEWAY_TLS_CLIENT_CA
+#                         PEM CA bundle for MUTUAL TLS (ISSUE 16): when
+#                         set (requires the cert/key pair above), the
+#                         listener demands and verifies a client
+#                         certificate at handshake; a client without one
+#                         is rejected before a single HTTP byte and the
+#                         rejection counts in ``auth_rejections``.
 
 
 def gateway_tls_files() -> Optional[Tuple[str, str]]:
@@ -468,6 +475,22 @@ def gateway_tls_files() -> Optional[Tuple[str, str]]:
             "serve plaintext)"
         )
     return cert, key
+
+
+def gateway_tls_client_ca() -> Optional[str]:
+    """``RCA_GATEWAY_TLS_CLIENT_CA``: PEM CA bundle that turns the TLS
+    gateway MUTUAL — set without the cert/key pair raises (an mTLS knob
+    on a plaintext listener would silently verify nobody)."""
+    ca = (env_raw("RCA_GATEWAY_TLS_CLIENT_CA") or "").strip()
+    if not ca:
+        return None
+    if gateway_tls_files() is None:
+        raise ValueError(
+            "RCA_GATEWAY_TLS_CLIENT_CA requires RCA_GATEWAY_TLS_CERT/"
+            "RCA_GATEWAY_TLS_KEY (client-cert verification needs a TLS "
+            "listener to verify on)"
+        )
+    return ca
 
 
 def parse_gateway_tokens(spec: str) -> "Dict[str, Tuple[str, Optional[float]]]":
@@ -541,6 +564,22 @@ def gateway_tenant_rps() -> float:
 #                        spills to the next ring worker past it, so one
 #                        hot bucket cannot wedge the whole plane behind
 #                        one process
+#
+# elasticmesh (ISSUE 16) — the autoscaling controller's fleet bounds and
+# pacing (rca_tpu/serve/autoscale.py, SERVING.md §Autoscaling):
+#
+#   RCA_FED_SCALE_MIN        [1, 64]  fleet floor the controller never
+#                            drains below (default 1)
+#   RCA_FED_SCALE_MAX        [1, 64]  fleet ceiling it never spawns past
+#                            (default 8); min > max fails loudly at
+#                            controller construction
+#   RCA_FED_SCALE_COOLDOWN_S [0.05, 600.0]  quiet period after ANY scale
+#                            action before the next may fire (default
+#                            10.0) — with the per-rule sustain windows in
+#                            SCALE_RULES this is what makes a flapping
+#                            load signal unable to thrash the ring
+#   RCA_FED_SCALE_INTERVAL_S [0.01, 60.0]  controller sweep cadence,
+#                            seconds (default 1.0)
 
 
 def fed_workers() -> int:
@@ -561,6 +600,26 @@ def fed_lease_misses() -> int:
 def fed_window() -> int:
     """``RCA_FED_WINDOW``: per-worker outstanding-request window."""
     return env_int("RCA_FED_WINDOW", 64, 1, 4096)
+
+
+def fed_scale_min() -> int:
+    """``RCA_FED_SCALE_MIN``: autoscaler fleet floor."""
+    return env_int("RCA_FED_SCALE_MIN", 1, 1, 64)
+
+
+def fed_scale_max() -> int:
+    """``RCA_FED_SCALE_MAX``: autoscaler fleet ceiling."""
+    return env_int("RCA_FED_SCALE_MAX", 8, 1, 64)
+
+
+def fed_scale_cooldown_s() -> float:
+    """``RCA_FED_SCALE_COOLDOWN_S``: quiet period after a scale action."""
+    return env_float("RCA_FED_SCALE_COOLDOWN_S", 10.0, 0.05, 600.0)
+
+
+def fed_scale_interval_s() -> float:
+    """``RCA_FED_SCALE_INTERVAL_S``: controller sweep cadence (seconds)."""
+    return env_float("RCA_FED_SCALE_INTERVAL_S", 1.0, 0.01, 60.0)
 
 
 # -- tracing + SLO telemetry (ISSUE 11) --------------------------------------

@@ -28,12 +28,17 @@ class GatewayClient:
     """``tls=True`` speaks HTTPS; ``ca_file`` pins/verifies the server
     cert (self-signed deployments pass their own cert), without it the
     connection is encrypted but UNverified — loopback test territory.
-    ``token`` rides every request as ``Authorization: Bearer`` for
-    gateways with ``RCA_GATEWAY_TOKENS`` set.  ``sleeper`` is the
-    injectable delay seam the retry path uses (tests pass a recorder)."""
+    ``cert_file``/``key_file`` present this client's certificate to an
+    mTLS gateway (``RCA_GATEWAY_TLS_CLIENT_CA``); without them such a
+    gateway rejects the handshake.  ``token`` rides every request as
+    ``Authorization: Bearer`` for gateways with ``RCA_GATEWAY_TOKENS``
+    set.  ``sleeper`` is the injectable delay seam the retry path uses
+    (tests pass a recorder)."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 60.0,
                  tls: bool = False, ca_file: Optional[str] = None,
+                 cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None,
                  token: Optional[str] = None,
                  sleeper: Callable[[float], None] = time.sleep):
         self.host = host
@@ -41,6 +46,8 @@ class GatewayClient:
         self.timeout_s = float(timeout_s)
         self.tls = bool(tls)
         self.ca_file = ca_file
+        self.cert_file = cert_file
+        self.key_file = key_file
         self.token = token
         self.sleeper = sleeper
 
@@ -67,7 +74,8 @@ class GatewayClient:
             return http.client.HTTPSConnection(
                 self.host, self.port, timeout=timeout,
                 context=make_tls_client_context(
-                    "gateway-client", self.ca_file
+                    "gateway-client", self.ca_file,
+                    cert_file=self.cert_file, key_file=self.key_file,
                 ),
             )
         return http.client.HTTPConnection(

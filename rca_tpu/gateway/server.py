@@ -52,6 +52,7 @@ from rca_tpu.config import (
     gateway_max_body,
     gateway_port,
     gateway_tenant_rps,
+    gateway_tls_client_ca,
     gateway_tls_files,
     gateway_tokens,
 )
@@ -299,13 +300,22 @@ class _GatewayHTTPServer(HTTPServer):
                 # TLS handshake happens HERE, on the connection thread —
                 # never on the acceptor (a slow or plaintext client must
                 # not block accept).  A failed handshake (plaintext to a
-                # TLS gateway, bad protocol) raises, is recorded in the
-                # fault log, and the connection dies having touched
-                # nothing: rejected before the serve queue by
-                # construction.
-                request = self.gateway.tls_context.wrap_socket(
-                    request, server_side=True
-                )
+                # TLS gateway, bad protocol, missing/untrusted client
+                # cert under mTLS) raises, is recorded in the fault log,
+                # and the connection dies having touched nothing:
+                # rejected before the serve queue by construction.
+                try:
+                    request = self.gateway.tls_context.wrap_socket(
+                        request, server_side=True
+                    )
+                except (OSError, ValueError):
+                    # under mTLS a handshake failure IS an authn
+                    # rejection (no/untrusted client cert) — count it
+                    # with the other refused credentials, then let
+                    # suppressed() log the fault
+                    if self.gateway.tls_client_ca is not None:
+                        self.gateway.metrics.auth_rejected()
+                    raise
             self.finish_request(request, client_address)
         self.shutdown_request(request)
 
@@ -764,6 +774,7 @@ class GatewayServer:
         tracer=None,
         wall: Callable[[], float] = time.time,
         tls: Optional[Tuple[str, str]] = None,
+        tls_client_ca: Optional[str] = None,
         tokens: Optional[Dict[str, Tuple[str, Optional[float]]]] = None,
         retry_jitter_s: float = 2.0,
         retry_jitter_seed: Optional[int] = None,
@@ -778,11 +789,26 @@ class GatewayServer:
         # (tenant, expires) — default from RCA_GATEWAY_TOKENS; empty =
         # authn off (the ISSUE-9 auth-less behavior, loopback territory).
         tls_pair = tls if tls is not None else gateway_tls_files()
+        # mTLS (ISSUE 16): a client-CA file upgrades the listener to
+        # REQUIRE and verify client certificates at handshake —
+        # rejection happens before a byte of HTTP, counted in
+        # auth_rejections like every other refused credential
+        client_ca = (
+            tls_client_ca if tls_client_ca is not None
+            else (gateway_tls_client_ca() if tls is None else None)
+        )
+        if client_ca and tls_pair is None:
+            raise ValueError(
+                "gateway: tls_client_ca requires a TLS cert/key pair "
+                "(mTLS without server TLS is not a thing)"
+            )
+        self.tls_client_ca = client_ca or None
         if tls_pair is not None:
             from rca_tpu.util.net import make_tls_server_context
 
             self.tls_context = make_tls_server_context(
-                "gateway", tls_pair[0], tls_pair[1]
+                "gateway", tls_pair[0], tls_pair[1],
+                client_ca=self.tls_client_ca,
             )
         else:
             self.tls_context = None
@@ -890,6 +916,7 @@ class GatewayServer:
             return {
                 "ok": bool(ok), "replicas": states,
                 "queue_depth": len(loop.queue),
+                "occupancy": round(loop.occupancy(), 4),
             }
         state = loop.breaker.state
         return {

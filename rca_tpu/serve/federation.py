@@ -38,6 +38,22 @@ past the per-worker outstanding window (``RCA_FED_WINDOW``) a request
 spills to the next worker on its ring so one hot bucket cannot wedge
 the plane behind one process.
 
+**The fleet is elastic** (ISSUE 16, elasticmesh).  An
+:class:`rca_tpu.serve.autoscale.AutoscaleController` attached to the
+plane spawns workers through the procs seam and retires them through
+:meth:`FederationPlane.drain_worker` — the worker leaves the ring
+first, finishes its in-flight work, answers ``drained``, and only then
+is its process terminated, so a scale-down is invisible to the
+exactly-once contract (and never misclassified as a fault).  Placement
+is shape-aware on top of rendezvous: hello frames carry each worker's
+kernel-registry and device-memory summaries, and for graph buckets the
+``PLACEMENT_RULES`` table marks as informed-routable the router
+prefers the worker with the winning per-shape timing (headroom as the
+tie-break), falling back to pure rendezvous order when nobody has
+data.  ``advertise_host`` separates the bind address from the address
+spawned/external workers dial — the multi-host deploy seam
+(SERVING.md §Deploy).
+
 Concurrency discipline (gravelock): all threads named via
 :mod:`rca_tpu.util.threads`; ``FederationPlane._lock`` guards the
 worker table, ring, and pending map and is never held across a socket
@@ -67,6 +83,7 @@ from rca_tpu.config import (
     fed_workers,
 )
 from rca_tpu.observability.spans import default_tracer
+from rca_tpu.serve.autoscale import PLACEMENT_RULES, shape_tier_ms
 from rca_tpu.serve.fedwire import (
     FrameConn,
     FrameError,
@@ -257,6 +274,35 @@ def graph_route_key(graph_key: Tuple) -> str:
 # ---------------------------------------------------------------------------
 
 
+def _parse_shape_summary(registry: Any) -> Dict[int, float]:
+    """A hello frame's ``registry`` summary → ``{n_pad: winner_ms}``.
+    Hellos from older workers (or fakes) omit it; malformed entries are
+    dropped, never fatal — placement is an optimization, not a
+    dependency."""
+    out: Dict[int, float] = {}
+    if not isinstance(registry, dict):
+        return out
+    for n_pad, t_ms in registry.items():
+        try:
+            key, val = int(n_pad), float(t_ms)
+        except (TypeError, ValueError):
+            continue
+        if key > 0 and val >= 0.0:
+            out[key] = val
+    return out
+
+
+def _parse_headroom(headroom: Any) -> Optional[int]:
+    """A hello frame's ``headroom`` summary → device ``bytes_in_use``
+    (LOWER = more headroom), or None when absent/malformed."""
+    if not isinstance(headroom, dict):
+        return None
+    try:
+        return int(headroom.get("bytes_in_use"))
+    except (TypeError, ValueError):
+        return None
+
+
 class _WorkerHandle:
     """Coordinator-side state for one worker (connection + lease +
     outstanding accounting).  Mutated only under FederationPlane._lock
@@ -274,12 +320,21 @@ class _WorkerHandle:
         self.partition_dropped = 0
         self.served = 0
         self.state = "connecting"
+        # elasticmesh: scale-down + placement state.  ``draining`` marks
+        # an intentional retirement in progress (the worker is off the
+        # ring, not routable, and its eventual EOF is NOT a fault);
+        # ``shape_ms``/``mem_bytes`` are the hello frame's registry and
+        # headroom summaries the placement rules read.
+        self.draining = False
+        self.shape_ms: Dict[int, float] = {}     # n_pad -> winner ms
+        self.mem_bytes: Optional[int] = None
 
     def summary(self) -> Dict[str, Any]:
         return {
             "worker_id": self.worker_id,
             "state": self.state,
             "live": self.live,
+            "draining": self.draining,
             "outstanding": self.outstanding,
             "served": self.served,
             "pid": self.hello.get("pid"),
@@ -287,6 +342,8 @@ class _WorkerHandle:
             "lease_renewals": (
                 self.lease.renewals if self.lease is not None else 0
             ),
+            "shapes_known": len(self.shape_ms),
+            "mem_bytes": self.mem_bytes,
         }
 
 
@@ -335,6 +392,7 @@ class FederationPlane:
         store=None,
         tracer=None,
         steal: Optional[bool] = None,
+        advertise_host: Optional[str] = None,
     ):
         self.config = config or ServeConfig.from_env()
         self.clock = clock
@@ -377,11 +435,18 @@ class FederationPlane:
         sock = make_server_socket("federation", host, port)
         self.host, self.port = bound_address(sock)
         self._server_sock = sock
+        # multi-host (ISSUE 16): the address workers DIAL may differ
+        # from the bind address (bind 0.0.0.0, advertise the host's
+        # reachable IP); the attached autoscale controller registers
+        # itself here so /healthz can report the elastic state
+        self.advertise_host = advertise_host
+        self.autoscaler = None
 
     # -- introspection --------------------------------------------------------
     @property
     def address(self) -> str:
-        return f"{self.host}:{self.port}"
+        host = self.advertise_host if self.advertise_host else self.host
+        return f"{host}:{self.port}"
 
     def live_workers(self) -> List[int]:
         with self._lock:
@@ -578,6 +643,86 @@ class FederationPlane:
         self._event("partition_start", worker_id, for_s=float(for_s))
         return True
 
+    # -- elastic scale-down (drain-and-retire, ISSUE 16) ----------------------
+    def drain_worker(self, worker_id: int) -> bool:
+        """Begin one worker's intentional retirement: off the ring first
+        (no new routes), then a ``drain`` frame — the worker finishes
+        its in-flight work, answers ``drained``, and
+        :meth:`_scaledown_complete` retires it.  False when the worker
+        is not live (or already draining)."""
+        with self._lock:
+            w = self.workers.get(int(worker_id))
+            if w is None or not w.live or w.draining or w.conn is None:
+                return False
+            w.draining = True
+            w.state = "draining"
+            self.ring.remove(worker_id)
+            conn = w.conn
+        self._event("drain_started", worker_id)
+        if not conn.send({"t": "drain"}):
+            # died before the frame landed: the conn loop's EOF path
+            # handles it as a fault; nothing to retire politely here
+            return True
+        return True
+
+    def _scaledown_complete(self, w: _WorkerHandle) -> None:
+        """Finish one intentional retirement (the ``drained`` ack).
+        ``live`` drops FIRST, so the socket EOF (and the monitor's
+        dead-proc sweep) that follow hit :meth:`_worker_down`'s
+        not-live early-return — a scale-down must never be counted as a
+        ``process_kill``.  Anything still pending on the worker (a race
+        with the router) reroutes through overflow."""
+        with self._lock:
+            if not w.live:
+                return
+            w.live = False
+            w.draining = False
+            w.state = "drained"
+            self.ring.remove(w.worker_id)
+            reclaimed = [
+                p for p in self._pending.values()
+                if p.worker_id == w.worker_id
+            ]
+            for p in reclaimed:
+                del self._pending[p.req.request_id]
+            w.outstanding = 0
+            proc = w.proc
+            for p in reclaimed:
+                p.moves += 1
+                self.reroutes += 1
+                self._overflow.append(p.req)
+        self.leases.revoke(w.worker_id)
+        self._event("worker_scaled_down", w.worker_id,
+                    rerouted=len(reclaimed))
+        if proc is not None:
+            proc.terminate(grace_s=3.0)
+        self.queue.kick()
+
+    def scale_status(self) -> Dict[str, Any]:
+        """The autoscale controller's view of the fleet in one lock
+        acquisition: routable workers, retirements in progress, the
+        per-worker outstanding map (the scale-down victim policy), and
+        the next NEVER-REUSED worker id (reusing a retired id would
+        alias its late, stale responses onto a fresh worker)."""
+        with self._lock:
+            live = sorted(
+                w.worker_id for w in self.workers.values()
+                if w.live and not w.draining
+            )
+            draining = sorted(
+                w.worker_id for w in self.workers.values()
+                if w.live and w.draining
+            )
+            outstanding = {
+                w.worker_id: w.outstanding
+                for w in self.workers.values() if w.live
+            }
+            next_id = max(self.workers) + 1 if self.workers else 0
+        return {
+            "live": live, "draining": draining,
+            "outstanding": outstanding, "next_id": next_id,
+        }
+
     # -- connection handling --------------------------------------------------
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -628,9 +773,22 @@ class FederationPlane:
             w.lease = lease
             w.hello = dict(hello)
             w.live = True
-            w.state = "live"
             w.partitioned_until = 0.0
-            self.ring.add(worker_id)
+            # a hello on a NEW connection is a new process: any drain
+            # sent to the old one died with its socket, so this worker
+            # is volunteering back in.  On the SAME connection (a hung/
+            # partitioned worker re-helloing past a stale lease) a
+            # drain already sent is still in that process's inbox —
+            # KEEP the draining intent, or a stale-heartbeat re-hello
+            # racing a scale-down wipes it and the retirement never
+            # completes (the scaling_storm rejoin-vs-drain race)
+            if old_conn is not None:
+                w.draining = False
+            w.state = "draining" if w.draining else "live"
+            w.shape_ms = _parse_shape_summary(hello.get("registry"))
+            w.mem_bytes = _parse_headroom(hello.get("headroom"))
+            if not w.draining:
+                self.ring.add(worker_id)
         if old_conn is not None:
             old_conn.close()
         self._event("rejoin" if rejoin else "worker_joined", worker_id,
@@ -678,7 +836,15 @@ class FederationPlane:
             elif t == "resp" and handle is not None:
                 self._on_response(handle, msg)
             elif t == "drained" and handle is not None:
-                self._event("worker_drained", handle.worker_id)
+                self._event("worker_drained", handle.worker_id,
+                            served=msg.get("served"))
+                with self._lock:
+                    draining = handle.draining
+                if draining:
+                    # intentional retirement (scale-down): complete it
+                    # BEFORE the socket drops so the EOF below is a
+                    # no-op, never a process_kill
+                    self._scaledown_complete(handle)
         if handle is not None:
             self._worker_down(handle.worker_id, eof=True)
 
@@ -786,14 +952,46 @@ class FederationPlane:
         """Ring owner first; spill down the preference order past the
         outstanding window.  None while nothing live has room (the
         router parks) — and None with NOTHING live at all (the ladder
-        answers).  Called under the plane lock."""
+        answers).  Called under the plane lock.
+
+        Shape-aware placement (ISSUE 16): for graph buckets the
+        ``PLACEMENT_RULES`` table marks as informed-routable, the pick
+        prefers the candidate whose hello'd registry summary shows the
+        winning timing at this request's shape tier (device
+        ``bytes_in_use`` breaks ties toward headroom, ring order breaks
+        the rest — the scoring is deterministic, so a hot bucket stays
+        STICKY to its preferred worker).  No candidate with data, or a
+        bucket the table leaves alone → pure rendezvous order.  The
+        metrics lock is a documented leaf under the plane lock."""
         key = graph_route_key(req.graph_key)
+        candidates = []
         for wid in self.ring.ranked(key):
             w = self.workers.get(wid)
-            if (w is not None and w.live and w.conn is not None
+            if (w is not None and w.live and not w.draining
+                    and w.conn is not None
                     and w.outstanding < self.window):
-                return w
-        return None
+                candidates.append(w)
+        if not candidates:
+            return None
+        rule = PLACEMENT_RULES.rule_for(int(req.graph_key[0]))
+        if "timings" in rule.prefer and len(candidates) > 1:
+            scored = []
+            for pos, w in enumerate(candidates):
+                t_ms = shape_tier_ms(w.shape_ms, int(req.graph_key[0]))
+                if t_ms is None:
+                    continue
+                mem = (
+                    w.mem_bytes
+                    if ("headroom" in rule.prefer
+                        and w.mem_bytes is not None)
+                    else float("inf")
+                )
+                scored.append((t_ms, mem, pos, w))
+            if scored:
+                self.metrics.placement("preferred")
+                return min(scored)[3]
+        self.metrics.placement("rendezvous")
+        return candidates[0]
 
     def _route_one(self, req: ServeRequest, now: float) -> bool:
         """Place one popped request.  True when it reached a worker (or
@@ -900,18 +1098,26 @@ class FederationPlane:
             for wid in gone:
                 self._worker_down(wid, eof=True)
 
-    # -- health (gateway /healthz) --------------------------------------------
+    # -- health (gateway /healthz, `rca fleet`) -------------------------------
     def health(self) -> Dict[str, Any]:
         with self._lock:
             states = {
                 str(w.worker_id): w.state for w in self.workers.values()
             }
             ok = any(w.live for w in self.workers.values())
-        return {
+            fleet = [
+                self.workers[wid].summary() for wid in sorted(self.workers)
+            ]
+        out = {
             "ok": bool(ok), "workers": states,
             "queue_depth": len(self.queue),
             "pending": self.pending_count(),
+            "fleet": fleet,
         }
+        auto = self.autoscaler
+        if auto is not None:
+            out["autoscale"] = auto.status()
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -930,6 +1136,7 @@ def federation_selftest(
     heartbeat_s: float = 0.15,
     timeout_s: float = 180.0,
     ready_timeout_s: float = 90.0,
+    bind_external: bool = False,
 ) -> Dict[str, Any]:
     """End-to-end federation contract check, the cross-process twin of
     :func:`rca_tpu.serve.client.serve_selftest`:
@@ -943,7 +1150,12 @@ def federation_selftest(
     - POOL-vs-FEDERATION bit parity: every ok ranking must equal a solo
       single-process analysis of the same request, bit for bit — the
       wire codec's float32→JSON→float32 identity plus the serve
-      coalesced-vs-solo contract, now across process boundaries.
+      coalesced-vs-solo contract, now across process boundaries;
+    - ``bind_external``: the multi-host deploy leg (ISSUE 16) — the
+      coordinator binds ``0.0.0.0`` and advertises the host's primary
+      interface IP, so every worker joins via a REAL non-loopback
+      ``--connect host:port`` exactly as an external host would
+      (SERVING.md §Deploy).
     """
     import threading as _threading   # Event only (signal, not a lock)
 
@@ -976,8 +1188,16 @@ def federation_selftest(
             "deadline_expired": i % 11 == 10,
         })
 
+    plane_kwargs: Dict[str, Any] = {}
+    if bind_external:
+        from rca_tpu.util.net import primary_host_ip
+
+        plane_kwargs.update(
+            host="0.0.0.0", advertise_host=primary_host_ip(),
+        )
     plane = FederationPlane(
         workers=workers, config=config, heartbeat_s=heartbeat_s,
+        **plane_kwargs,
     )
     requests: List[Optional[ServeRequest]] = [None] * n_requests
     kill_at: Dict[str, Any] = {"t": None, "worker": None}
@@ -1115,6 +1335,12 @@ def federation_selftest(
         "requests": n_requests,
         "kill_worker": bool(kill_worker),
         "startup_s": round(startup_s, 3),
+        **({
+            "bind_external": {
+                "listen": "0.0.0.0",
+                "advertised": plane.address,
+            },
+        } if bind_external else {}),
         "by_status": by_status,
         "expected_shed_min": expected_shed,
         "all_resolved": bool(all_resolved),

@@ -18,7 +18,14 @@ frame          direction  meaning
 ``hello``      w → c      worker introduces itself (worker_id, pid, engine,
                           distributed-bootstrap info; optional lease_id
                           when re-joining — a STALE lease is rejected and
-                          the worker must re-hello fresh)
+                          the worker must re-hello fresh after a seeded
+                          jittered backoff.  ISSUE 16 adds two OPTIONAL
+                          placement-evidence fields: ``registry`` — the
+                          kernel registry's winning per-shape timings,
+                          ``{n_pad: winner_ms}`` — and ``headroom`` —
+                          kernelscope's ``{"bytes_in_use": N}``.  Absent
+                          fields mean 'no evidence': the worker places
+                          by pure rendezvous)
 ``lease``      c → w      lease grant: lease_id + ttl_s + heartbeat_s
 ``reject``     c → w      hello/heartbeat refused (stale_lease, bad_proto)
 ``hb``         w → c      heartbeat (renews the lease)
@@ -28,8 +35,13 @@ frame          direction  meaning
 ``hang``       c → w      CHAOS: stop heartbeating for ``for_s`` seconds
                           (the socket stays open — ``worker_hang``)
 ``drain``      c → w      stop accepting, finish in flight, answer
-                          ``drained``, exit
-``drained``    w → c      drain complete
+                          ``drained``, exit (fleet stop AND autoscale
+                          scale-down both retire workers with this — the
+                          coordinator's ``draining`` flag on the handle
+                          distinguishes the two when ``drained`` lands)
+``drained``    w → c      drain complete (carries ``served`` — the
+                          worker's lifetime answer count, reported in
+                          the scale-down event)
 =============  =========  =================================================
 
 The codec refuses frames over :data:`MAX_FRAME` loudly — an unbounded
@@ -73,6 +85,11 @@ class FrameConn:
         self.sock = sock
         self.name = name
         self._wlock = make_lock("FrameConn._wlock")
+        # one reader thread per connection by construction, but the
+        # reader differs by deployment (coordinator conn loop, worker
+        # main, thread-mode fleet members) — the lock makes the buffer
+        # read-modify-write atomic whichever thread owns the read side
+        self._rlock = make_lock("FrameConn._rlock")
         self._rbuf = b""
         self.closed = False
 
@@ -113,16 +130,17 @@ class FrameConn:
 
     def recv(self) -> Optional[Dict[str, Any]]:
         """The next message, or None when the peer is gone."""
-        head = self._read_exact(_LEN.size)
-        if head is None:
-            return None
-        (length,) = _LEN.unpack(head)
-        if length > MAX_FRAME:
-            raise FrameError(
-                f"{self.name}: inbound frame claims {length} B "
-                f"(cap {MAX_FRAME} B) — poisoned stream"
-            )
-        payload = self._read_exact(length)
+        with self._rlock:
+            head = self._read_exact(_LEN.size)
+            if head is None:
+                return None
+            (length,) = _LEN.unpack(head)
+            if length > MAX_FRAME:
+                raise FrameError(
+                    f"{self.name}: inbound frame claims {length} B "
+                    f"(cap {MAX_FRAME} B) — poisoned stream"
+                )
+            payload = self._read_exact(length)
         if payload is None:
             return None
         try:

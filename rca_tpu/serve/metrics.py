@@ -11,6 +11,7 @@ submit path and the serve worker record concurrently.
 
 from __future__ import annotations
 
+import collections
 from typing import Dict, List, Optional
 
 from rca_tpu.config import slo_ms
@@ -20,6 +21,17 @@ from rca_tpu.util.threads import make_lock
 
 _COUNTER_KEYS = (
     "submitted", "answered", "shed", "rejected", "degraded", "errors",
+)
+
+#: recent time-in-queue samples kept for the autoscaler's WINDOWED p99
+#: (ISSUE 16).  PhaseStats quantiles are all-time — after one surge the
+#: all-time p99 never falls again, so a scale-DOWN signal fed by it
+#: could never fire; the controller reads this bounded window instead.
+_RECENT_QUEUE_CAP = 512
+
+_SCALE_EVENTS = (
+    "scale_ups", "scale_downs", "holds", "cooldown_skips", "clamps",
+    "forced",
 )
 
 
@@ -56,6 +68,15 @@ class ServeMetrics:
         # prints these and bench's serve_pool section reads them
         self._replicas: Dict[int, Dict[str, object]] = {}
         self._replica_occ = PhaseStats()   # one phase per replica id
+        # elasticmesh (ISSUE 16): the autoscaler's windowed queue-time
+        # signal, its action counters, and shape-aware placement
+        # outcomes (preferred = a registry/headroom-informed pick,
+        # rendezvous = the hash-ring fallback)
+        self._recent_queue_ms: "collections.deque[float]" = (
+            collections.deque(maxlen=_RECENT_QUEUE_CAP)
+        )
+        self._scale_events: Dict[str, int] = {k: 0 for k in _SCALE_EVENTS}
+        self._placement: Dict[str, int] = {"preferred": 0, "rendezvous": 0}
 
     def _tenant(self, tenant: str) -> Dict[str, int]:
         return self._counts.setdefault(
@@ -72,6 +93,7 @@ class ServeMetrics:
         with self._lock:
             self._tenant(tenant)["answered"] += 1
             self._queue_ms.record(tenant, queue_ms)
+            self._recent_queue_ms.append(float(queue_ms))
 
     def shed(self, tenant: str) -> None:
         with self._lock:
@@ -170,6 +192,39 @@ class ServeMetrics:
             rec["delta_requests"] += 1
             rec["rows_saved"] += int(rows_saved)
 
+    # -- elasticmesh (ISSUE 16) ----------------------------------------------
+    def scale_event(self, kind: str) -> None:
+        """One autoscaler outcome: ``scale_ups``/``scale_downs``/
+        ``holds``/``cooldown_skips``/``clamps``/``forced``."""
+        with self._lock:
+            self._scale_events[kind] += 1
+
+    def placement(self, outcome: str) -> None:
+        """One routing pick: ``preferred`` (registry/headroom-informed)
+        or ``rendezvous`` (the hash-ring fallback)."""
+        with self._lock:
+            self._placement[outcome] += 1
+
+    def autoscale_signals(self) -> Dict[str, object]:
+        """The controller's metric-side inputs in one lock acquisition:
+        the WINDOWED cross-tenant queue-time p99 (last
+        ``_RECENT_QUEUE_CAP`` completions — all-time quantiles can never
+        fall after a surge, see ``_RECENT_QUEUE_CAP``) and the running
+        SLO-breach total (the controller differentiates it into a burn
+        rate between sweeps)."""
+        with self._lock:
+            recent = sorted(self._recent_queue_ms)
+            breaches = sum(self._slo_breaches.values())
+        p99 = (
+            recent[min(len(recent) - 1, int(len(recent) * 0.99))]
+            if recent else None
+        )
+        return {
+            "queue_ms_p99_recent": p99,
+            "recent_samples": len(recent),
+            "slo_breach_total": breaches,
+        }
+
     # -- reporting -----------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
         """One CONSISTENT deep copy of all raw state, taken under the
@@ -204,6 +259,8 @@ class ServeMetrics:
                 },
                 "slo_breaches": dict(self._slo_breaches),
                 "slo_ms": self.slo_ms_target,
+                "scale_events": dict(self._scale_events),
+                "placement": dict(self._placement),
             }
 
     def summary(self) -> Dict[str, object]:
@@ -265,4 +322,25 @@ class ServeMetrics:
             "rejected_total": sum(
                 c["rejected"] for c in counts.values()
             ),
+            **self._autoscale_summary(snap),
+        }
+
+    @staticmethod
+    def _autoscale_summary(snap: Dict[str, object]) -> Dict[str, object]:
+        """Autoscale + placement block, only when anything happened —
+        a plain ServeLoop's summary stays byte-identical to PR 15."""
+        events: Dict[str, int] = snap["scale_events"]   # type: ignore
+        placement: Dict[str, int] = snap["placement"]   # type: ignore
+        picks = sum(placement.values())
+        if not any(events.values()) and picks == 0:
+            return {}
+        return {
+            "autoscale": dict(events),
+            "placement": {
+                **placement,
+                "hit_rate": (
+                    round(placement["preferred"] / picks, 4)
+                    if picks else None
+                ),
+            },
         }

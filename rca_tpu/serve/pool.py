@@ -205,6 +205,23 @@ class ServePool:
     def device_batches(self) -> int:
         return sum(r.device_batches for r in self.replicas)
 
+    def occupancy(self) -> float:
+        """Fraction of the pool's staging capacity in use (replica
+        occupancy counts over the per-replica stage-ahead cap) — the
+        SAME load signal the elasticmesh autoscaler reads off a
+        federation (ISSUE 16), exported here so /healthz shows it for
+        pools too (pools resize via RCA_SERVE_REPLICAS, but the
+        operator's dial is one signal)."""
+        from rca_tpu.serve.replica import STAGE_AHEAD_BATCHES
+
+        live = [r for r in self.replicas if r.alive()]
+        if not live:
+            return 1.0
+        cap = max(
+            1, self.config.max_batch * STAGE_AHEAD_BATCHES * len(live)
+        )
+        return min(1.0, sum(r.occupancy() for r in live) / cap)
+
     # -- admission (same contract as ServeLoop.submit) -----------------------
     def submit(self, req: ServeRequest) -> bool:
         """Admit one request; either way the request WILL be completed

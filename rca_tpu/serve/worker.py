@@ -38,9 +38,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import sys
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, List, Optional
 
 from rca_tpu.serve.fedwire import (
     FrameConn,
@@ -59,6 +60,52 @@ from rca_tpu.util.threads import make_lock, spawn
 #: accumulating parked waiter threads forever)
 REQUEST_TIMEOUT_S = 120.0
 
+#: seeded jittered backoff for the re-hello loop (ISSUE 16 small fix):
+#: a healing partition ages out MANY leases at once — without backoff
+#: every survivor re-hellos in the same instant, a rejoin stampede on
+#: the coordinator it just stopped being able to reach
+REJOIN_BACKOFF_BASE_S = 0.05
+REJOIN_BACKOFF_CAP_S = 2.0
+
+
+def _registry_summary() -> Dict[str, float]:
+    """The hello frame's kernel-registry digest: winning per-shape
+    timing (ms) per ``n_pad`` tier, from this process's own
+    :class:`KernelRegistry`.  Empty when nothing is compiled yet or the
+    registry is unavailable — the field is OPTIONAL on the wire and the
+    coordinator treats absence as 'no placement evidence'."""
+    try:
+        from rca_tpu.engine.registry import kernel_table
+
+        out: Dict[str, float] = {}
+        for row in kernel_table():
+            n_pad = int(row.get("n_pad") or 0)
+            timings = row.get("timings_ms") or {}
+            winner = row.get("winner")
+            ms = timings.get(winner) if winner else None
+            if n_pad <= 0 or ms is None:
+                continue
+            key = str(n_pad)
+            if key not in out or float(ms) < out[key]:
+                out[key] = float(ms)
+        return out
+    except Exception:  # noqa: BLE001 - evidence is optional, never fatal
+        return {}
+
+
+def _headroom_summary() -> Optional[Dict[str, int]]:
+    """The hello frame's device-memory digest from the kernelscope
+    accountant — ``bytes_in_use`` lets the coordinator's headroom
+    placement prefer the emptier device.  None when sampling fails
+    (platforms without memory_stats): optional, like the registry."""
+    try:
+        from rca_tpu.observability.kernelscope import sample_device_memory
+
+        mem = sample_device_memory()
+        return {"bytes_in_use": int(mem["bytes_in_use"])}
+    except Exception:  # noqa: BLE001 - evidence is optional, never fatal
+        return None
+
 
 class WorkerAgent:
     """The control-channel client around one local serving plane.
@@ -76,11 +123,21 @@ class WorkerAgent:
         clock: Callable[[], float] = time.monotonic,
         connect_timeout_s: float = 30.0,
         engine_tag: str = "",
+        rejoin_seed: Optional[int] = None,
+        sleeper: Callable[[float], None] = time.sleep,
     ):
         self.worker_id = int(worker_id)
         self.loop = loop
         self.clock = clock
         self.engine_tag = engine_tag
+        # seeded per-worker: every fleet member jitters DIFFERENTLY, so
+        # a mass lease expiry heals as a spread, not a stampede
+        self._rejoin_rng = random.Random(
+            rejoin_seed if rejoin_seed is not None else worker_id
+        )
+        self._rejoin_attempts = 0
+        self.rejoin_delays: List[float] = []
+        self.sleeper = sleeper
         sock = make_client_socket(
             f"fed-worker{worker_id}", host, port,
             timeout_s=connect_timeout_s,
@@ -110,10 +167,33 @@ class WorkerAgent:
             "process_index": boot.get("process_index"),
             "local_devices": boot.get("local_device_count"),
         }
+        # placement evidence (ISSUE 16): OPTIONAL fields — a bare hello
+        # (old workers, fresh processes) still joins, it just gets pure
+        # rendezvous placement
+        registry = _registry_summary()
+        if registry:
+            msg["registry"] = registry
+        headroom = _headroom_summary()
+        if headroom is not None:
+            msg["headroom"] = headroom
         with self._lock:
             if with_lease and self.lease_id is not None:
                 msg["lease_id"] = self.lease_id
         return self.conn.send(msg)
+
+    def _next_rejoin_delay(self) -> float:
+        """Exponential backoff with full-range jitter for the re-hello
+        loop: ``min(cap, base * 2^attempts) * uniform(0.5, 1.5)``.
+        Every call is a DISTINCT delay (the regression test asserts it),
+        and the sequence is seeded — replayable stampede spreading."""
+        raw = min(
+            REJOIN_BACKOFF_CAP_S,
+            REJOIN_BACKOFF_BASE_S * (2.0 ** self._rejoin_attempts),
+        )
+        self._rejoin_attempts += 1
+        delay = raw * (0.5 + self._rejoin_rng.random())
+        self.rejoin_delays.append(delay)
+        return delay
 
     # -- heartbeats -----------------------------------------------------------
     def _hb_loop(self) -> None:
@@ -126,7 +206,12 @@ class WorkerAgent:
             with self._lock:
                 lease, hung = self.lease_id, self.hang_until
                 cadence = self.heartbeat_s
-                if self.draining or self.conn.closed:
+                # draining is NOT an exit: a worker finishing in-flight
+                # work is alive and must keep its lease, or a drain
+                # longer than the TTL reads as worker_hang death and
+                # the retirement never completes (scaling_storm's
+                # rejoin-vs-drain race found this)
+                if self.conn.closed:
                     return
             now = self.clock()
             if (lease is not None and now >= hung
@@ -216,12 +301,16 @@ class WorkerAgent:
                     self.heartbeat_s = float(
                         msg.get("heartbeat_s") or self.heartbeat_s
                     )
+                self._rejoin_attempts = 0   # granted: backoff re-arms
             elif t == "reject":
                 if str(msg.get("reason")) == "stale_lease":
                     # declared dead while hung/partitioned: rejoin with
-                    # an explicit fresh hello (stale lease dropped)
+                    # an explicit fresh hello (stale lease dropped) —
+                    # after a jittered backoff, so a healing partition's
+                    # worth of workers doesn't stampede the coordinator
                     with self._lock:
                         self.lease_id = None
+                    self.sleeper(self._next_rejoin_delay())
                     if not self._hello(with_lease=False):
                         return 3
                 else:

@@ -79,10 +79,15 @@ def make_client_socket(
 
 def make_tls_server_context(
     name: str, certfile: str, keyfile: str,
+    client_ca: Optional[str] = None,
 ) -> ssl.SSLContext:
     """A server-side TLS context over the one seam, so cert loading
     failures are attributable and protocol floors are decided once
-    (TLS 1.2+; everything older is disabled by the default context)."""
+    (TLS 1.2+; everything older is disabled by the default context).
+
+    ``client_ca`` turns on mutual TLS (ISSUE 16): the listener DEMANDS
+    a client certificate at handshake and verifies it against that CA —
+    a client without one is rejected before a byte of HTTP is read."""
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     try:
         ctx.load_cert_chain(certfile=certfile, keyfile=keyfile)
@@ -91,17 +96,28 @@ def make_tls_server_context(
             f"{name}: cannot load TLS cert/key "
             f"({certfile!r}, {keyfile!r}): {exc}"
         ) from exc
+    if client_ca:
+        try:
+            ctx.load_verify_locations(cafile=client_ca)
+        except (OSError, ssl.SSLError) as exc:
+            raise ValueError(
+                f"{name}: cannot load client CA {client_ca!r}: {exc}"
+            ) from exc
+        ctx.verify_mode = ssl.CERT_REQUIRED
     ctx.minimum_version = ssl.TLSVersion.TLSv1_2
     return ctx
 
 
 def make_tls_client_context(
     name: str, ca_file: Optional[str] = None,
+    cert_file: Optional[str] = None, key_file: Optional[str] = None,
 ) -> ssl.SSLContext:
     """Client-side twin: with ``ca_file`` the server cert is VERIFIED
     against it (self-signed deployments pin their own cert); without,
     verification is off — encryption without authentication, loopback
-    test territory only, and the caller had to ask for it by name."""
+    test territory only, and the caller had to ask for it by name.
+    ``cert_file``/``key_file`` present the CLIENT's certificate to an
+    mTLS gateway (``key_file`` defaults to the cert file holding both)."""
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     if ca_file:
         try:
@@ -113,7 +129,31 @@ def make_tls_client_context(
     else:
         ctx.check_hostname = False
         ctx.verify_mode = ssl.CERT_NONE
+    if cert_file:
+        try:
+            ctx.load_cert_chain(certfile=cert_file, keyfile=key_file)
+        except (OSError, ssl.SSLError) as exc:
+            raise ValueError(
+                f"{name}: cannot load client cert/key "
+                f"({cert_file!r}, {key_file!r}): {exc}"
+            ) from exc
     return ctx
+
+
+def primary_host_ip(name: str = "external") -> str:
+    """This host's primary outbound IPv4 address — what an EXTERNAL
+    worker should ``--connect`` to when the coordinator binds 0.0.0.0
+    (ISSUE 16 multi-host deploy).  Uses the classic connected-UDP trick:
+    no packet is sent, the kernel just picks the route's source address.
+    Falls back to loopback on isolated hosts (no route at all)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        sock.connect(("10.255.255.255", 1))
+        return str(sock.getsockname()[0])
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        sock.close()
 
 
 def bound_address(sock: socket.socket) -> Tuple[str, int]:

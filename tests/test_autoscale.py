@@ -1,0 +1,746 @@
+"""elasticmesh: the autoscaling worker fleet (ISSUE 16).
+
+Four layers, cheapest first:
+
+- pure units: SCALE_RULES / PLACEMENT_RULES table validation (a typo'd
+  rule must fail at construction), shape-tier lookup, and the hello
+  placement-evidence parsers;
+- the CONTROLLER on a fake clock against a stub plane: hysteresis
+  (sustain windows), cooldown, min/max clamps, least-loaded victim
+  selection, force semantics, and the breach re-arm after an action;
+- the CONTROL PLANE against in-process fake workers speaking the real
+  wire protocol: shape-aware placement preferring advertised winning
+  timings (headroom tie-breaks), rendezvous fallback, drain-based
+  scale-down retiring a worker as ``worker_scaled_down`` (never
+  ``process_kill``), and worker-id monotonicity;
+- the THREADED 2→8→2 load-ramp soak (real WorkerAgents + ServeLoops
+  over real sockets): all-terminal + exactly-once + bounded windowed
+  p99 through both transitions — the acceptance gate, run in-tree.
+
+The worker's seeded rejoin backoff (the ISSUE 16 small fix) is
+regression-tested at the wire level: a coordinator-side script of
+stale-lease rejects must observe DISTINCT, seeded sleep delays.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+import numpy as np
+import pytest
+
+from rca_tpu.serve.autoscale import (
+    PLACEMENT_RULES,
+    SCALE_RULES,
+    SCALING_FAULT_CLASSES,
+    AutoscaleController,
+    PlacementRule,
+    PlacementRuleSet,
+    ScaleRule,
+    ScaleRuleSet,
+    run_scale_ramp_soak,
+    run_scaling_storm,
+    shape_tier_ms,
+)
+from rca_tpu.serve.federation import (
+    FederationPlane,
+    _parse_headroom,
+    _parse_shape_summary,
+)
+from rca_tpu.serve.fedwire import FrameConn, FrameError, PROTO
+from rca_tpu.serve.request import ServeRequest
+from rca_tpu.util.net import make_client_socket
+from rca_tpu.util.threads import make_lock, spawn
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _req(tenant="t", n=8, seed=0, **kw) -> ServeRequest:
+    rng = np.random.default_rng(seed)
+    feats = rng.random((n, 14), dtype=np.float32)
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    return ServeRequest(
+        tenant=tenant, features=feats, dep_src=src, dep_dst=dst, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rule-table validation (loud at construction)
+# ---------------------------------------------------------------------------
+
+
+def test_default_tables_are_valid():
+    assert len(SCALE_RULES.rules) >= 2
+    assert any(r.action == "up" for r in SCALE_RULES.rules)
+    assert any(r.action == "down" for r in SCALE_RULES.rules)
+    assert PLACEMENT_RULES.rules[-1].min_services == 0
+    assert SCALING_FAULT_CLASSES == ("scaling_storm",)
+
+
+def _rule(**kw) -> ScaleRule:
+    base = dict(name="r", signal="queue_depth", op=">", threshold=1.0,
+                for_s=1.0, action="up", step=1)
+    base.update(kw)
+    return ScaleRule(**base)
+
+
+@pytest.mark.parametrize("bad", [
+    (),                                              # empty
+    (_rule(), _rule(action="down", op="<")),         # duplicate names
+    (_rule(signal="nope"), _rule(name="d", action="down", op="<")),
+    (_rule(op=">="), _rule(name="d", action="down", op="<")),
+    (_rule(action="sideways"), _rule(name="d", action="down", op="<")),
+    (_rule(threshold=-1.0), _rule(name="d", action="down", op="<")),
+    (_rule(for_s=-0.1), _rule(name="d", action="down", op="<")),
+    (_rule(step=0), _rule(name="d", action="down", op="<")),
+    (_rule(),),                                      # no down rule
+    (_rule(action="down", op="<"),),                 # no up rule
+])
+def test_scale_ruleset_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        ScaleRuleSet(rules=bad)
+
+
+def test_scale_ruleset_requires_hysteresis_band():
+    """One signal driving both directions must leave a dead zone, or a
+    steady value fires up and down alternately — the exact flap the
+    table exists to prevent."""
+    with pytest.raises(ValueError, match="hysteresis"):
+        ScaleRuleSet(rules=(
+            _rule(name="up", signal="occupancy", op=">", threshold=0.5),
+            _rule(name="down", signal="occupancy", op="<", threshold=0.5,
+                  action="down"),
+        ))
+    # a proper band is fine
+    ScaleRuleSet(rules=(
+        _rule(name="up", signal="occupancy", op=">", threshold=0.8),
+        _rule(name="down", signal="occupancy", op="<", threshold=0.2,
+              action="down"),
+    ))
+
+
+@pytest.mark.parametrize("bad", [
+    (),                                              # empty
+    (PlacementRule("a", 10), PlacementRule("a", 0)),  # dup names
+    (PlacementRule("a", 10, ("vibes",)), PlacementRule("b", 0)),
+    (PlacementRule("a", 10), PlacementRule("b", 10)),  # not descending
+    (PlacementRule("a", 10),),                       # last not 0
+])
+def test_placement_ruleset_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        PlacementRuleSet(rules=bad)
+
+
+def test_placement_rule_for_first_match_descending():
+    rs = PlacementRuleSet(rules=(
+        PlacementRule("big", 100, ("timings", "headroom")),
+        PlacementRule("mid", 10, ("timings",)),
+        PlacementRule("small", 0),
+    ))
+    assert rs.rule_for(500).name == "big"
+    assert rs.rule_for(100).name == "big"
+    assert rs.rule_for(99).name == "mid"
+    assert rs.rule_for(3).name == "small"
+
+
+def test_shape_tier_ms_covering_then_largest():
+    shapes = {64: 1.5, 256: 9.0}
+    assert shape_tier_ms(shapes, 48) == 1.5     # smallest covering pad
+    assert shape_tier_ms(shapes, 64) == 1.5
+    assert shape_tier_ms(shapes, 100) == 9.0
+    assert shape_tier_ms(shapes, 4096) == 9.0   # undersized: largest
+    assert shape_tier_ms({}, 48) is None
+
+
+def test_hello_evidence_parsers_drop_malformed():
+    assert _parse_shape_summary(
+        {"64": 1.5, "256": "9.0", "bad": 2.0, "-3": 1.0, "0": 1.0,
+         "32": -1.0}
+    ) == {64: 1.5, 256: 9.0}
+    assert _parse_shape_summary(None) == {}
+    assert _parse_shape_summary("garbage") == {}
+    assert _parse_headroom({"bytes_in_use": 1024}) == 1024
+    assert _parse_headroom({"bytes_in_use": "1024"}) == 1024
+    assert _parse_headroom({"bytes_in_use": "lots"}) is None
+    assert _parse_headroom(None) is None
+    assert _parse_headroom({}) is None
+
+
+# ---------------------------------------------------------------------------
+# Controller on a fake clock (stub plane — pure policy)
+# ---------------------------------------------------------------------------
+
+
+class StubMetrics:
+    def __init__(self):
+        self.events = collections.Counter()
+        self.signals = {
+            "queue_ms_p99_recent": None,
+            "recent_samples": 0,
+            "slo_breach_total": 0,
+        }
+        self.placements = collections.Counter()
+
+    def autoscale_signals(self):
+        return dict(self.signals)
+
+    def scale_event(self, kind):
+        self.events[kind] += 1
+
+    def placement(self, outcome):
+        self.placements[outcome] += 1
+
+
+class StubPlane:
+    """Just enough surface for the controller: live-set arithmetic,
+    spawn/drain recording, and the metrics signal block."""
+
+    def __init__(self, clock, live=2, window=4):
+        self.clock = clock
+        self.metrics = StubMetrics()
+        self.queue = []
+        self.window = window
+        self.autoscaler = None
+        self.workers = {i: 0 for i in range(live)}   # wid -> outstanding
+        self.spawned = []
+        self.drained = []
+        self.events = []
+
+    def scale_status(self):
+        live = sorted(self.workers)
+        return {
+            "live": live, "draining": [],
+            "outstanding": dict(self.workers),
+            "next_id": (max(self.workers) + 1) if self.workers else 0,
+        }
+
+    def pending_count(self):
+        return sum(self.workers.values())
+
+    def spawn_worker(self, wid):
+        self.workers[wid] = 0
+        self.spawned.append(wid)
+
+    def drain_worker(self, wid):
+        if wid not in self.workers:
+            return False
+        del self.workers[wid]
+        self.drained.append(wid)
+        return True
+
+    def _event(self, name, wid, **kw):
+        self.events.append({"event": name, "worker_id": wid, **kw})
+
+
+def _controller(plane, **kw):
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 8)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("interval_s", 1.0)
+    return AutoscaleController(plane, **kw)
+
+
+def test_bounds_validation():
+    plane = StubPlane(FakeClock())
+    with pytest.raises(ValueError):
+        AutoscaleController(plane, min_workers=0, max_workers=4)
+    with pytest.raises(ValueError):
+        AutoscaleController(plane, min_workers=5, max_workers=4)
+
+
+def test_sustain_window_gates_the_fire():
+    """A breach must hold continuously for ``for_s`` before the rule
+    fires — one spike never scales (hysteresis half 1)."""
+    clock = FakeClock()
+    plane = StubPlane(clock, live=2)
+    ctl = _controller(plane)             # default SCALE_RULES
+    plane.queue = [0] * 40               # queue_depth 40 > 32 (surge)
+    d = ctl.run_once(now=1000.0)
+    assert d["action"] == "hold"         # breach just started
+    assert plane.spawned == []
+    d = ctl.run_once(now=1004.0)         # 4s < for_s=5
+    assert d["action"] == "hold"
+    d = ctl.run_once(now=1005.0)         # sustained
+    assert d["action"] == "up" and d["rule"] == "surge-depth"
+    assert plane.spawned == [2, 3]       # step 2, ids continue from 2
+    assert plane.metrics.events["scale_ups"] == 1
+
+
+def test_breach_interruption_resets_sustain():
+    clock = FakeClock()
+    plane = StubPlane(clock, live=2)
+    ctl = _controller(plane)
+    plane.queue = [0] * 40
+    ctl.run_once(now=1000.0)
+    plane.queue = []                     # breach clears...
+    ctl.run_once(now=1003.0)
+    plane.queue = [0] * 40               # ...and returns: timer restarts
+    ctl.run_once(now=1004.0)
+    d = ctl.run_once(now=1008.0)         # only 4s since the RE-breach
+    assert d["action"] == "hold"
+    assert plane.spawned == []
+
+
+def test_cooldown_blocks_consecutive_actions():
+    clock = FakeClock()
+    plane = StubPlane(clock, live=2)
+    ctl = _controller(plane, cooldown_s=10.0)
+    plane.queue = [0] * 40
+    ctl.run_once(now=1000.0)
+    assert ctl.run_once(now=1005.0)["action"] == "up"
+    # still breaching, sustained again — but inside the cooldown
+    ctl.run_once(now=1006.0)
+    d = ctl.run_once(now=1012.0)
+    assert d["action"] == "cooldown"
+    assert plane.metrics.events["cooldown_skips"] >= 1
+    assert plane.spawned == [2, 3]       # nothing further spawned
+    # past the cooldown the same sustained breach acts again
+    d = ctl.run_once(now=1016.0)
+    assert d["action"] == "up"
+    assert plane.spawned == [2, 3, 4, 5]
+
+
+def test_max_clamp_holds_the_ceiling():
+    clock = FakeClock()
+    plane = StubPlane(clock, live=4)
+    ctl = _controller(plane, max_workers=4)
+    plane.queue = [0] * 40
+    ctl.run_once(now=1000.0)
+    d = ctl.run_once(now=1005.0)
+    assert d["action"] == "clamped"
+    assert plane.spawned == []
+    assert plane.metrics.events["clamps"] == 1
+
+
+def test_min_clamp_holds_the_floor():
+    clock = FakeClock()
+    plane = StubPlane(clock, live=2)
+    ctl = _controller(plane, min_workers=2)
+    # occupancy 0 < 0.10 — the idle-occupancy down rule (for_s 30)
+    ctl.run_once(now=1000.0)
+    d = ctl.run_once(now=1030.0)
+    assert d["action"] == "clamped"
+    assert plane.drained == []
+
+
+def test_scale_down_picks_least_loaded_newest_first():
+    clock = FakeClock()
+    plane = StubPlane(clock, live=3)
+    plane.workers = {0: 5, 1: 0, 2: 0}
+    ctl = _controller(plane, min_workers=1)
+    ctl.run_once(now=1000.0)             # occupancy 5/12 is not < 0.10?
+    # occupancy = pending / (live * window) = 5/12 ≈ 0.42 — no breach;
+    # empty the fleet so the idle rule breaches
+    plane.workers = {0: 0, 1: 0, 2: 0}
+    ctl.run_once(now=1001.0)
+    d = ctl.run_once(now=1031.0)
+    assert d["action"] == "down"
+    # tie on outstanding → NEWEST id drains first (hot residency stays)
+    assert plane.drained == [2]
+    assert plane.metrics.events["scale_downs"] == 1
+
+
+def test_action_rearms_every_sustain_window():
+    """After any action the breach history is cleared: the fleet just
+    changed, old evidence describes a dead topology."""
+    clock = FakeClock()
+    plane = StubPlane(clock, live=2)
+    ctl = _controller(plane, cooldown_s=0.5)
+    plane.queue = [0] * 40
+    ctl.run_once(now=1000.0)
+    assert ctl.run_once(now=1005.0)["action"] == "up"
+    # past cooldown but the sustain clock restarted at the action
+    d = ctl.run_once(now=1006.0)
+    assert d["action"] == "hold"
+    d = ctl.run_once(now=1011.1)
+    assert d["action"] == "up"
+
+
+def test_force_bypasses_sustain_and_cooldown_not_clamps():
+    clock = FakeClock()
+    plane = StubPlane(clock, live=2)
+    ctl = _controller(plane, max_workers=3, cooldown_s=1000.0)
+    d = ctl.force("up", step=5, rule="chaos")
+    assert d["forced"] and d["action"] == "up"
+    assert plane.spawned == [2]          # clamped to max=3
+    d = ctl.force("down", victims=[0], rule="chaos")
+    assert plane.drained == [0]
+    assert plane.metrics.events["forced"] == 2
+    with pytest.raises(ValueError):
+        ctl.force("sideways")
+
+
+def test_ensure_min_spawns_up_to_floor():
+    clock = FakeClock()
+    plane = StubPlane(clock, live=0)
+    plane.workers = {}
+    ctl = _controller(plane, min_workers=3)
+    assert ctl.ensure_min() == [0, 1, 2]
+    assert sorted(plane.workers) == [0, 1, 2]
+    assert ctl.ensure_min() == []        # already at floor
+
+
+def test_status_and_decision_log():
+    clock = FakeClock()
+    plane = StubPlane(clock, live=2)
+    ctl = _controller(plane, min_workers=1, max_workers=8)
+    st = ctl.status()
+    assert st["min"] == 1 and st["max"] == 8
+    assert st["running"] is False and st["last_decision"] is None
+    ctl.force("up", rule="probe")
+    st = ctl.status()
+    assert st["decisions"] == 1
+    assert st["last_decision"]["rule"] == "probe"
+    assert plane.autoscaler is ctl       # registered for health()
+
+
+def test_config_scale_knobs(monkeypatch):
+    from rca_tpu.config import (
+        fed_scale_cooldown_s,
+        fed_scale_max,
+        fed_scale_min,
+    )
+
+    monkeypatch.setenv("RCA_FED_SCALE_MIN", "3")
+    monkeypatch.setenv("RCA_FED_SCALE_MAX", "12")
+    monkeypatch.setenv("RCA_FED_SCALE_COOLDOWN_S", "2.5")
+    assert fed_scale_min() == 3
+    assert fed_scale_max() == 12
+    assert fed_scale_cooldown_s() == 2.5
+
+
+# ---------------------------------------------------------------------------
+# Placement + drain scale-down vs FAKE workers (real wire protocol)
+# ---------------------------------------------------------------------------
+
+
+class FakeWorker:
+    """In-process worker over a loopback socket; ``registry`` /
+    ``headroom`` ride the hello as placement evidence."""
+
+    def __init__(self, worker_id, plane, registry=None, headroom=None,
+                 heartbeat_s=0.05):
+        self.worker_id = worker_id
+        self.heartbeat_s = heartbeat_s
+        self.lease_id = None
+        self.served = 0
+        self.drain_seen = 0
+        self._lock = make_lock("FakeWorker._lock")
+        sock = make_client_socket(
+            f"fake{worker_id}", plane.host, plane.port
+        )
+        self.conn = FrameConn(sock, name=f"fake{worker_id}")
+        hello = {
+            "t": "hello", "proto": PROTO, "worker_id": worker_id,
+            "pid": 0, "engine": "fake",
+        }
+        if registry is not None:
+            hello["registry"] = registry
+        if headroom is not None:
+            hello["headroom"] = headroom
+        self.conn.send(hello)
+        self._reader = spawn(
+            self._read_loop, name=f"fake{worker_id}-read", daemon=True,
+        )
+        self._hb = spawn(
+            self._hb_loop, name=f"fake{worker_id}-hb", daemon=True,
+        )
+
+    def _read_loop(self):
+        while True:
+            try:
+                msg = self.conn.recv()
+            except (FrameError, OSError):
+                return
+            if msg is None:
+                return
+            t = msg.get("t")
+            if t == "lease":
+                with self._lock:
+                    self.lease_id = msg["lease_id"]
+            elif t == "req":
+                self.conn.send({
+                    "t": "resp", "request_id": msg["request_id"],
+                    "status": "ok",
+                    "ranked": [{"component": f"svc-{self.worker_id}",
+                                "score": 1.0}],
+                    "batch_size": 1, "engine": "fake",
+                })
+                self.served += 1
+            elif t == "drain":
+                with self._lock:
+                    self.drain_seen += 1
+                self.conn.send({"t": "drained", "served": self.served})
+
+    def _hb_loop(self):
+        seq = 0
+        while not self.conn.closed:
+            time.sleep(self.heartbeat_s)
+            with self._lock:
+                lease = self.lease_id
+            if lease is None:
+                continue
+            seq += 1
+            if not self.conn.send({
+                "t": "hb", "worker_id": self.worker_id,
+                "lease_id": lease, "seq": seq,
+            }):
+                return
+
+    def close(self):
+        self.conn.close()
+
+
+def _plane(**kw):
+    kw.setdefault("heartbeat_s", 0.05)
+    kw.setdefault("lease_misses", 3)
+    plane = FederationPlane(workers=1, spawn_workers=False, **kw)
+    plane.start()
+    return plane
+
+
+def _wait(pred, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_placement_prefers_winning_timings_and_headroom():
+    """A mid-bucket request routes to the worker whose hello advertises
+    the winning timing at its shape tier — deterministically, so the
+    bucket stays sticky; headroom breaks a timing tie."""
+    plane = _plane()
+    fakes = [
+        FakeWorker(0, plane),                                # no evidence
+        FakeWorker(1, plane, registry={"64": 5.0},
+                   headroom={"bytes_in_use": 10}),
+        FakeWorker(2, plane, registry={"64": 2.0},
+                   headroom={"bytes_in_use": 100}),
+    ]
+    try:
+        assert plane.wait_ready(3, timeout_s=10.0)
+        reqs = [_req(n=48, seed=i) for i in range(4)]
+        for r in reqs:
+            plane.submit(r)
+        rs = [r.result(10.0) for r in reqs]
+        assert all(r.status == "ok" for r in rs)
+        # n=48 hits the mid-graphs bucket (timings): worker 2 wins
+        assert {r.ranked[0]["component"] for r in rs} == {"svc-2"}
+        snap = plane.metrics.snapshot()
+        assert snap["placement"]["preferred"] >= 4
+        # timing tie in the BIG bucket (headroom evidence enabled) →
+        # smaller bytes_in_use (more headroom) wins
+        with plane._lock:
+            plane.workers[2].shape_ms = {64: 5.0}
+        tied = [_req(n=200, seed=9) for _ in range(3)]
+        for r in tied:
+            plane.submit(r)
+        out = [r.result(10.0) for r in tied]
+        assert {r.ranked[0]["component"] for r in out} == {"svc-1"}
+    finally:
+        for f in fakes:
+            f.close()
+        plane.stop()
+
+
+def test_placement_falls_back_to_rendezvous():
+    """No evidence anywhere (and small-bucket requests regardless) →
+    pure rendezvous, counted as such."""
+    plane = _plane()
+    fakes = [FakeWorker(i, plane) for i in range(3)]
+    try:
+        assert plane.wait_ready(3, timeout_s=10.0)
+        reqs = [_req(n=48, seed=3) for _ in range(4)]   # ONE graph
+        for r in reqs:
+            plane.submit(r)
+        rs = [r.result(10.0) for r in reqs]
+        assert all(r.status == "ok" for r in rs)
+        assert len({r.ranked[0]["component"] for r in rs}) == 1  # sticky
+        snap = plane.metrics.snapshot()
+        assert snap["placement"]["rendezvous"] >= 4
+        assert snap["placement"]["preferred"] == 0
+        # small graphs never consult evidence, even when present
+        with plane._lock:
+            plane.workers[0].shape_ms = {64: 0.1}
+        small = _req(n=8, seed=5)
+        plane.submit(small)
+        assert small.result(10.0).status == "ok"
+        assert plane.metrics.snapshot()["placement"]["preferred"] == 0
+    finally:
+        for f in fakes:
+            f.close()
+        plane.stop()
+
+
+def test_drain_scale_down_is_never_process_kill():
+    """drain_worker retires a member through drain-and-reroute: the
+    worker answers ``drained``, the handle completes as
+    ``worker_scaled_down``, and the socket closing afterwards must NOT
+    read as a ``process_kill`` death."""
+    plane = _plane()
+    fakes = [FakeWorker(i, plane) for i in range(2)]
+    try:
+        assert plane.wait_ready(2, timeout_s=10.0)
+        assert plane.drain_worker(0) is True
+        assert _wait(lambda: any(
+            e["event"] == "worker_scaled_down" and e["worker_id"] == 0
+            for e in list(plane.events)
+        ))
+        assert plane.drain_worker(0) is False    # already retired
+        assert plane.drain_worker(99) is False   # unknown
+        fakes[0].close()                         # EOF after retirement
+        time.sleep(0.2)
+        downs = [e for e in list(plane.events)
+                 if e["event"] == "worker_down" and e["worker_id"] == 0]
+        assert downs == []                       # retirement, not death
+        status = plane.scale_status()
+        assert status["live"] == [1]
+        assert status["next_id"] == 2            # ids never reused
+        # the survivor still serves
+        r = _req(seed=1)
+        plane.submit(r)
+        assert r.result(10.0).status == "ok"
+    finally:
+        for f in fakes:
+            f.close()
+        plane.stop()
+
+
+def test_health_carries_fleet_and_autoscale():
+    plane = _plane()
+    fakes = [FakeWorker(0, plane, registry={"64": 1.0})]
+    ctl = AutoscaleController(plane, min_workers=1, max_workers=4)
+    try:
+        assert plane.wait_ready(1, timeout_s=10.0)
+        h = plane.health()
+        assert [w["worker_id"] for w in h["fleet"]] == [0]
+        assert h["fleet"][0]["shapes_known"] == 1
+        assert h["fleet"][0]["draining"] is False
+        assert h["autoscale"]["min"] == 1
+        assert h["autoscale"]["max"] == 4
+    finally:
+        ctl.stop()
+        for f in fakes:
+            f.close()
+        plane.stop()
+
+
+# ---------------------------------------------------------------------------
+# Worker rejoin backoff (the ISSUE 16 small fix) — wire-level regression
+# ---------------------------------------------------------------------------
+
+
+def test_rejoin_backoff_distinct_seeded_delays():
+    """A stale-lease reject storm must produce DISTINCT, growing,
+    seeded sleep delays before each re-hello — not an immediate-retry
+    stampede."""
+    from rca_tpu.serve.worker import (
+        REJOIN_BACKOFF_BASE_S,
+        REJOIN_BACKOFF_CAP_S,
+        WorkerAgent,
+    )
+    from rca_tpu.util.net import bound_address, make_server_socket
+
+    srv = make_server_socket("backoff-test", "127.0.0.1", 0)
+    host, port = bound_address(srv)
+    frames = []
+
+    class DummyLoop:
+        def submit(self, req):
+            pass
+
+    def coordinator():
+        sock, _ = srv.accept()
+        conn = FrameConn(sock, name="backoff-coord")
+        rejects = 0
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                return
+            frames.append(msg)
+            if msg.get("t") == "hello":
+                if rejects < 3:
+                    rejects += 1
+                    conn.send({"t": "reject", "reason": "stale_lease"})
+                else:
+                    conn.send({"t": "lease", "lease_id": "L",
+                               "ttl_s": 1.0, "heartbeat_s": 10.0})
+                    conn.send({"t": "drain"})
+
+    coord = spawn(coordinator, name="backoff-coord", daemon=True)
+    slept = []
+    agent = WorkerAgent(
+        0, host, port, DummyLoop(), rejoin_seed=5,
+        sleeper=slept.append,
+    )
+    try:
+        assert agent.run() == 0          # drained cleanly in the end
+    finally:
+        agent.close()
+        srv.close()
+        coord.join(5.0)
+    assert len(slept) == 3
+    assert len(set(slept)) == 3          # DISTINCT delays
+    assert slept == agent.rejoin_delays
+    for i, d in enumerate(slept):
+        raw = min(REJOIN_BACKOFF_CAP_S, REJOIN_BACKOFF_BASE_S * 2.0 ** i)
+        assert 0.5 * raw <= d <= 1.5 * raw
+    # seeded: the same seed replays the same spread
+    import random
+
+    rng = random.Random(5)
+    expect = [
+        min(REJOIN_BACKOFF_CAP_S, REJOIN_BACKOFF_BASE_S * 2.0 ** i)
+        * (0.5 + rng.random())
+        for i in range(3)
+    ]
+    assert slept == pytest.approx(expect)
+    # the re-hellos carried no stale lease
+    hellos = [f for f in frames if f.get("t") == "hello"]
+    assert len(hellos) == 4
+    assert all("lease_id" not in h for h in hellos)
+
+
+# ---------------------------------------------------------------------------
+# The 2→8→2 load-ramp soak (acceptance gate, real thread workers)
+# ---------------------------------------------------------------------------
+
+
+def test_scale_ramp_soak_2_8_2():
+    """The tentpole contract: under continuous traffic the fleet walks
+    2→8→2 with every request terminal, ZERO double completions, and the
+    windowed queue p99 bounded through both transitions."""
+    out = run_scale_ramp_soak(seed=0, min_workers=2, max_workers=8)
+    assert out["ok"], out
+    assert out["all_terminal"]
+    assert out["double_completions"] == 0
+    assert out["peaked"] and out["shrunk"]
+    assert out["scale_ups"] >= 1 and out["scale_downs"] >= 1
+    assert out["p99_ok"]
+    assert out["by_status"].get("hung", 0) == 0
+    assert out["requests"] == sum(out["by_status"].values())
+
+
+@pytest.mark.slow
+def test_scaling_storm_chaos_gate():
+    """The chaos gate `rca chaos` runs: every forced transition racing
+    a fault seam observed, zero doubles, bounded stale drops."""
+    out = run_scaling_storm(seed=0)
+    assert out["ok"], out
+    assert "scaling_storm" in out["fault_classes_observed"]
+    assert out["double_completions"] == 0
+    assert out["stale_responses"] <= out["stale_bound"]

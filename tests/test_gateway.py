@@ -1150,3 +1150,78 @@ def test_canary_samples_through_live_gateway(engine, tmp_path):
             gw.close()
     finally:
         loop.stop()
+
+
+# -- mTLS: client-certificate front door (ISSUE 16) --------------------------
+
+CLIENT_CERT = os.path.join(
+    REPO_ROOT, "tests", "fixtures", "client_cert.pem"
+)
+CLIENT_KEY = os.path.join(
+    REPO_ROOT, "tests", "fixtures", "client_key.pem"
+)
+
+
+def test_gateway_tls_client_ca_requires_pair(monkeypatch, engine):
+    from rca_tpu.config import gateway_tls_client_ca
+
+    monkeypatch.delenv("RCA_GATEWAY_TLS_CERT", raising=False)
+    monkeypatch.delenv("RCA_GATEWAY_TLS_KEY", raising=False)
+    monkeypatch.delenv("RCA_GATEWAY_TLS_CLIENT_CA", raising=False)
+    assert gateway_tls_client_ca() is None
+    # client-CA without a TLS listener: an mTLS knob on a plaintext
+    # port would silently verify nobody — fail loudly instead
+    monkeypatch.setenv("RCA_GATEWAY_TLS_CLIENT_CA", CLIENT_CERT)
+    with pytest.raises(ValueError):
+        gateway_tls_client_ca()
+    monkeypatch.setenv("RCA_GATEWAY_TLS_CERT", CERT)
+    monkeypatch.setenv("RCA_GATEWAY_TLS_KEY", KEY)
+    assert gateway_tls_client_ca() == CLIENT_CERT
+    # same contract on the constructor path (env cleared: nothing to
+    # fall back to, so a client CA alone must refuse to build)
+    monkeypatch.delenv("RCA_GATEWAY_TLS_CERT")
+    monkeypatch.delenv("RCA_GATEWAY_TLS_KEY")
+    monkeypatch.delenv("RCA_GATEWAY_TLS_CLIENT_CA")
+    loop = _unstarted_loop(engine)
+    with pytest.raises(ValueError):
+        GatewayServer(loop, port=0, tls=None, tls_client_ca=CLIENT_CERT)
+
+
+def test_mtls_client_cert_enforced(engine, case):
+    """Mutual TLS: a client presenting the pinned fixture cert
+    round-trips; a cert-less (or wrong-cert) client dies at the
+    handshake and is COUNTED in auth_rejections — refused credentials
+    look the same in the metrics whatever layer refused them."""
+    loop = ServeLoop(engine=engine).start()
+    try:
+        # the self-signed client cert is its own CA: pin exactly it
+        gw = GatewayServer(loop, port=0, tls=(CERT, KEY),
+                           tls_client_ca=CLIENT_CERT)
+        gw.start()
+        try:
+            cl = GatewayClient(
+                gw.host, gw.port, tls=True, ca_file=CERT,
+                cert_file=CLIENT_CERT, key_file=CLIENT_KEY,
+            )
+            code, health = cl.healthz()
+            assert code == 200 and health["ok"]
+            code, body, _ = cl.analyze(
+                case.features, case.dep_src, case.dep_dst, k=3,
+            )
+            assert code == 200 and body["status"] == "ok"
+            # no client cert: dead at the handshake, before any route
+            with pytest.raises((OSError, http.client.HTTPException)):
+                GatewayClient(
+                    gw.host, gw.port, tls=True, ca_file=CERT
+                ).healthz()
+            # a cert the pinned CA did not sign is equally dead
+            with pytest.raises((OSError, http.client.HTTPException)):
+                GatewayClient(
+                    gw.host, gw.port, tls=True, ca_file=CERT,
+                    cert_file=CERT, key_file=KEY,
+                ).healthz()
+            assert gw.metrics.snapshot()["auth_rejections"] >= 2
+        finally:
+            gw.close()
+    finally:
+        loop.stop()

@@ -56,6 +56,16 @@ HEADLINE_METRICS = {
         "serve_federation", "request_ms_p50",
     ),
     "federation_recovery_ms": ("serve_federation", "recovery_ms"),
+    # elasticmesh (ISSUE 16): serve p99 THROUGH the 2→8→2 ramp and the
+    # controller's per-sweep decision wall — a regression in either
+    # means scale transitions got visible to callers.  Absent in rounds
+    # before 16: skipped, never failed.
+    "autoscale_ramp_request_ms_p99": (
+        "serve_autoscale", "ramp_request_ms_p99",
+    ),
+    "autoscale_scale_decision_ms_p50": (
+        "serve_autoscale", "scale_decision_ms_p50",
+    ),
 }
 
 #: metrics gated TIGHTER than the default threshold, name -> (path,
