@@ -28,6 +28,9 @@ from rca_tpu.analysis.core import FileContext, Finding, Rule, register
 SCOPE = (
     "rca_tpu/cluster/columnar.py",
     "rca_tpu/features/extract.py",
+    # live ingest (ISSUE 17): the watch-pump adapter's payload() is the
+    # per-capture surface — per-mutation loops stay behind _sync
+    "rca_tpu/cluster/live_columnar.py",
 )
 
 MARKER = "[no-dict-scan]"

@@ -55,6 +55,11 @@ REPLAY_SCOPE = (
     # columnar tables (ISSUE 10): coldiff frames replay the row writes,
     # so the whole module is clock-free by construction
     "rca_tpu/cluster/columnar.py",
+    # live ingest (ISSUE 17): the watch-pump columnar adapter and the
+    # multi-cluster merge feed recorded sessions — both must stay
+    # clock-free so merged corpora replay host-independently
+    "rca_tpu/cluster/live_columnar.py",
+    "rca_tpu/cluster/clusterset.py",
     "rca_tpu/features/extract.py",
     "rca_tpu/resilience/chaos.py",
     "rca_tpu/resilience/policy.py",

@@ -33,6 +33,12 @@ TICK_MODULES = {
     # columnar capture (ISSUE 10) is pure host-side table work — it may
     # never synchronize with the device
     "rca_tpu/cluster/columnar.py": set(),
+    # live ingest (ISSUE 17): watch-pump capture, the multi-cluster
+    # merge, and the ingest runner are host-side capture paths — none
+    # may ever touch the device
+    "rca_tpu/cluster/live_columnar.py": set(),
+    "rca_tpu/cluster/clusterset.py": set(),
+    "rca_tpu/serve/ingest.py": set(),
     "rca_tpu/serve/dispatcher.py": {"fetch"},
     "rca_tpu/serve/loop.py": set(),
     "rca_tpu/serve/queue.py": set(),

@@ -457,6 +457,26 @@ def cmd_chaos(args) -> int:
             and "scaling_storm"
             in summary["autoscale"]["fault_classes_observed"]
         )
+    # ingest_death leg (ISSUE 17): SIGKILL the ingest worker that owns
+    # capture mirrors mid-soak — gated on the death observed as its own
+    # fault class, drain-and-reroute to a survivor, rendezvous reclaim
+    # on rejoin, and ZERO double-applied capture ticks (the coordinator
+    # cluster table is the exactly-once arbiter).
+    ingest_ok = True
+    if (not getattr(args, "no_federation", False)
+            and not getattr(args, "no_ingest", False)
+            and args.ticks >= 100):
+        from rca_tpu.serve.federation import (
+            INGEST_FAULT_CLASS, run_ingest_chaos,
+        )
+
+        summary["ingest"] = run_ingest_chaos(seed=seed)
+        ingest_ok = (
+            summary["ingest"]["ok"]
+            and INGEST_FAULT_CLASS
+            in summary["ingest"]["fault_classes_observed"]
+            and summary["ingest"]["double_applied"] == 0
+        )
     print(json.dumps(summary, indent=None if args.compact else 2))
     scope = summary.get("kernelscope", {})
     ok = (
@@ -465,6 +485,7 @@ def cmd_chaos(args) -> int:
         and (summary["all_classes_observed"] or args.ticks < 100)
         and fed_ok
         and auto_ok
+        and ingest_ok
         # --record adds the record→replay parity leg to the contract
         and summary.get("replay", {}).get("parity_ok", True)
         # kernelscope gates (ISSUE 12): zero post-warmup recompiles on
@@ -473,6 +494,58 @@ def cmd_chaos(args) -> int:
         and scope.get("memory_gate", {}).get("ok", True)
     )
     return 0 if ok else 1
+
+
+def cmd_ingest(args) -> int:
+    """Federated capture fleet (SERVING.md §Ingest workers): spawn
+    ``--workers`` ingest-class workers, register ``--clusters`` synthetic
+    clusters (rendezvous-routed, exactly one capture-mirror owner each),
+    soak for ``--duration`` seconds, and print the coordinator's cluster
+    table — owner, epoch, ticks, sweep latency, coldiff bytes.  Exits 0
+    only when every cluster is owned and ticking with zero double-applied
+    ticks and zero stale-stat leaks past the epoch fence."""
+    import time as _time
+
+    from rca_tpu.serve.federation import FederationPlane
+
+    plane = FederationPlane(
+        workers=0, heartbeat_s=args.heartbeat_s, spawn_workers=False,
+    )
+    with plane:
+        for i in range(args.workers):
+            plane.spawn_worker(i, role="ingest")
+        if not plane.wait_ready(args.workers, timeout_s=90.0):
+            print(json.dumps({"ok": False, "error": "workers never joined",
+                              "workers": plane.worker_table()}))
+            return 1
+        specs = {
+            f"c{j}": {
+                "digest": f"ingest-{args.seed}-{j}",
+                "services": args.services,
+                "pods_per_service": args.pods_per_service,
+                "seed": args.seed + j,
+                "namespace": "synthetic",
+            }
+            for j in range(args.clusters)
+        }
+        plane.register_clusters(specs)
+        deadline = _time.monotonic() + args.duration
+        while _time.monotonic() < deadline:
+            _time.sleep(0.1)
+        status = plane.ingest_status()
+        double = sum(c["double_applied"] for c in status.values())
+        summary = {
+            "clusters": status,
+            "workers": plane.worker_table(),
+            "double_applied": double,
+            "stale_stats_dropped": plane.ingest_stale,
+            "ok": bool(status) and double == 0 and all(
+                c["owner"] is not None and c["ticks"] > 0
+                for c in status.values()
+            ),
+        }
+    print(json.dumps(summary, indent=None if args.compact else 2))
+    return 0 if summary["ok"] else 1
 
 
 def _parse_autoscale(spec: str):
@@ -1369,8 +1442,33 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="no_autoscale",
                     help="skip the scaling_storm chaos leg (forced scale "
                     "transitions racing kill/hang/partition)")
+    sp.add_argument("--no-ingest", action="store_true",
+                    dest="no_ingest",
+                    help="skip the ingest_death chaos leg (SIGKILL the "
+                    "capture-mirror owner; exactly-once tick gate)")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser(
+        "ingest",
+        help="federated capture fleet: ingest-class workers owning "
+        "columnar cluster mirrors, rendezvous-routed (SERVING.md)",
+    )
+    sp.add_argument("--workers", type=int, default=2,
+                    help="ingest worker processes")
+    sp.add_argument("--clusters", type=int, default=3,
+                    help="synthetic clusters to register")
+    sp.add_argument("--services", type=int, default=20,
+                    help="services per synthetic cluster")
+    sp.add_argument("--pods-per-service", type=int, default=1,
+                    dest="pods_per_service")
+    sp.add_argument("--duration", type=float, default=5.0,
+                    help="soak seconds before scoring")
+    sp.add_argument("--heartbeat-s", type=float, default=0.25,
+                    dest="heartbeat_s")
+    sp.add_argument("--seed", type=int, default=17)
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_ingest)
 
     sp = sub.add_parser(
         "serve",
@@ -1523,11 +1621,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-round recording probability (override "
                     "$RCA_CANARY_SAMPLE_RATE; default 1.0)")
     sp.add_argument("--mode", default="stream",
-                    choices=["stream", "serve", "both"],
+                    choices=["stream", "serve", "both", "multicluster"],
                     help="what each round samples: streaming "
                     "investigations (bisect names the exact tick), "
-                    "serve waves (first divergent request index), or "
-                    "both")
+                    "serve waves (first divergent request index), "
+                    "both, or merged multi-cluster sessions captured "
+                    "through the live columnar adapter")
     sp.add_argument("--listen-url", default=None, dest="listen_url",
                     metavar="URL",
                     help="sample through a RUNNING gateway "

@@ -114,6 +114,21 @@ def _metric_pcts_pair(rec: Optional[dict]) -> Tuple[float, float]:
     return _metric_pcts(rec)
 
 
+def _extract_columnar(obj_s: dict, rec: Optional[dict],
+                      texts_by_container: Dict[str, str]) -> dict:
+    """ONE (sanitized) pod object + its metric record + its log texts ->
+    the pod's full columnar scalar block, as the wire-op dict shape.  THE
+    shared encoder: the journal-fed master (``_encode_pod_op``), the full
+    rebuild, and the live ``K8sApiClient`` adapter (cluster/
+    live_columnar.py) all route through here, which is what makes
+    live-vs-mock-vs-dict bit-parity structural rather than aspirational."""
+    logc, lnb = _pod_log_fields(obj_s, texts_by_container or {})
+    return {
+        "obj": obj_s, "rec": rec,
+        "logc": [int(x) for x in logc], "lnb": bool(lnb),
+    }
+
+
 def _warn_counts_of(events: List[dict]) -> Dict[str, int]:
     """Warning-event counts by involved pod — the extractor's
     ``_warn_counts`` over a plain event list."""
@@ -322,13 +337,10 @@ class ColumnarWorld:
         rec = (
             w.pod_metrics.get(ns, {}).get("pods", {}) or {}
         ).get(name)
-        logc, lnb = _pod_log_fields(
-            obj_s, w.logs.get(ns, {}).get(name, {}) or {}
+        ext = _extract_columnar(
+            obj_s, rec, w.logs.get(ns, {}).get(name, {}) or {}
         )
-        return {
-            "op": "pod", "name": name, "obj": obj_s, "rec": rec,
-            "logc": [int(x) for x in logc], "lnb": bool(lnb),
-        }
+        return {"op": "pod", "name": name, **ext}
 
     def _encode_kind_op(self, store: str, name: str) -> Optional[dict]:
         w, ns = self.world, self.namespace
@@ -469,8 +481,8 @@ class ColumnarWorld:
         for i, pod in enumerate(pods):
             name = (pod.get("metadata") or {}).get("name", "")
             rec = self.metric_recs.get(name)
-            logc, lnb = _pod_log_fields(pod, logs_store.get(name, {}) or {})
-            self._write_pod_row(i, pod, rec, logc, lnb)
+            ext = _extract_columnar(pod, rec, logs_store.get(name, {}) or {})
+            self._write_pod_row(i, pod, rec, ext["logc"], ext["lnb"])
 
     # -- shared row write (master + mirror) ---------------------------------
     def _label_sig(self, labels: Dict[str, str]) -> int:

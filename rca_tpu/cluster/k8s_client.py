@@ -137,6 +137,11 @@ class K8sApiClient:
                 with suppressed("k8s.reconnect_pump_stop"):
                     pumps.stop()
             pumps_by_ns.clear()
+            # columnar feeds ride the pumps: their shadow worlds mirror
+            # the previous cluster, so a reconnect discards them too —
+            # feed generations make every old cursor read out-of-range
+            # and the next get_columnar serves a fresh full dump
+            self.__dict__.setdefault("_colfeeds", {}).clear()
         if not HAVE_K8S_LIB:
             return
         try:
@@ -756,6 +761,28 @@ class K8sApiClient:
                     "expired": True, "changes": []}
         return {"supported": True, "cursor": cursor,
                 "expired": False, "changes": changes}
+
+    # ---- columnar feed (live adapter; ISSUE 17) ---------------------------
+    def get_columnar(self, namespace: str,
+                     cursor: Optional[str] = None) -> Dict[str, Any]:
+        """Live columnar capture feed: the same payload protocol the mock
+        serves (full column dump once, ordered column-diff ops after, a
+        full rebuild on watch expiry), built on the watch pumps' per-event
+        resourceVersions by one :class:`~rca_tpu.cluster.live_columnar.
+        LiveColumnarFeed` per namespace.  ``supported: False`` (no
+        kubernetes lib / not connected / pumps unsupported) keeps callers
+        on the dict-sweep path — ``ClusterSnapshot.capture`` falls back
+        exactly as it does for degenerate worlds."""
+        if not HAVE_K8S_LIB or not self._connected:
+            return {"supported": False, "reason": "no live connection"}
+        from rca_tpu.cluster.live_columnar import LiveColumnarFeed
+
+        with self._pumps_registry():
+            feeds = self.__dict__.setdefault("_colfeeds", {})
+            feed = feeds.get(namespace)
+            if feed is None:
+                feed = feeds[namespace] = LiveColumnarFeed(self, namespace)
+        return feed.payload(cursor)
 
     def watch_close(self, namespace: str, cursor: Optional[str]) -> None:
         """Release a consumer token acquired from :meth:`watch_changes`.

@@ -706,8 +706,11 @@ def explain_topm() -> int:
 #   RCA_KERNEL_CACHE   file the registry persists timed autotune winners +
 #                      cost rows to (keyed by jax version + kernel-set
 #                      hash, so upgrades re-time); default
-#                      ~/.cache/rca_tpu/kernel_cache.json; 0|off|none
-#                      disables persistence entirely
+#                      ~/.cache/rca_tpu/kernel_cache.<platform>.json
+#                      (platform-keyed, ISSUE 17); 0|off|none disables
+#                      persistence entirely.  A committed read-only seed
+#                      (rca_tpu/engine/kernel_cache.<platform>.json)
+#                      backstops a cold user cache.
 #   RCA_KERNELSCOPE    1 (default) | 0 — the runtime recompile watchdog
 #                      (a jax_log_compiles-fed monitor counting any
 #                      compilation whose signature was already compiled —
@@ -720,19 +723,48 @@ def explain_topm() -> int:
 #                      live-buffer walk is cheap but not free)
 
 
+def kernel_platform() -> str:
+    """The platform key the winner cache files are named by — the JAX
+    default backend ("cpu", "tpu", "gpu"), falling back to "cpu" before
+    jax is importable.  Filesystem-safe by construction."""
+    try:
+        import jax
+
+        name = str(jax.default_backend()).strip().lower()
+    except Exception:
+        name = "cpu"
+    return "".join(ch if ch.isalnum() or ch in "-_" else "-" for ch in name) \
+        or "cpu"
+
+
 def kernel_cache_path() -> Optional[str]:
     """``RCA_KERNEL_CACHE``: the registry's autotune/cost cache file.
-    Unset/empty = the default under ``~/.cache``; ``0``/``off``/``none``
-    = disabled (returns None)."""
+    Unset/empty = the PLATFORM-KEYED default under ``~/.cache``
+    (``kernel_cache.<platform>.json`` — ISSUE 17: a CPU host and a TPU
+    host must never overwrite each other's timed winners); ``0``/``off``/
+    ``none`` = disabled (returns None)."""
     raw = (env_raw("RCA_KERNEL_CACHE") or "").strip()
     if not raw:
         return os.path.join(
             os.path.expanduser("~"), ".cache", "rca_tpu",
-            "kernel_cache.json",
+            f"kernel_cache.{kernel_platform()}.json",
         )
     if raw.lower() in ("0", "off", "none"):
         return None
     return raw
+
+
+def shipped_kernel_cache_path() -> str:
+    """The committed-shippable winner cache for this platform
+    (``rca_tpu/engine/kernel_cache.<platform>.json``): read-only seed
+    rows so fleet workers skip the autotune cold-start.  Stale headers
+    (different jax version / kernel-set hash) are rejected by the same
+    header check as the user cache — stale platform keys re-time, they
+    never poison."""
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "engine",
+        f"kernel_cache.{kernel_platform()}.json",
+    )
 
 
 def kernelscope_enabled() -> bool:
@@ -741,6 +773,45 @@ def kernelscope_enabled() -> bool:
         "RCA_KERNELSCOPE", "1", choices=("0", "1", "on", "off"),
         lower=True,
     ) in ("1", "on")
+
+
+# -- live columnar ingestion + multi-cluster capture (ISSUE 17) --------------
+# env knobs for the live get_columnar adapter (cluster/live_columnar.py),
+# the ClusterSet merged world (cluster/clusterset.py), and the fleetmesh
+# cluster-ingest worker class (serve/federation.py):
+#
+#   RCA_INGEST_TOPO_EVERY [0, 100000]  re-list + rv-diff the topology kinds
+#                      (services, deployments, ... — everything the watch
+#                      pumps do not stream) every Nth sweep; 0 = never
+#                      (watch entries only; real pumps then never refresh
+#                      topology).  Default 1: every sweep, the rv-diff
+#                      makes unchanged stores free downstream.
+#   RCA_INGEST_LOGS    1 (default) | 0 — fetch tail-200 container logs
+#                      into the shadow world when a pod changes.  Off
+#                      keeps log-pattern columns at zero (clusters where
+#                      the log API is the expensive hop) and trades away
+#                      log-channel evidence + dict-path parity on pods
+#                      with logs.
+#   RCA_INGEST_TICK_S  [0.0, 60.0]  ingest-worker capture cadence inside
+#                      fleetmesh cluster-ingest workers (default 0.05)
+
+
+def ingest_topo_every() -> int:
+    """``RCA_INGEST_TOPO_EVERY``: topology re-list cadence (sweeps)."""
+    return env_int("RCA_INGEST_TOPO_EVERY", 1, 0, 100_000)
+
+
+def ingest_log_fetch() -> bool:
+    """``RCA_INGEST_LOGS``: fetch container logs into the live feed."""
+    return env_str(
+        "RCA_INGEST_LOGS", "1", choices=("0", "1", "on", "off"),
+        lower=True,
+    ) in ("1", "on")
+
+
+def ingest_tick_s() -> float:
+    """``RCA_INGEST_TICK_S``: ingest-worker capture cadence (seconds)."""
+    return env_float("RCA_INGEST_TICK_S", 0.05, 0.0, 60.0)
 
 
 def memory_sample_every() -> int:

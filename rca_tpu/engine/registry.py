@@ -204,8 +204,12 @@ class KernelRegistry:
             return self._cache_path_override
         return kernel_cache_path()
 
-    def _load_cached(self, key: str) -> Optional[Dict[str, Any]]:
-        path = self._cache_file()
+    @staticmethod
+    def _read_cache_rows(path: Optional[str]) -> Optional[Dict[str, Any]]:
+        """Validated rows from one cache file, or None.  A stale header
+        (jax upgrade, kernel edit, or a shipped cache from a DIFFERENT
+        platform whose filename key happens to match) re-times instead
+        of poisoning — the header check is the guarantee."""
         if not path or not os.path.exists(path):
             return None
         try:
@@ -222,7 +226,25 @@ class KernelRegistry:
             or data.get("kernel_set") != kernel_set_hash()
         ):
             return None  # stale header (jax upgrade / kernel edit): re-time
-        row = (data.get("rows") or {}).get(key)
+        rows = data.get("rows")
+        return rows if isinstance(rows, dict) else None
+
+    def _load_cached(self, key: str) -> Optional[Dict[str, Any]]:
+        row = None
+        rows = self._read_cache_rows(self._cache_file())
+        if rows is not None:
+            row = rows.get(key)
+        if row is None:
+            # fleet cold-start (ROADMAP item 2): fall back to the
+            # committed platform-keyed cache (engine/kernel_cache.
+            # <platform>.json) so a fresh worker process skips autotune
+            # for shapes the shipped cache already timed on this
+            # platform.  Never written to — user cache overrides it.
+            from rca_tpu.config import shipped_kernel_cache_path
+
+            shipped = self._read_cache_rows(shipped_kernel_cache_path())
+            if shipped is not None:
+                row = shipped.get(key)
         if not isinstance(row, dict) or row.get("winner") not in KERNELS:
             return None
         return row

@@ -45,6 +45,33 @@ from rca_tpu.config import RCAConfig, bucket_for
 from rca_tpu.engine.runner import GraphEngine, _propagate_ranked
 
 
+def topology_digest(tag: str, parts) -> str:
+    """Stable hex digest over a topology description.
+
+    ``parts`` is any JSON-serializable nested structure of strings /
+    numbers / sequences (tuples are canonicalized to lists).  Used by
+    the multi-cluster :class:`~rca_tpu.cluster.clusterset.ClusterSet`
+    both per member (the rendezvous key ingest ownership is routed by)
+    and over the merged world (the fleet's replay/routing identity).
+    Same topology — regardless of construction or iteration order at the
+    call site, which must pre-sort — same digest, across processes
+    (sha256, not ``hash()``).
+    """
+    import hashlib
+    import json
+
+    def _canon(x):
+        if isinstance(x, (list, tuple)):
+            return [_canon(v) for v in x]
+        if isinstance(x, dict):
+            return {str(k): _canon(v) for k, v in sorted(x.items())}
+        return x
+
+    blob = json.dumps([tag, _canon(parts)], sort_keys=True,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
 @functools.partial(
     jax.jit,
     donate_argnums=(0,),

@@ -119,6 +119,55 @@ def _sample_stream(tmp: str, out_path: str, ticks: int, services: int,
     return {"mode": "stream", "ticks": stats["ticks"]}
 
 
+def _sample_multicluster(tmp: str, out_path: str, ticks: int,
+                         services: int, seed: int, k: int,
+                         clusters: int = 3) -> Dict[str, Any]:
+    """One recorded streaming investigation over a MERGED multi-cluster
+    world (ISSUE 17): ``clusters`` synthetic member worlds behind one
+    :class:`~rca_tpu.cluster.clusterset.MergedClusterClient`, captured
+    through the live columnar adapter with cluster-prefixed names and
+    cluster-local service edges.  The minted recording carries merged
+    frames — committing one puts the federation path under the
+    permanent corpus gate."""
+    from rca_tpu.cluster.clusterset import ClusterSet
+    from rca_tpu.cluster.generator import synthetic_cascade_world
+    from rca_tpu.cluster.mock_client import MockClusterClient
+    from rca_tpu.engine.live import LiveStreamingSession
+    from rca_tpu.replay import Recorder, mint_recording
+
+    worlds = {
+        f"c{j}": synthetic_cascade_world(
+            services, n_roots=1, seed=seed + j
+        )
+        for j in range(int(clusters))
+    }
+    cset = ClusterSet({
+        cid: MockClusterClient(w) for cid, w in worlds.items()
+    })
+    merged = cset.merged_client()
+    recorder = Recorder(os.path.join(tmp, "multicluster"), mode="stream")
+    session = LiveStreamingSession(
+        merged, "synthetic", k=k,
+        topology_check_every=10, recorder=recorder,
+    )
+    rng = np.random.default_rng(seed)
+    cids = sorted(worlds)
+    for t in range(ticks):
+        if t % 3 == 0:
+            # churn lands in a different member each time — merged
+            # frames must interleave cluster-prefixed deltas
+            cid = cids[t // 3 % len(cids)]
+            i = int(rng.integers(0, services))
+            name = f"pod-svc-{i:05d}" if services > 5 else "pod-0"
+            worlds[cid].touch("pod_metrics", "synthetic", name)
+        session.poll()
+    recorder.close()
+    merged.close()
+    stats = mint_recording(recorder.path, out_path)
+    return {"mode": "multicluster", "clusters": int(clusters),
+            "ticks": stats["ticks"]}
+
+
 def _sample_gateway(tmp: str, out_path: str, url: str, requests: int,
                     services: int, seed: int, k: int,
                     token: Optional[str] = None,
@@ -277,8 +326,10 @@ def run_canary(
     gets one investigation per sampled recording with its
     ``recording_ref`` pointing at the minted file — the corpus is
     replayable by investigation id."""
-    if mode not in ("stream", "serve", "both"):
-        raise ValueError(f"mode must be stream|serve|both, got {mode!r}")
+    if mode not in ("stream", "serve", "both", "multicluster"):
+        raise ValueError(
+            f"mode must be stream|serve|both|multicluster, got {mode!r}"
+        )
     if listen_url is not None:
         mode = "gateway"
     if sample_rate is None:
@@ -306,6 +357,11 @@ def run_canary(
             try:
                 if leg == "stream":
                     info = _sample_stream(
+                        tmp, out_path, ticks=ticks, services=services,
+                        seed=seed + i, k=k,
+                    )
+                elif leg == "multicluster":
+                    info = _sample_multicluster(
                         tmp, out_path, ticks=ticks, services=services,
                         seed=seed + i, k=k,
                     )

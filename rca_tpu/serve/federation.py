@@ -101,6 +101,12 @@ from rca_tpu.util.threads import make_lock, spawn
 #: the federation's fault classes — what the chaos gate must observe
 FED_FAULT_CLASSES = ("process_kill", "worker_hang", "coordinator_partition")
 
+#: the ingest-fleet fault class (ISSUE 17): an ingest worker's socket
+#: EOF — its cluster mirrors move to rendezvous survivors with a fresh
+#: ownership epoch, and the dead owner's in-flight tick stats are
+#: dropped as epoch-stale (never double-applied)
+INGEST_FAULT_CLASS = "ingest_death"
+
 #: router idle park while nothing is queued / routable
 _ROUTE_IDLE_S = 0.02
 
@@ -328,10 +334,15 @@ class _WorkerHandle:
         self.draining = False
         self.shape_ms: Dict[int, float] = {}     # n_pad -> winner ms
         self.mem_bytes: Optional[int] = None
+        # planetcap (ISSUE 17): worker class from the hello frame —
+        # "serve" workers join the serve ring, "ingest" workers join the
+        # ingest ring and own cluster capture mirrors instead
+        self.role = "serve"
 
     def summary(self) -> Dict[str, Any]:
         return {
             "worker_id": self.worker_id,
+            "role": self.role,
             "state": self.state,
             "live": self.live,
             "draining": self.draining,
@@ -419,6 +430,14 @@ class FederationPlane:
             self.heartbeat_s, self.lease_misses, clock=clock
         )
         self.ring = HashRing()
+        # planetcap (ISSUE 17): the ingest worker class.  Cluster capture
+        # mirrors are rendezvous-routed over THIS ring (``cid:digest``
+        # keys), one owner per cluster; the cluster table is the
+        # coordinator-side exactly-once arbiter for capture ticks
+        # (epoch-stale and replayed tick stats are dropped, counted).
+        self.ingest_ring = HashRing()
+        self.clusters: Dict[str, Dict[str, Any]] = {}
+        self.ingest_stale = 0
         self._lock = make_lock("FederationPlane._lock")
         self.workers: Dict[int, _WorkerHandle] = {}
         self._pending: Dict[str, _Pending] = {}
@@ -493,22 +512,26 @@ class FederationPlane:
                 self.spawn_worker(i)
         return self
 
-    def spawn_worker(self, worker_id: int):
+    def spawn_worker(self, worker_id: int, role: str = "serve"):
         """Spawn (or respawn) one worker process through the procs seam;
-        it connects back to the control port and hellos."""
+        it connects back to the control port and hellos.  ``role``
+        selects the worker class (``"ingest"`` spawns a cluster-capture
+        worker that joins the ingest ring instead of the serve ring)."""
         from rca_tpu.config import environ_copy
         from rca_tpu.util.procs import python_argv, spawn_worker
 
         env = environ_copy()
         if self.worker_env:
             env.update(self.worker_env)
+        args = [
+            "--connect", self.address,
+            "--worker-id", str(worker_id),
+        ]
+        if role != "serve":
+            args += ["--role", str(role)]
         proc = spawn_worker(
             f"fed-worker{worker_id}",
-            python_argv(
-                "rca_tpu.serve.worker",
-                "--connect", self.address,
-                "--worker-id", str(worker_id),
-            ),
+            python_argv("rca_tpu.serve.worker", *args),
             env=env,
         )
         with self._lock:
@@ -787,18 +810,25 @@ class FederationPlane:
             w.state = "draining" if w.draining else "live"
             w.shape_ms = _parse_shape_summary(hello.get("registry"))
             w.mem_bytes = _parse_headroom(hello.get("headroom"))
+            w.role = str(hello.get("role") or "serve")
             if not w.draining:
-                self.ring.add(worker_id)
+                # worker class decides the ring: ingest workers own
+                # cluster mirrors, never serve traffic
+                (self.ingest_ring if w.role == "ingest"
+                 else self.ring).add(worker_id)
         if old_conn is not None:
             old_conn.close()
         self._event("rejoin" if rejoin else "worker_joined", worker_id,
-                    lease_id=lease.lease_id)
+                    lease_id=lease.lease_id, role=w.role)
         conn.send({
             "t": "lease", "lease_id": lease.lease_id,
             "ttl_s": self.leases.ttl_s,
             "heartbeat_s": self.heartbeat_s,
         })
         self.queue.kick()    # routable capacity appeared
+        if w.role == "ingest":
+            # a (re)joined ingest worker may rendezvous-reclaim clusters
+            self._ingest_rebalance()
         return w
 
     def _conn_loop(self, conn: FrameConn) -> None:
@@ -835,6 +865,8 @@ class FederationPlane:
                     conn.send({"t": "reject", "reason": "stale_lease"})
             elif t == "resp" and handle is not None:
                 self._on_response(handle, msg)
+            elif t == "ingest_stat" and handle is not None:
+                self._on_ingest_stat(handle, msg)
             elif t == "drained" and handle is not None:
                 self._event("worker_drained", handle.worker_id,
                             served=msg.get("served"))
@@ -910,12 +942,18 @@ class FederationPlane:
             w.live = False
             w.state = "dead"
             self.ring.remove(worker_id)
+            self.ingest_ring.remove(worker_id)
+            was_ingest = w.role == "ingest"
             lease = w.lease
             overdue = (
                 max(0.0, now - lease.expires_at())
                 if lease is not None else 0.0
             )
-            if eof:
+            if was_ingest:
+                # any ingest-owner loss is the same fault from the
+                # capture plane's point of view: mirrors must move
+                fault = INGEST_FAULT_CLASS
+            elif eof:
                 fault = "process_kill"
             elif w.partitioned_until > 0.0:
                 fault = "coordinator_partition"
@@ -946,6 +984,121 @@ class FederationPlane:
                 self.reroutes += 1
                 self._overflow.append(p.req)
         self.queue.kick()
+        if was_ingest:
+            # drain-and-reroute for the capture plane: every cluster the
+            # dead worker owned moves to its rendezvous survivor
+            self._ingest_rebalance()
+
+    # -- ingest worker class: federated cluster capture (ISSUE 17) ------------
+    def register_clusters(self, specs: Dict[str, Dict[str, Any]]) -> None:
+        """Register captured clusters with the ingest fleet.
+
+        ``specs`` maps cluster id -> a spec dict carrying at least
+        ``digest`` (the :meth:`ClusterSet.cluster_digest` value; the
+        rendezvous routing key is ``"<cid>:<digest>"``) plus whatever
+        world parameters the worker-side runner needs to host the
+        mirror.  Each cluster gets EXACTLY ONE live ingest owner; every
+        ownership change bumps the cluster's epoch so stats from
+        deposed owners are dropped, never double-applied."""
+        with self._lock:
+            for cid, spec in specs.items():
+                ent = self.clusters.setdefault(str(cid), {
+                    "digest": "", "spec": {}, "owner": None, "epoch": 0,
+                    "last_seq": 0, "ticks": 0, "double_applied": 0,
+                    "moves": 0, "sweep_ms": None, "coldiff_bytes": None,
+                })
+                ent["digest"] = str(spec.get("digest") or cid)
+                ent["spec"] = dict(spec)
+        self._ingest_rebalance()
+
+    def _ingest_rebalance(self) -> None:
+        """Recompute every cluster's owner over the live ingest ring and
+        ship (un)assign frames for the moves.  Rendezvous keys are
+        ``cid:digest`` — a digest change (topology change) is allowed to
+        move a mirror; a rejoining worker reclaims exactly the clusters
+        it owned before (HRW stickiness)."""
+        sends: List[Any] = []
+        moved: List[Dict[str, Any]] = []
+        with self._lock:
+            for cid in sorted(self.clusters):
+                ent = self.clusters[cid]
+                key = f"{cid}:{ent['digest']}"
+                new_owner = None
+                for wid in self.ingest_ring.ranked(key):
+                    w = self.workers.get(wid)
+                    if (w is not None and w.live and not w.draining
+                            and w.conn is not None):
+                        new_owner = wid
+                        break
+                if new_owner == ent["owner"]:
+                    continue
+                old_id = ent["owner"]
+                old = (
+                    self.workers.get(old_id)
+                    if old_id is not None else None
+                )
+                ent["owner"] = new_owner
+                ent["epoch"] += 1
+                ent["moves"] += 1
+                if (old is not None and old.live
+                        and old.conn is not None):
+                    sends.append((old.conn, {
+                        "t": "ingest_unassign", "cluster": cid,
+                        "epoch": ent["epoch"],
+                    }))
+                if new_owner is not None:
+                    sends.append((self.workers[new_owner].conn, {
+                        "t": "ingest_assign", "cluster": cid,
+                        "epoch": ent["epoch"],
+                        "resume_seq": ent["last_seq"],
+                        "spec": ent["spec"],
+                    }))
+                moved.append({
+                    "cluster": cid, "from": old_id, "to": new_owner,
+                    "epoch": ent["epoch"],
+                })
+        for m in moved:
+            self._event(
+                "ingest_assigned" if m["to"] is not None
+                else "ingest_orphaned",
+                m["to"], cluster=m["cluster"], epoch=m["epoch"],
+                prev_owner=m["from"],
+            )
+        for conn, msg in sends:
+            conn.send(msg)
+
+    def _on_ingest_stat(self, w: _WorkerHandle,
+                        msg: Dict[str, Any]) -> None:
+        """One capture-tick report from an ingest worker.  The cluster
+        table arbitrates exactly-once: stats from a deposed owner
+        (wrong worker or stale epoch) and replayed tick seqs are
+        counted and dropped — a tick is applied at most once."""
+        cid = str(msg.get("cluster"))
+        epoch = int(msg.get("epoch") or -1)
+        seq = int(msg.get("tick_seq") or 0)
+        with self._lock:
+            ent = self.clusters.get(cid)
+            if (ent is None or ent["owner"] != w.worker_id
+                    or ent["epoch"] != epoch):
+                self.ingest_stale += 1
+                return
+            if seq <= ent["last_seq"]:
+                ent["double_applied"] += 1
+                return
+            ent["last_seq"] = seq
+            ent["ticks"] += 1
+            if msg.get("sweep_ms") is not None:
+                ent["sweep_ms"] = float(msg["sweep_ms"])
+            if msg.get("coldiff_bytes") is not None:
+                ent["coldiff_bytes"] = int(msg["coldiff_bytes"])
+
+    def ingest_status(self) -> Dict[str, Dict[str, Any]]:
+        """Snapshot of the cluster-ownership table (CLI + tests)."""
+        with self._lock:
+            return {
+                cid: {k: v for k, v in ent.items() if k != "spec"}
+                for cid, ent in self.clusters.items()
+            }
 
     # -- routing --------------------------------------------------------------
     def _pick_worker(self, req: ServeRequest) -> Optional[_WorkerHandle]:
@@ -1117,6 +1270,9 @@ class FederationPlane:
         auto = self.autoscaler
         if auto is not None:
             out["autoscale"] = auto.status()
+        ingest = self.ingest_status()
+        if ingest:
+            out["ingest"] = ingest
         return out
 
 
@@ -1543,4 +1699,153 @@ def run_federation_chaos(
         "lease_ttl_s": ttl,
         "detect_lag_ms_max": round(max(detect), 3) if detect else None,
         "rejoins": sum(1 for e in events if e["event"] == "rejoin"),
+    }
+
+
+def run_ingest_chaos(
+    seed: int = 17,
+    workers: int = 3,
+    clusters: int = 4,
+    heartbeat_s: float = 0.12,
+    timeout_s: float = 180.0,
+    ready_timeout_s: float = 90.0,
+) -> Dict[str, Any]:
+    """Drive the ``ingest_death`` fault class against a live ingest
+    fleet mid-sweep, and score the capture-ownership contract:
+
+    1. spawn an ingest-worker fleet and register ``clusters`` synthetic
+       clusters — each rendezvous-routed to exactly one owner, ticking
+       its columnar mirror continuously;
+    2. SIGKILL the owner of a seeded-chosen cluster while its ticks are
+       flowing (mid-sweep by construction: the runner never pauses);
+    3. assert every orphaned cluster moves to EXACTLY ONE live
+       survivor with a fresh epoch and resumes ticking, with ZERO
+       double-applied ticks (late stats from the dead owner are
+       epoch-stale and dropped);
+    4. respawn the dead worker id: HRW stickiness must hand it back
+       exactly the clusters it owned before (rejoin reclaims)."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    plane = FederationPlane(
+        workers=0, heartbeat_s=heartbeat_s, spawn_workers=False,
+    )
+
+    def wait_for(pred, deadline: float) -> bool:
+        while plane.clock() < deadline:
+            if pred():
+                return True
+            if plane._stop.wait(0.05):
+                return False
+        return bool(pred())
+
+    with plane:
+        for i in range(workers):
+            plane.spawn_worker(i, role="ingest")
+        if not plane.wait_ready(workers, timeout_s=ready_timeout_s):
+            raise RuntimeError(
+                "ingest chaos: workers failed to join: "
+                f"{plane.worker_table()}"
+            )
+        specs = {
+            f"ing{j}": {
+                "digest": f"digest-{seed}-{j}",
+                "services": 6, "pods_per_service": 1,
+                "seed": seed + j, "namespace": "synthetic",
+            }
+            for j in range(clusters)
+        }
+        plane.register_clusters(specs)
+
+        def ticking(min_ticks: int, table=None) -> bool:
+            status = plane.ingest_status()
+            return all(
+                c["owner"] is not None and c["ticks"] >= (
+                    (table or {}).get(cid, 0) + min_ticks
+                )
+                for cid, c in status.items()
+            )
+
+        deadline = plane.clock() + timeout_s
+        if not wait_for(lambda: ticking(3), deadline):
+            raise RuntimeError(
+                f"ingest chaos: fleet never ticked: {plane.ingest_status()}"
+            )
+
+        pre = plane.ingest_status()
+        owners = sorted({c["owner"] for c in pre.values()})
+        victim = owners[rng.randrange(len(owners))]
+        victim_clusters = sorted(
+            cid for cid, c in pre.items() if c["owner"] == victim
+        )
+        pre_ticks = {cid: pre[cid]["ticks"] for cid in pre}
+        # mid-sweep: ticks are flowing when the SIGKILL lands
+        plane.kill_worker(victim)
+
+        death_seen = wait_for(
+            lambda: any(
+                e["event"] == "worker_down"
+                and e["worker_id"] == victim
+                and e.get("class") == INGEST_FAULT_CLASS
+                for e in list(plane.events)
+            ),
+            deadline,
+        )
+
+        def moved() -> bool:
+            status = plane.ingest_status()
+            live = set(plane.live_workers())
+            return all(
+                status[cid]["owner"] in live
+                and status[cid]["owner"] != victim
+                and status[cid]["epoch"] > pre[cid]["epoch"]
+                and status[cid]["ticks"] >= pre_ticks[cid] + 2
+                for cid in victim_clusters
+            )
+
+        moved_ok = wait_for(moved, deadline)
+        mid = plane.ingest_status()
+
+        # rejoin: the respawned worker id must reclaim ITS clusters
+        plane.spawn_worker(victim, role="ingest")
+
+        def reclaimed() -> bool:
+            status = plane.ingest_status()
+            return all(
+                status[cid]["owner"] == victim
+                and status[cid]["ticks"] >= mid[cid]["ticks"] + 2
+                for cid in victim_clusters
+            )
+
+        reclaimed_ok = wait_for(reclaimed, deadline)
+        status = plane.ingest_status()
+        double = sum(c["double_applied"] for c in status.values())
+        stale = plane.ingest_stale
+        classes = plane.fault_classes_observed()
+        single_owner = all(
+            c["owner"] is not None for c in status.values()
+        )
+
+    ok = (
+        death_seen
+        and moved_ok
+        and reclaimed_ok
+        and single_owner
+        and double == 0
+        and INGEST_FAULT_CLASS in classes
+        and bool(victim_clusters)
+    )
+    return {
+        "ok": bool(ok),
+        "workers": workers,
+        "clusters": clusters,
+        "victim": victim,
+        "victim_clusters": victim_clusters,
+        "death_seen": bool(death_seen),
+        "moved_to_survivor": bool(moved_ok),
+        "rejoin_reclaimed": bool(reclaimed_ok),
+        "double_applied": double,
+        "stale_stats_dropped": stale,
+        "fault_classes_observed": classes,
+        "table": status,
     }

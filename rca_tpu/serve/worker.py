@@ -125,11 +125,17 @@ class WorkerAgent:
         engine_tag: str = "",
         rejoin_seed: Optional[int] = None,
         sleeper: Callable[[float], None] = time.sleep,
+        role: str = "serve",
     ):
         self.worker_id = int(worker_id)
         self.loop = loop
         self.clock = clock
         self.engine_tag = engine_tag
+        # worker class (ISSUE 17): "serve" joins the serve ring;
+        # "ingest" joins the ingest ring and hosts capture mirrors via
+        # the runner attached at `self.ingest`
+        self.role = str(role)
+        self.ingest = None
         # seeded per-worker: every fleet member jitters DIFFERENTLY, so
         # a mass lease expiry heals as a spread, not a stampede
         self._rejoin_rng = random.Random(
@@ -162,6 +168,7 @@ class WorkerAgent:
         msg = {
             "t": "hello", "proto": PROTO, "worker_id": self.worker_id,
             "pid": os.getpid(),
+            "role": self.role,
             "engine": self.engine_tag,
             "process_count": boot.get("process_count"),
             "process_index": boot.get("process_index"),
@@ -319,6 +326,9 @@ class WorkerAgent:
                 self.acks += 1
             elif t == "req":
                 self._on_request(msg)
+            elif t in ("ingest_assign", "ingest_unassign"):
+                if self.ingest is not None:
+                    self.ingest.handle(msg)
             elif t == "hang":
                 with self._lock:
                     self.hang_until = self.clock() + float(
@@ -327,6 +337,8 @@ class WorkerAgent:
             elif t == "drain":
                 with self._lock:
                     self.draining = True
+                if self.ingest is not None:
+                    self.ingest.stop()
                 deadline = self.clock() + REQUEST_TIMEOUT_S
                 while self.clock() < deadline:
                     with self._lock:
@@ -368,11 +380,27 @@ def main(argv=None) -> int:
                         help="coordinator control address")
     parser.add_argument("--worker-id", type=int, required=True,
                         dest="worker_id")
+    parser.add_argument("--role", choices=("serve", "ingest"),
+                        default="serve",
+                        help="worker class: serve (default) joins the "
+                             "serve ring; ingest hosts cluster capture "
+                             "mirrors (SERVING.md §Ingest workers)")
     args = parser.parse_args(argv)
     host, port = parse_hostport(args.connect, 0)
-    loop, tag = build_local_plane()
+    if args.role == "ingest":
+        # no engine, no serve plane: ingest workers never see requests
+        from rca_tpu.serve.ingest import NullServePlane
+
+        loop, tag = NullServePlane(), "ingest"
+    else:
+        loop, tag = build_local_plane()
     loop.start()
-    agent = WorkerAgent(args.worker_id, host, port, loop, engine_tag=tag)
+    agent = WorkerAgent(args.worker_id, host, port, loop, engine_tag=tag,
+                        role=args.role)
+    if args.role == "ingest":
+        from rca_tpu.serve.ingest import IngestRunner
+
+        agent.ingest = IngestRunner(agent)
     # the one stdout line: machine-parseable liveness for the procs
     # seam's capture (everything else goes to stderr)
     print(json.dumps({

@@ -239,7 +239,13 @@ def test_kernel_cache_path_accessor(monkeypatch):
     monkeypatch.setenv("RCA_KERNEL_CACHE", "/tmp/x.json")
     assert kernel_cache_path() == "/tmp/x.json"
     monkeypatch.delenv("RCA_KERNEL_CACHE")
-    assert kernel_cache_path().endswith("kernel_cache.json")
+    # the default is PLATFORM-KEYED (ISSUE 17): a CPU host and a TPU
+    # host must never overwrite each other's timed winners
+    from rca_tpu.config import kernel_platform
+
+    assert kernel_cache_path().endswith(
+        f"kernel_cache.{kernel_platform()}.json"
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,534 @@
+"""planetcap (ISSUE 17): live columnar ingestion + multi-cluster
+federated capture.
+
+Four claims under test:
+
+1. The LIVE columnar adapter (``LiveColumnarFeed``, the watch-pump path
+   the real ``K8sApiClient`` uses) is BIT-identical to the dict path
+   through ``extract_features`` under seeded churn — the same property
+   the mock's native columnar master is held to — and a cursor expiry
+   (the 410 analogue: the watch journal trimmed past the cursor) forces
+   a full rebuild with NO silent gap: changes made inside the expiry
+   window appear in the post-expiry payload.
+2. The merged multi-cluster world (``ClusterSet`` /
+   ``MergedClusterClient``) rejects identity collisions loudly, keeps
+   digests stable against member insertion order, and holds the same
+   columnar-vs-dict bit parity across cross-cluster churn.
+3. Multi-cluster recordings replay bit-identically at pipeline depths
+   1 AND 2 (the committed ``multicluster-3x20svc-seed17.rcz`` fixture).
+4. The ingest control plane applies each capture tick AT MOST once:
+   the coordinator's cluster table drops wrong-owner / stale-epoch /
+   replayed-seq stats, rendezvous assignment names exactly one owner
+   per cluster, and the runner resumes the dead owner's tick count.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from rca_tpu.cluster.clusterset import ClusterSet
+from rca_tpu.cluster.columnar import ColumnarClientState
+from rca_tpu.cluster.generator import synthetic_cascade_world
+from rca_tpu.cluster.live_columnar import LiveColumnarFeed
+from rca_tpu.cluster.mock_client import MockClusterClient
+from rca_tpu.cluster.snapshot import ClusterSnapshot
+from rca_tpu.cluster.world import make_pod
+from rca_tpu.features.extract import extract_features
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class LiveShim:
+    """A mock client whose ``get_columnar`` is the LIVE watch-pump
+    adapter instead of the mock's native columnar master — captures
+    through this pay what a real apiserver-backed ingest pays."""
+
+    def __init__(self, inner, ns):
+        self._inner = inner
+        self.feed = LiveColumnarFeed(inner, ns)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def get_columnar(self, namespace, cursor=None):
+        return self.feed.payload(cursor)
+
+    def close(self):
+        self.feed.close()
+
+
+def _fs_equal(a, b) -> bool:
+    return (
+        a.pod_names == b.pod_names
+        and a.service_names == b.service_names
+        and a.node_names == b.node_names
+        and a.pod_features.tobytes() == b.pod_features.tobytes()
+        and a.service_features.tobytes() == b.service_features.tobytes()
+        and a.node_features.tobytes() == b.node_features.tobytes()
+        and a.pod_service.tobytes() == b.pod_service.tobytes()
+        and a.pod_node.tobytes() == b.pod_node.tobytes()
+        and a.memb_pod.tobytes() == b.memb_pod.tobytes()
+        and a.memb_svc.tobytes() == b.memb_svc.tobytes()
+    )
+
+
+def _expire_watch(world) -> None:
+    """The 410 analogue for the mock watch feed: trim the journal past
+    every registered cursor, so the next drain reports ``expired``."""
+    world.journal.clear()
+    world.journal_floor = world.journal_seq + 2
+    world.journal_seq += 1
+
+
+def _churn(world, ns, rng, step):
+    """One seeded mutation drawn from the property domain: metric
+    touch, pod update, pod delete, pod add, NaN metric."""
+    pods = world.pods.get(ns, [])
+    op = int(rng.integers(0, 5))
+    if op == 0 and pods:
+        name = pods[int(rng.integers(0, len(pods)))]["metadata"]["name"]
+        world.touch("pod_metrics", ns, name)
+    elif op == 1 and pods:
+        pod = pods[int(rng.integers(0, len(pods)))]
+        pod["status"]["phase"] = (
+            "Failed" if pod["status"]["phase"] == "Running" else "Running"
+        )
+        world.touch("pod", ns, pod["metadata"]["name"])
+    elif op == 2 and len(pods) > 2:
+        pod = pods[int(rng.integers(0, len(pods)))]
+        name = pod["metadata"]["name"]
+        pods.remove(pod)
+        world.touch("pod", ns, name)
+    elif op == 3:
+        node = world.nodes[0]["metadata"]["name"]
+        name = f"clone-{step}"
+        world.add("pods", ns, make_pod(name, ns, app=f"clone{step}",
+                                       node_name=node))
+        world.touch("pod", ns, name)
+    else:
+        recs = (world.pod_metrics.get(ns) or {}).get("pods") or {}
+        if recs:
+            names = sorted(recs)
+            name = names[int(rng.integers(0, len(names)))]
+            # REPLACE the record (a real apiserver returns fresh parsed
+            # objects per call); an in-place mutation of the mock's
+            # aliased rec would be invisible to any snapshot differ
+            rec = recs[name]
+            recs[name] = {
+                **rec,
+                "cpu": {**rec["cpu"], "usage_percentage": float("nan")},
+            }
+            world.touch("pod_metrics", ns, name)
+
+
+# -- 1. the live adapter --------------------------------------------------
+
+
+def test_live_adapter_parity_property():
+    """Seeded churn property: capture through the LIVE adapter ==
+    capture through the dict path, bitwise, at every step — exactly the
+    gate the mock's native columnar master passes."""
+    ns = "live"
+    world = synthetic_cascade_world(14, n_roots=1, seed=5, namespace=ns,
+                                    pods_per_service=2)
+    client = LiveShim(MockClusterClient(world), ns)
+    state = ColumnarClientState()
+    rng = np.random.default_rng(17)
+    snap = ClusterSnapshot.capture(client, ns, columnar_state=state)
+    for step in range(24):
+        _churn(world, ns, rng, step)
+        snap = ClusterSnapshot.capture(
+            client, ns, columnar_state=state, traces_from=snap.traces,
+        )
+        fs_live = extract_features(snap)
+        snap_d = ClusterSnapshot.capture(
+            client._inner, ns, columnar=False, traces_from=snap.traces,
+        )
+        fs_dict = extract_features(snap_d)
+        assert _fs_equal(fs_live, fs_dict), (
+            f"live-vs-dict divergence at churn step {step}"
+        )
+    client.close()
+
+
+def test_cursor_expiry_rebuilds_without_gap():
+    """The 410 leg: changes made while the watch journal was trimmed
+    past the feed's cursor must appear in the post-expiry payload —
+    expiry means FULL REBUILD, never a silent gap."""
+    ns = "gap"
+    world = synthetic_cascade_world(10, n_roots=1, seed=3, namespace=ns)
+    client = LiveShim(MockClusterClient(world), ns)
+    state = ColumnarClientState()
+    snap = ClusterSnapshot.capture(client, ns, columnar_state=state)
+    resyncs_before = client.feed.resyncs
+
+    # mutate INSIDE the expiry window: a pod flips to Failed and one is
+    # deleted, then the journal is trimmed past the feed's cursor
+    victim = world.pods[ns][0]
+    victim["status"]["phase"] = "Failed"
+    world.touch("pod", ns, victim["metadata"]["name"])
+    gone = world.pods[ns][1]
+    world.pods[ns].remove(gone)
+    world.touch("pod", ns, gone["metadata"]["name"])
+    _expire_watch(world)
+
+    snap = ClusterSnapshot.capture(
+        client, ns, columnar_state=state, traces_from=snap.traces,
+    )
+    fs_live = extract_features(snap)
+    snap_d = ClusterSnapshot.capture(
+        client._inner, ns, columnar=False, traces_from=snap.traces,
+    )
+    fs_dict = extract_features(snap_d)
+    assert client.feed.resyncs == resyncs_before + 1, (
+        "expiry must force exactly one full re-list reconcile"
+    )
+    assert gone["metadata"]["name"] not in fs_live.pod_names
+    assert _fs_equal(fs_live, fs_dict), (
+        "post-expiry capture diverged from the dict path — the rebuild "
+        "left a gap"
+    )
+    client.close()
+
+
+def test_expired_external_cursor_serves_full_dump():
+    """A consumer holding a pre-expiry cursor gets a FULL payload after
+    the feed rebuilt — not an empty diff (the silent-gap failure)."""
+    ns = "cur"
+    world = synthetic_cascade_world(8, n_roots=1, seed=2, namespace=ns)
+    feed = LiveColumnarFeed(MockClusterClient(world), ns)
+    first = feed.payload(None)
+    assert first.get("supported") and first.get("full")
+    cursor = first["cursor"]
+    world.touch("pod_metrics", ns,
+                world.pods[ns][0]["metadata"]["name"])
+    _expire_watch(world)
+    p = feed.payload(cursor)
+    assert p.get("supported")
+    assert p.get("full"), (
+        "stale cursor after expiry must be answered with a full dump"
+    )
+    feed.close()
+
+
+# -- 2. the merged multi-cluster world ------------------------------------
+
+
+def _three_cluster_set(seed=17, services=6):
+    worlds = {
+        f"c{j}": synthetic_cascade_world(
+            services, n_roots=1, seed=seed + j, namespace="synthetic",
+        )
+        for j in range(3)
+    }
+    cset = ClusterSet({
+        cid: MockClusterClient(w) for cid, w in worlds.items()
+    })
+    return worlds, cset
+
+
+def test_namespace_collision_rejected():
+    world = synthetic_cascade_world(4, n_roots=1, seed=0,
+                                    namespace="synthetic")
+    with pytest.raises(ValueError, match="cluster id"):
+        ClusterSet({"a/b": MockClusterClient(world)})
+    with pytest.raises(ValueError, match="cluster id"):
+        ClusterSet({"": MockClusterClient(world)})
+    with pytest.raises(ValueError, match="cluster id"):
+        ClusterSet({" c0": MockClusterClient(world)})
+
+    # a member NAMESPACE carrying the separator would alias another
+    # cluster's prefixed path: rejected at every merged surface
+    bad = synthetic_cascade_world(4, n_roots=1, seed=0,
+                                  namespace="evil/synthetic")
+    cset = ClusterSet({"c0": MockClusterClient(bad)})
+    with pytest.raises(ValueError, match="alias"):
+        cset.namespaces()
+    with pytest.raises(ValueError, match="alias"):
+        cset.merged_client().get_namespaces()
+
+
+def test_digest_stability_and_sensitivity():
+    worlds, cset = _three_cluster_set()
+    # member INSERTION order must not move any digest
+    reordered = ClusterSet({
+        cid: cset.members[cid] for cid in ("c2", "c0", "c1")
+    })
+    assert cset.graph_digest() == reordered.graph_digest()
+    for cid in cset.ids:
+        assert cset.cluster_digest(cid) == reordered.cluster_digest(cid)
+
+    # pod churn (metrics, status) must not move the TOPOLOGY digest
+    before = cset.cluster_digest("c0")
+    worlds["c0"].touch(
+        "pod_metrics", "synthetic",
+        worlds["c0"].pods["synthetic"][0]["metadata"]["name"],
+    )
+    assert cset.cluster_digest("c0") == before
+
+    # a topology change (new service) MUST move that cluster's digest
+    # and the graph digest, and leave the siblings' digests alone
+    sib = cset.cluster_digest("c1")
+    graph = cset.graph_digest()
+    from rca_tpu.cluster.world import make_service
+
+    worlds["c0"].add("services", "synthetic",
+                     make_service("svc-new", "synthetic", {"app": "new"}))
+    worlds["c0"].touch("service", "synthetic", "svc-new")
+    assert cset.cluster_digest("c0") != before
+    assert cset.graph_digest() != graph
+    assert cset.cluster_digest("c1") == sib
+
+
+def test_merged_columnar_parity_under_cross_cluster_churn():
+    """The merged view's live columnar feed vs the merged dict path,
+    bitwise, through cross-cluster churn — including a pod ADD (the
+    mid-list insert that forces the reorder+rebuild path) and deletes."""
+    worlds, cset = _three_cluster_set()
+    merged = cset.merged_client()
+    ns = "synthetic"
+    state = ColumnarClientState()
+    snap = ClusterSnapshot.capture(merged, ns, columnar_state=state)
+    rng = np.random.default_rng(7)
+    for step in range(12):
+        cid = f"c{step % 3}"
+        _churn(worlds[cid], ns, rng, step)
+        snap = ClusterSnapshot.capture(
+            merged, ns, columnar_state=state, traces_from=snap.traces,
+        )
+        fs_col = extract_features(snap)
+        snap_d = ClusterSnapshot.capture(
+            merged, ns, columnar=False, traces_from=snap.traces,
+        )
+        fs_dict = extract_features(snap_d)
+        assert _fs_equal(fs_col, fs_dict), (
+            f"merged live-vs-dict divergence at step {step} ({cid})"
+        )
+    # every pod name is cluster-prefixed and service edges stay local
+    assert all("/" in n for n in fs_dict.pod_names)
+    deps = merged.get_service_dependencies(ns)
+    for src, dsts in deps.items():
+        scid = src.split("/", 1)[0]
+        assert all(d.split("/", 1)[0] == scid for d in dsts), (
+            f"cross-cluster edge leaked from {src}"
+        )
+    merged.close()
+
+
+# -- 3. multi-cluster replay ----------------------------------------------
+
+
+FIXTURE = os.path.join(
+    REPO_ROOT, "tests", "corpus", "multicluster-3x20svc-seed17.rcz"
+)
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_multicluster_fixture_replays_at_depth(depth):
+    """The committed merged-capture fixture holds bit parity when
+    replayed at pipeline depth 1 AND depth 2 — pipelining must not
+    move a bit on multi-cluster frames any more than single-cluster."""
+    from rca_tpu.replay import replay_stream
+
+    report = replay_stream(FIXTURE, pipeline_depth=depth)
+    assert report["parity_ok"], {
+        k: report.get(k)
+        for k in ("first_divergent_tick", "mismatched_ticks")
+    }
+    assert report["pipeline_depth_replayed"] == depth
+    assert report["ticks_replayed"] == report["ticks_recorded"]
+
+
+# -- 4. the ingest control plane ------------------------------------------
+
+
+class _FakeConn:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+
+def _ingest_handle(plane, wid):
+    from rca_tpu.serve.federation import _WorkerHandle
+
+    w = _WorkerHandle(wid)
+    w.role = "ingest"
+    w.live = True
+    w.conn = _FakeConn()
+    plane.workers[wid] = w
+    plane.ingest_ring.add(wid)
+    return w
+
+
+def test_ingest_rebalance_single_owner_moves_and_reclaims():
+    from rca_tpu.serve.federation import FederationPlane
+
+    plane = FederationPlane(workers=0, spawn_workers=False)
+    w1, w2 = _ingest_handle(plane, 1), _ingest_handle(plane, 2)
+    plane.register_clusters({
+        f"k{i}": {"digest": f"d{i}"} for i in range(6)
+    })
+    owners = {cid: e["owner"] for cid, e in plane.clusters.items()}
+    assert set(owners.values()) <= {1, 2}
+    assert all(e["epoch"] == 1 for e in plane.clusters.values())
+    assigns = [m for m in w1.conn.sent + w2.conn.sent
+               if m["t"] == "ingest_assign"]
+    assert len(assigns) == 6 and all(
+        m["resume_seq"] == 0 for m in assigns
+    )
+
+    # the owner dies: every orphan moves to the one survivor with a
+    # fresh epoch and the last applied seq as resume point; the corpse
+    # gets no unassign frame
+    mine = sorted(c for c, o in owners.items() if o == 1)
+    assert mine, "rendezvous should spread 6 clusters over 2 workers"
+    for cid in mine:
+        plane.clusters[cid]["last_seq"] = 41
+    plane.ingest_ring.remove(1)
+    w1.live = False
+    dead_frames = len(w1.conn.sent)
+    plane._ingest_rebalance()
+    for cid in mine:
+        ent = plane.clusters[cid]
+        assert ent["owner"] == 2 and ent["epoch"] == 2
+    assert len(w1.conn.sent) == dead_frames
+    resumed = [m for m in w2.conn.sent
+               if m["t"] == "ingest_assign" and m["cluster"] in mine]
+    assert all(m["resume_seq"] == 41 for m in resumed)
+
+    # rejoin: HRW stickiness hands back exactly the clusters it owned
+    w1.live = True
+    plane.ingest_ring.add(1)
+    plane._ingest_rebalance()
+    now_mine = sorted(
+        c for c, e in plane.clusters.items() if e["owner"] == 1
+    )
+    assert now_mine == mine
+
+
+def test_ingest_stat_exactly_once_arbiter():
+    from rca_tpu.serve.federation import FederationPlane, _WorkerHandle
+
+    plane = FederationPlane(workers=0, spawn_workers=False)
+    owner = _WorkerHandle(1)
+    deposed = _WorkerHandle(2)
+    plane.clusters["c"] = {
+        "digest": "d", "spec": {}, "owner": 1, "epoch": 3,
+        "last_seq": 10, "ticks": 0, "double_applied": 0, "moves": 0,
+        "sweep_ms": None, "coldiff_bytes": None,
+    }
+
+    def stat(w, epoch, seq):
+        plane._on_ingest_stat(w, {
+            "cluster": "c", "epoch": epoch, "tick_seq": seq,
+            "sweep_ms": 1.5, "coldiff_bytes": 64,
+        })
+
+    stat(owner, 3, 11)                 # applied
+    ent = plane.clusters["c"]
+    assert ent["ticks"] == 1 and ent["last_seq"] == 11
+    assert ent["sweep_ms"] == 1.5 and ent["coldiff_bytes"] == 64
+    stat(owner, 3, 11)                 # replayed seq -> double counted
+    assert ent["double_applied"] == 1 and ent["ticks"] == 1
+    stat(owner, 2, 12)                 # stale epoch -> dropped
+    stat(deposed, 3, 12)               # wrong owner -> dropped
+    assert plane.ingest_stale == 2
+    assert ent["last_seq"] == 11 and ent["ticks"] == 1
+    stat(owner, 3, 12)                 # next seq applies exactly once
+    assert ent["ticks"] == 2 and ent["last_seq"] == 12
+    status = plane.ingest_status()
+    assert status["c"]["double_applied"] == 1
+    assert "spec" not in status["c"]
+
+
+def test_ingest_runner_resumes_seq_and_reports():
+    from rca_tpu.serve.ingest import IngestRunner
+
+    agent = SimpleNamespace(worker_id=9, conn=_FakeConn())
+    runner = IngestRunner(agent, tick_s=0.01)
+    try:
+        runner.handle({
+            "t": "ingest_assign", "cluster": "k0", "epoch": 4,
+            "resume_seq": 7,
+            "spec": {"services": 4, "seed": 1, "namespace": "synthetic"},
+        })
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if len(agent.conn.sent) >= 3:
+                break
+            time.sleep(0.02)
+        frames = [m for m in agent.conn.sent if m["t"] == "ingest_stat"]
+        assert len(frames) >= 3, "runner never ticked"
+        # resume semantics: the count CONTINUES the dead owner's seq
+        assert frames[0]["tick_seq"] == 8
+        seqs = [m["tick_seq"] for m in frames]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert all(m["cluster"] == "k0" and m["epoch"] == 4
+                   for m in frames)
+        assert all(m["coldiff_bytes"] > 0 for m in frames)
+        assert all(
+            isinstance(m["sweep_ms"], float) and m["sweep_ms"] >= 0
+            and math.isfinite(m["sweep_ms"]) for m in frames
+        )
+
+        runner.handle({"t": "ingest_unassign", "cluster": "k0"})
+        time.sleep(0.05)
+        n = len([m for m in agent.conn.sent if m["t"] == "ingest_stat"])
+        time.sleep(0.1)
+        after = len(
+            [m for m in agent.conn.sent if m["t"] == "ingest_stat"]
+        )
+        assert after <= n + 1, "unassigned cluster kept ticking"
+    finally:
+        runner.stop()
+
+
+# -- 5. the platform-keyed shipped kernel cache ---------------------------
+
+
+def test_shipped_kernel_cache_fallback(monkeypatch, tmp_path):
+    """Cold start with no user cache reads the committed
+    ``kernel_cache.<platform>.json``; a present user cache wins; a
+    stale-header shipped cache re-times instead of poisoning."""
+    from rca_tpu.engine.registry import KERNELS, KernelRegistry
+
+    shipped = tmp_path / "shipped.json"
+    winner = KERNELS[0]
+    writer = KernelRegistry(cache_path=str(shipped))
+    writer._store_cached("dense|64|cpu|", SimpleNamespace(
+        winner=winner, timings_ms={winner: 1.0}, cost=None,
+    ))
+    assert shipped.exists()
+    monkeypatch.setattr(
+        "rca_tpu.config.shipped_kernel_cache_path",
+        lambda: str(shipped),
+    )
+
+    # user cache missing -> the shipped row answers
+    reg = KernelRegistry(cache_path=str(tmp_path / "user.json"))
+    row = reg._load_cached("dense|64|cpu|")
+    assert row is not None and row["winner"] == winner
+
+    # user cache present -> it wins over the shipped row
+    other = KERNELS[1]
+    reg._store_cached("dense|64|cpu|", SimpleNamespace(
+        winner=other, timings_ms={other: 0.5}, cost=None,
+    ))
+    row = reg._load_cached("dense|64|cpu|")
+    assert row is not None and row["winner"] == other
+
+    # stale shipped header (kernel edit / other platform): re-time
+    data = json.loads(shipped.read_text())
+    data["kernel_set"] = "stale"
+    shipped.write_text(json.dumps(data))
+    reg2 = KernelRegistry(cache_path=str(tmp_path / "nope.json"))
+    assert reg2._load_cached("dense|64|cpu|") is None

@@ -66,6 +66,17 @@ HEADLINE_METRICS = {
     "autoscale_scale_decision_ms_p50": (
         "serve_autoscale", "scale_decision_ms_p50",
     ),
+    # planetcap (ISSUE 17): the 1M-pod soak's steady sweep tick p99 and
+    # the quiet-drain p99 — a regression in either means federated
+    # capture got slower at planet scale (the quiet drain is what every
+    # no-change poll pays, so it is gated separately from the sweep).
+    # Absent in rounds before 17: skipped, never failed.
+    "planet_sweep_tick_ms_p99": (
+        "planet_capture", "sweep_tick_ms_p99",
+    ),
+    "planet_quiet_tick_ms_p99": (
+        "planet_capture", "quiet_tick_ms_p99",
+    ),
 }
 
 #: metrics gated TIGHTER than the default threshold, name -> (path,

@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Generate the committed platform-keyed kernel winner cache (ISSUE 17).
+
+``rca_tpu/engine/kernel_cache.<platform>.json`` ships the autotune
+winners for the canonical shape buckets so a fleet worker's first
+resolve of a shape serves a seeded row instead of paying the timing
+race cold (``KernelRegistry._load_cached`` falls back to the shipped
+file when the user cache has no row).  The file is ordinary cache
+format — same ``_CACHE_VERSION`` / jax-version / ``kernel_set_hash``
+header, so a jax upgrade or kernel edit invalidates it wholesale and
+the fleet re-times rather than serving stale verdicts.
+
+Run on the target platform after any kernel change::
+
+    JAX_PLATFORMS=cpu python tools/gen_kernel_cache.py
+
+and commit the refreshed ``kernel_cache.<platform>.json``.  Timing is
+the registry's own harness (``_time_candidates`` over the full
+propagation chain), so shipped rows are bit-for-bit what a live
+autotune would have decided on this host class.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None,
+                    help="cache file to write (default: the shipped "
+                         "platform-keyed path)")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated n_pad buckets (default: the "
+                         "config shape buckets)")
+    ap.add_argument("--edge-tiers", default="1,2",
+                    help="e_pad multipliers per bucket (default 1,2: "
+                         "ring-sparse and 2x-dense edge tiers)")
+    args = ap.parse_args()
+
+    from rca_tpu.config import RCAConfig, shipped_kernel_cache_path
+    from rca_tpu.engine.pallas_kernels import pallas_supported
+    from rca_tpu.engine.registry import (
+        KERNELS, KernelRegistry, KernelRow, _backend, _eligibility,
+        _pick_winner, _segscan_min, _time_candidates,
+    )
+
+    out = args.out or shipped_kernel_cache_path()
+    backend = _backend()
+    steps = int(args.steps)
+    if args.buckets:
+        buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    else:
+        buckets = list(RCAConfig().shape_buckets)
+    tiers = [int(t) for t in args.edge_tiers.split(",") if t.strip()]
+
+    # write THROUGH the registry's own store path so the header (cache
+    # version, jax version, kernel-set hash) and the atomic-replace
+    # discipline are exactly what _read_cache_rows validates
+    reg = KernelRegistry(cache_path=out)
+    stored = 0
+    for n_pad in buckets:
+        for tier in tiers:
+            e_pad = n_pad * tier
+            eligible = _eligibility("dense", n_pad, e_pad, steps)
+            candidates = [k for k in KERNELS if eligible.get(k) is True]
+            if "pallas" in candidates and not pallas_supported():
+                candidates.remove("pallas")
+            if "segscan" in candidates and n_pad < _segscan_min():
+                candidates.remove("segscan")
+            if candidates == ["xla"]:
+                continue  # nothing to race — the default row needs no seed
+            timings = _time_candidates(n_pad, e_pad, steps, candidates)
+            row = KernelRow(
+                variant="dense", n_pad=n_pad, e_pad=e_pad, steps=steps,
+                backend=backend, winner=_pick_winner(timings),
+                source="timed", eligible=eligible, timings_ms=timings,
+            )
+            key = f"dense:{n_pad}:{e_pad}:{steps}:{backend}"
+            reg._store_cached(key, row)
+            stored += 1
+            print(f"  {key:<28} winner={row.winner:<9} "
+                  f"{ {k: v for k, v in timings.items()} }")
+
+    # round-trip through the validating reader — a header mismatch here
+    # means the file would be dead weight in the tree
+    rows = KernelRegistry._read_cache_rows(out)
+    if stored and not rows:
+        print(f"FATAL: {out} failed its own header validation", file=sys.stderr)
+        return 1
+    with open(out, encoding="utf-8") as f:
+        size = len(f.read())
+    print(f"wrote {out}: {len(rows or {})} rows, {size} bytes "
+          f"(backend={backend}, jax pinned in header)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
