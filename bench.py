@@ -541,13 +541,19 @@ def lint_metrics() -> dict:
 
     from rca_tpu.analysis import run_lint
     from rca_tpu.analysis.concurrency import model_for, rsan
-    from rca_tpu.analysis.core import repo_root
+    from rca_tpu.analysis.core import parse_cache_stats, repo_root
 
+    pc0 = parse_cache_stats()
     result = run_lint()
     top3 = sorted(result.per_rule_ms.items(), key=lambda kv: -kv[1])[:3]
 
     model = model_for(repo_root())
     stats = model.stats()
+    # shared-parse-cache effectiveness across the lint + model build
+    # (ISSUE 19 satellite: one ast.parse per file per run)
+    pc1 = parse_cache_stats()
+    pc_hits = pc1["hits"] - pc0["hits"]
+    pc_misses = pc1["misses"] - pc0["misses"]
 
     # rsan overhead: uncontended acquire/release, bare vs sanitized
     def time_lock(lock, n=20_000):
@@ -573,6 +579,9 @@ def lint_metrics() -> dict:
         "wall_ms": round(result.wall_ms, 1),
         "files": result.files_scanned,
         "findings": len(result.findings),
+        "parse_cache_hit_rate": round(
+            pc_hits / max(pc_hits + pc_misses, 1), 3
+        ),
         "slowest_rules": [
             {"rule": name, "ms": round(ms, 1)} for name, ms in top3
         ],

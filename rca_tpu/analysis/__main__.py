@@ -5,8 +5,12 @@ Exit-code contract (stable for CI):
 - **0** — no findings (suppressed/baselined hits do not count); with
   ``--tracecheck``, additionally no second-call recompilation; with
   ``--rsan``, additionally a clean runtime cross-check (no order
-  contradictions, no observed races, stress totals exact);
-- **1** — findings (or a tracecheck recompile, or an rsan failure);
+  contradictions, no observed races, stress totals exact); with
+  ``--specsan``, additionally every observed device fetch unifies with
+  the graftspec contract tables; ``--all`` = all of the above in one
+  run with a single JSON summary;
+- **1** — findings (or a tracecheck recompile, or an rsan/specsan
+  failure);
 - **2** — usage or internal error (unknown rule, malformed baseline,
   ``--changed`` mixed with explicit paths).
 
@@ -89,6 +93,16 @@ def build_parser() -> argparse.ArgumentParser:
                    "sanitized multi-thread stress whose observed lock "
                    "orders and access pairs must agree with the static "
                    "concurrency model (ANALYSIS.md)")
+    p.add_argument("--specsan", action="store_true",
+                   help="also run the graftspec runtime cross-check: a "
+                   "seeded engine session + serve selftest with every "
+                   "device_get instrumented; observed transfer shapes/"
+                   "dtypes/bytes must unify with the FETCH_BUDGETS "
+                   "contract tables (ANALYSIS.md §graftspec)")
+    p.add_argument("--all", action="store_true", dest="run_all",
+                   help="the full gate: default rules + --tracecheck + "
+                   "--rsan + --specsan in one run, one summary, one "
+                   "exit code")
     p.add_argument("--root", default=None, help=argparse.SUPPRESS)
     return p
 
@@ -96,6 +110,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     root = args.root or repo_root()
+    if args.run_all:
+        args.tracecheck = args.rsan = args.specsan = True
 
     if args.list_rules:
         rules = all_rules()
@@ -169,6 +185,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         rsan_report = run_rsan_crosscheck(root=root)
 
+    specsan_report = None
+    if args.specsan:
+        from rca_tpu.analysis.dataplane.specsan import run_specsan
+        from rca_tpu.config import env_int
+
+        specsan_report = run_specsan(
+            root=root,
+            seed=env_int("RCA_SPECSAN_SEED", 0, 0, 2**31 - 1),
+            n_requests=env_int("RCA_SPECSAN_REQUESTS", 8, 1, 10_000),
+        )
+
     if args.as_json:
         out = result.to_dict()
         if changed is not None:
@@ -179,6 +206,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if rsan_report is not None:
             out["rsan"] = rsan_report
             out["clean"] = out["clean"] and rsan_report["ok"]
+        if specsan_report is not None:
+            from rca_tpu.analysis.dataplane.specsan import confirm_findings
+
+            confirm_findings(out["findings"], specsan_report)
+            out["specsan"] = specsan_report
+            out["clean"] = out["clean"] and specsan_report["ok"]
         print(json.dumps(out))
         return 0 if out["clean"] else 1
 
@@ -225,8 +258,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                          else "NOT statically predicted — model gap")
             print(f"rsan: OBSERVED RACE {race['owner']}.{race['attr']} "
                   f"between {', '.join(race['threads'])} ({predicted})")
+    if specsan_report is not None:
+        s = specsan_report
+        print(f"specsan: {'ok' if s['ok'] else 'FAILED'} "
+              f"[{s['fetches']} fetches over "
+              f"{len(s['surfaces_confirmed'])} budgeted surface(s), "
+              f"{len(s['violations'])} violation(s), "
+              f"serve {'ok' if s['serve']['ok'] else 'FAILED'}, "
+              f"{s['wall_ms']:.0f} ms]")
+        for v in s["violations"]:
+            detail = {
+                "unmatched_roles": "leaves do not unify with declared "
+                                   "roles",
+                "over_budget": "transfer exceeds the declared byte "
+                               "budget",
+                "unaudited": "device_get outside the allowlisted "
+                             "functions of an audited module",
+            }.get(v["kind"], v["kind"])
+            print(f"specsan: {v['kind'].upper()} at {v['surface']}: "
+                  f"{detail} (shapes {v['shapes']}, dtypes "
+                  f"{v['dtypes']}, {v['nbytes']} B)")
     clean = (result.clean and (trace is None or trace["ok"])
-             and (rsan_report is None or rsan_report["ok"]))
+             and (rsan_report is None or rsan_report["ok"])
+             and (specsan_report is None or specsan_report["ok"]))
     print(f"graftlint: {'clean' if clean else 'FAILED'} ({counts})")
     return 0 if clean else 1
 

@@ -946,12 +946,15 @@ def model_for(root: str) -> ConcurrencyModel:
     cached = _CACHE.get(root)
     if cached is not None and cached[0] == key:
         return cached[1]
+    from rca_tpu.analysis.core import parse_file
+
     parsed: List[Tuple[str, ast.AST]] = []
     for f in files:
         rel = os.path.relpath(f, root).replace(os.sep, "/")
         try:
-            with open(f, encoding="utf-8") as fh:
-                parsed.append((rel, ast.parse(fh.read(), filename=rel)))
+            # shared parse cache: one ast.parse per file per lint run,
+            # even though graftlint's runner walks the same trees
+            parsed.append((rel, parse_file(f)[1]))
         except (SyntaxError, OSError):
             continue  # the core runner reports parse errors itself
     model = ConcurrencyModel(root, parsed)

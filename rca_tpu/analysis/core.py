@@ -43,6 +43,40 @@ SCAN_FILES = ("bench.py",)
 _SUPPRESS_LINE = re.compile(r"#\s*graftlint:\s*disable=([\w,\- ]+)")
 _SUPPRESS_FILE = re.compile(r"^\s*#\s*graftlint:\s*disable-file=([\w,\- ]+)")
 
+#: process-wide shared parse cache: ONE ast.parse per file per run even
+#: though graftlint, the concurrency model, and the dataplane analyzer
+#: all walk the same files.  Keyed by absolute path, validated by
+#: (mtime_ns, size) so an edited file reparses.  Single-threaded by
+#: design (the lint is sequential; a stale double-parse is the only
+#: failure mode anyway).  Trees served from here are SHARED — callers
+#: must treat them as read-only.
+_PARSE_CACHE: Dict[str, Tuple[Tuple[int, int], str, ast.AST]] = {}
+_PARSE_STATS = {"hits": 0, "misses": 0}
+
+
+def parse_file(full: str) -> Tuple[str, ast.AST]:
+    """(source, tree) for ``full`` via the shared cache.  SyntaxError /
+    OSError propagate to the caller, exactly like the direct parse."""
+    full = os.path.abspath(full)
+    st = os.stat(full)
+    key = (st.st_mtime_ns, st.st_size)
+    hit = _PARSE_CACHE.get(full)
+    if hit is not None and hit[0] == key:
+        _PARSE_STATS["hits"] += 1
+        return hit[1], hit[2]
+    _PARSE_STATS["misses"] += 1
+    with open(full, encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=full)
+    _PARSE_CACHE[full] = (key, source, tree)
+    return source, tree
+
+
+def parse_cache_stats() -> Dict[str, int]:
+    """Cumulative process-wide hit/miss counters (bench reads the delta
+    around a lint+model run to report ``parse_cache_hit_rate``)."""
+    return dict(_PARSE_STATS)
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -233,14 +267,22 @@ def update_index(root: str, files: Sequence[str]) -> None:
             idx[rel] = _sha1_file(full)
         except OSError:
             idx.pop(rel, None)
+    # atomic publish: write a sibling temp file and rename over the
+    # index, so a crash mid-write leaves the previous index intact
+    # (readers never observe a torn JSON document)
+    tmp = index_path(root) + f".tmp.{os.getpid()}"
     try:
         os.makedirs(os.path.dirname(index_path(root)), exist_ok=True)
-        with open(index_path(root), "w", encoding="utf-8") as f:
+        with open(tmp, "w", encoding="utf-8") as f:
             json.dump({"version": 1, "files": idx}, f, indent=0,
                       sort_keys=True)
             f.write("\n")
+        os.replace(tmp, index_path(root))
     except OSError:
-        pass
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _git_dirty(root: str) -> Set[str]:
@@ -298,6 +340,8 @@ class LintResult:
     files_scanned: int
     wall_ms: float
     per_rule_ms: Dict[str, float]
+    #: shared-parse-cache hits/misses attributable to this run
+    parse_cache: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def clean(self) -> bool:
@@ -315,6 +359,7 @@ class LintResult:
             "per_rule_ms": {
                 k: round(v, 3) for k, v in sorted(self.per_rule_ms.items())
             },
+            "parse_cache": dict(self.parse_cache),
         }
 
 
@@ -381,6 +426,7 @@ def run_lint(
     raw: List[Finding] = []
     suppressed = 0
     per_rule_ms: Dict[str, float] = {r.name: 0.0 for r in selected}
+    pc0 = parse_cache_stats()
     files = discover_files(root, paths)
     for rel in files:
         full = os.path.join(root, rel)
@@ -388,9 +434,7 @@ def run_lint(
         if not applicable:
             continue
         try:
-            with open(full, encoding="utf-8") as f:
-                source = f.read()
-            tree = ast.parse(source, filename=rel)
+            source, tree = parse_file(full)
         except (SyntaxError, OSError) as exc:
             lineno = getattr(exc, "lineno", 0) or 0
             raw.append(Finding(
@@ -444,8 +488,10 @@ def run_lint(
         ]
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    pc1 = parse_cache_stats()
     return LintResult(
         findings=findings, suppressed=suppressed, baselined=baselined,
         stale_baseline=stale, files_scanned=len(files),
         wall_ms=(time.perf_counter() - t0) * 1e3, per_rule_ms=per_rule_ms,
+        parse_cache={k: pc1[k] - pc0[k] for k in ("hits", "misses")},
     )

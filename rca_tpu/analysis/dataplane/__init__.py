@@ -1,0 +1,19 @@
+"""graftspec: static shape/dtype/donation contracts for the jit seams,
+plus the specsan runtime cross-check (ANALYSIS.md §graftspec).
+
+- :mod:`~rca_tpu.analysis.dataplane.contracts` — the declarative tables
+  (jit signatures, dtype scopes, quantitative fetch budgets);
+- :mod:`~rca_tpu.analysis.dataplane.absint` — the symbolic (shape,
+  dtype) abstract interpreter the rules prove against;
+- :mod:`~rca_tpu.analysis.dataplane.specsan` — the runtime half: run
+  real engine + serve work with every ``device_get`` instrumented and
+  diff the observed transfers against the static contract model
+  (``rca lint --specsan``).
+"""
+
+from rca_tpu.analysis.dataplane import absint, contracts  # noqa: F401
+from rca_tpu.analysis.dataplane.specsan import (  # noqa: F401
+    capture,
+    confirm_findings,
+    run_specsan,
+)

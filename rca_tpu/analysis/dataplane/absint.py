@@ -1,0 +1,473 @@
+"""graftspec abstract interpreter: symbolic (shape, dtype) facts over
+jnp/lax expressions (ANALYSIS.md §graftspec).
+
+A deliberately honest interpreter: every construct it does not model
+evaluates to :data:`~rca_tpu.analysis.dataplane.contracts.UNKNOWN`, and
+checks downstream only ever fire on KNOWN facts — so a gap in the op
+table costs coverage, never a false positive.  Dims are ints (exact) or
+symbol names (``"n_pad"``); ``None`` dims are wildcards.
+
+The op table covers exactly the vocabulary the ranked executables use:
+``propagate_auto`` and friends via :data:`SEMANTICS` (signature-level
+summaries — the propagation core itself is covered by its own tests),
+``jnp.stack`` / ``lax.top_k`` / ``topk_diag`` / ``.at[].set`` /
+indexing / elementwise arithmetic with broadcast + dtype promotion.
+Promotions between a low-precision dtype and float32 are recorded as
+events for the ``dtype-discipline`` rule; casts likewise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from rca_tpu.analysis.dataplane.contracts import (
+    Fact,
+    LOW_PRECISION_DTYPES,
+    UNKNOWN,
+)
+
+Dims = Tuple[Optional[Union[int, str]], ...]
+
+_DTYPE_NAMES = frozenset({
+    "float32", "float64", "float16", "bfloat16", "int8", "int16",
+    "int32", "int64", "uint8", "uint32", "bool_",
+} | LOW_PRECISION_DTYPES)
+
+_ELEMENTWISE = frozenset({
+    "maximum", "minimum", "where", "abs", "exp", "log", "log1p", "clip",
+    "nan_to_num", "sqrt", "square", "tanh", "sigmoid", "relu", "add",
+    "subtract", "multiply", "divide", "power", "logical_and",
+    "logical_or", "logical_not", "isfinite", "isnan",
+})
+
+_REDUCTIONS = frozenset({"sum", "prod", "max", "min", "mean", "all", "any"})
+
+
+def dtype_of_node(node: ast.expr) -> Optional[str]:
+    """The dtype a ``jnp.float32`` / ``np.int8`` style reference names,
+    else None."""
+    if isinstance(node, ast.Attribute) and node.attr in _DTYPE_NAMES:
+        return "bool" if node.attr == "bool_" else node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _DTYPE_NAMES else None
+    return None
+
+
+def promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    if a is None:
+        return b
+    if b is None or a == b:
+        return a
+    order = ("bool", "int8", "uint8", "int16", "int32", "int64",
+             "bfloat16", "float16", "float32", "float64")
+    if a in order and b in order:
+        return max(a, b, key=order.index)
+    return None
+
+
+def broadcast(a: Optional[Dims], b: Optional[Dims]) -> Optional[Dims]:
+    if a is None or b is None:
+        return None
+    if len(a) < len(b):
+        a, b = b, a
+    pad: Dims = (1,) * (len(a) - len(b)) + tuple(b)
+    out = []
+    for da, db in zip(a, pad):
+        if da == 1:
+            out.append(db)
+        elif db == 1 or db == da or db is None:
+            out.append(da)
+        elif da is None:
+            out.append(db)
+        else:
+            return None  # statically incompatible; stay silent here
+    return tuple(out)
+
+
+class Events:
+    """What the walk observed, for the dtype/shape rules to judge."""
+
+    def __init__(self) -> None:
+        #: (lineno, to_dtype) for every explicit cast/typed constructor
+        self.casts: List[Tuple[int, str]] = []
+        #: (lineno, dtype_a, dtype_b) for every mixed-precision binop
+        self.promotions: List[Tuple[int, str, str]] = []
+
+
+FactLike = Union[Fact, Tuple["FactLike", ...]]
+
+#: name -> summary(arg_facts) for the engine functions the executables
+#: call: propagate_* return five [n_pad] float32 vectors (n_pad = the
+#: feature buffer's leading dim), finite_mask_rows passes its input
+#: through plus a scalar count, topk_diag gathers [lead, *idx.shape]
+SEMANTICS: Dict[str, Callable[[List[FactLike]], FactLike]] = {}
+
+
+def _sem(name):
+    def deco(fn):
+        SEMANTICS[name] = fn
+        return fn
+    return deco
+
+
+def _first_dim(fact: FactLike):
+    return fact.shape[0] if isinstance(fact, Fact) and fact.shape else None
+
+
+@_sem("propagate_auto")
+@_sem("propagate")
+@_sem("propagate_core")
+@_sem("propagate_ell")
+def _sem_propagate(args: List[FactLike]) -> FactLike:
+    n = _first_dim(args[0]) if args else None
+    vec = Fact((n,), "float32")
+    return (vec, vec, vec, vec, vec)
+
+
+@_sem("finite_mask_rows")
+def _sem_finite_mask(args: List[FactLike]) -> FactLike:
+    src = args[0] if args and isinstance(args[0], Fact) else UNKNOWN
+    return (src, Fact((), "int32"))
+
+
+@_sem("topk_diag")
+def _sem_topk_diag(args: List[FactLike]) -> FactLike:
+    if (len(args) >= 2 and isinstance(args[0], Fact) and args[0].shape
+            and isinstance(args[1], Fact) and args[1].shape is not None):
+        return Fact((args[0].shape[0],) + tuple(args[1].shape),
+                    args[0].dtype)
+    return UNKNOWN
+
+
+class Interpreter(ast.NodeVisitor):
+    """One forward pass over a function body with an initial symbolic
+    environment; collects per-name facts, cast/promotion events, and the
+    facts of every ``return`` expression."""
+
+    def __init__(self, env: Optional[Dict[str, FactLike]] = None):
+        self.env: Dict[str, FactLike] = dict(env or {})
+        self.events = Events()
+        self.returns: List[FactLike] = []
+        self._local_defs: Dict[str, ast.FunctionDef] = {}
+
+    # -- driving ------------------------------------------------------------
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        for stmt in fn.body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.FunctionDef):
+            self._local_defs[stmt.name] = stmt
+            return
+        if isinstance(stmt, ast.Assign):
+            fact = self.eval(stmt.value)
+            for target in stmt.targets:
+                self._bind(target, fact)
+        elif isinstance(stmt, ast.AugAssign):
+            left = self.eval(stmt.target)
+            fact = self._binop(left, self.eval(stmt.value), stmt.lineno)
+            self._bind(stmt.target, fact)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.returns.append(self.eval(stmt.value))
+        elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With)):
+            self.eval(getattr(stmt, "test", None)
+                      or getattr(stmt, "iter", None) or ast.Constant(0))
+            for s in stmt.body + getattr(stmt, "orelse", []):
+                self._stmt(s)
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value)
+        # everything else (imports, asserts, raises): no fact flow
+
+    def _bind(self, target: ast.expr, fact: FactLike) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = fact
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(fact, tuple) and len(fact) == len(target.elts):
+                for t, f in zip(target.elts, fact):
+                    self._bind(t, f)
+            else:
+                for t in target.elts:
+                    self._bind(t, UNKNOWN)
+
+    # -- expressions --------------------------------------------------------
+
+    def eval(self, node: Optional[ast.expr]) -> FactLike:
+        if node is None:
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return Fact((), "bool")
+            if isinstance(node.value, (int, float)):
+                return Fact((), None)  # weak-typed scalar: never promotes
+            return UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self.eval(e) for e in node.elts)
+        if isinstance(node, ast.BinOp):
+            return self._binop(self.eval(node.left), self.eval(node.right),
+                               node.lineno)
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand)
+        if isinstance(node, ast.Compare):
+            left = self.eval(node.left)
+            shape = left.shape if isinstance(left, Fact) else None
+            for c in node.comparators:
+                right = self.eval(c)
+                if isinstance(right, Fact):
+                    shape = broadcast(shape, right.shape)
+            return Fact(shape, "bool")
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            a, b = self.eval(node.body), self.eval(node.orelse)
+            return a if a != UNKNOWN else b
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node)
+        if isinstance(node, ast.Attribute):
+            return UNKNOWN  # x.shape / x.T etc: static under trace
+        return UNKNOWN
+
+    def _binop(self, a: FactLike, b: FactLike, lineno: int) -> FactLike:
+        if not isinstance(a, Fact) or not isinstance(b, Fact):
+            return UNKNOWN
+        if (a.dtype and b.dtype and a.dtype != b.dtype
+                and (a.dtype in LOW_PRECISION_DTYPES)
+                != (b.dtype in LOW_PRECISION_DTYPES)):
+            self.events.promotions.append((lineno, a.dtype, b.dtype))
+        return Fact(broadcast(a.shape, b.shape), promote(a.dtype, b.dtype))
+
+    def _dim(self, node: ast.expr) -> Optional[Union[int, str]]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def _call(self, node: ast.Call) -> FactLike:
+        func = node.func
+        args = [self.eval(a) for a in node.args]
+
+        # explicit dtype anywhere in the call: a cast event
+        to_dtype = None
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                to_dtype = dtype_of_node(kw.value)
+        for a in node.args:
+            d = dtype_of_node(a)
+            if d is not None:
+                to_dtype = d
+
+        if isinstance(func, ast.Attribute):
+            # x.astype(dt)
+            if func.attr == "astype" and node.args:
+                dt = dtype_of_node(node.args[0]) or to_dtype
+                base = self.eval(func.value)
+                if dt:
+                    self.events.casts.append((node.lineno, dt))
+                shape = base.shape if isinstance(base, Fact) else None
+                return Fact(shape, dt)
+            # x.at[idx].set(rows) -> fact of x
+            if (func.attr in ("set", "add", "multiply", "min", "max")
+                    and isinstance(func.value, ast.Subscript)
+                    and isinstance(func.value.value, ast.Attribute)
+                    and func.value.value.attr == "at"):
+                return self.eval(func.value.value.value)
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        else:
+            # jax.vmap(f)(args): prepend the batch dim to f's outputs
+            if (isinstance(func, ast.Call)
+                    and isinstance(func.func, ast.Attribute)
+                    and func.func.attr == "vmap" and func.args):
+                return self._vmap(func.args[0], node)
+            return UNKNOWN
+
+        if to_dtype is not None:
+            self.events.casts.append((node.lineno, to_dtype))
+
+        if name in SEMANTICS:
+            return SEMANTICS[name](args)
+        if name == "stack":
+            if args and isinstance(args[0], tuple):
+                elems = [f for f in args[0] if isinstance(f, Fact)]
+                if len(elems) == len(args[0]):
+                    shape = elems[0].shape
+                    dtype = elems[0].dtype
+                    for f in elems[1:]:
+                        shape = shape if shape == f.shape else None
+                        dtype = promote(dtype, f.dtype)
+                    if shape is not None:
+                        return Fact((len(elems),) + tuple(shape), dtype)
+            return UNKNOWN
+        if name == "top_k" and len(node.args) >= 2:
+            base = args[0]
+            k = self._dim(node.args[1])
+            if isinstance(base, Fact) and base.shape and k is not None:
+                lead = tuple(base.shape[:-1])
+                return (Fact(lead + (k,), base.dtype),
+                        Fact(lead + (k,), "int32"))
+            return (UNKNOWN, UNKNOWN)
+        if name in ("asarray", "array"):
+            base = args[0] if args else UNKNOWN
+            shape = base.shape if isinstance(base, Fact) else None
+            if to_dtype:
+                return Fact(shape, to_dtype)
+            return base if isinstance(base, Fact) else UNKNOWN
+        if name in ("zeros", "ones", "full", "empty"):
+            shape_node = node.args[0] if node.args else None
+            dims: Optional[Dims] = None
+            if isinstance(shape_node, (ast.Tuple, ast.List)):
+                dims = tuple(self._dim(e) for e in shape_node.elts)
+            elif shape_node is not None:
+                d = self._dim(shape_node)
+                dims = (d,) if d is not None else None
+            return Fact(dims, to_dtype)
+        if name in ("zeros_like", "ones_like", "full_like"):
+            base = args[0] if args else UNKNOWN
+            if isinstance(base, Fact):
+                return Fact(base.shape, to_dtype or base.dtype)
+            return UNKNOWN
+        if name in _ELEMENTWISE:
+            facts = [a for a in args if isinstance(a, Fact)]
+            if name == "where" and len(facts) == 3:
+                facts = facts[1:]
+            out = facts[0] if facts else UNKNOWN
+            for f in facts[1:]:
+                if isinstance(out, Fact):
+                    out = Fact(broadcast(out.shape, f.shape),
+                               promote(out.dtype, f.dtype))
+            return out
+        if name in _REDUCTIONS:
+            base = args[0] if args and isinstance(args[0], Fact) else UNKNOWN
+            axis = None
+            for kw in node.keywords:
+                if kw.arg == "axis" and isinstance(kw.value, ast.Constant):
+                    axis = kw.value.value
+            if not isinstance(base, Fact) or base.shape is None:
+                return UNKNOWN
+            if axis is None:
+                return Fact((), base.dtype)
+            if isinstance(axis, int) and -len(base.shape) <= axis:
+                shape = list(base.shape)
+                del shape[axis]
+                return Fact(tuple(shape), base.dtype)
+            return UNKNOWN
+        if name in ("argmax", "argmin", "argsort"):
+            return Fact((), "int32")
+        if name in self._local_defs:
+            return self._interp_local(self._local_defs[name], args)
+        return UNKNOWN
+
+    def _interp_local(self, fn: ast.FunctionDef,
+                      args: List[FactLike]) -> FactLike:
+        params = [a.arg for a in fn.args.args]
+        env = dict(self.env)
+        env.update(dict(zip(params, args)))
+        sub = Interpreter(env)
+        sub._local_defs = dict(self._local_defs)
+        sub.run(fn)
+        self.events.casts += sub.events.casts
+        self.events.promotions += sub.events.promotions
+        return sub.returns[-1] if sub.returns else UNKNOWN
+
+    def _vmap(self, fn_node: ast.expr, call: ast.Call) -> FactLike:
+        if not isinstance(fn_node, ast.Name):
+            return UNKNOWN
+        fn = self._local_defs.get(fn_node.id)
+        if fn is None:
+            return UNKNOWN
+        batched = [self.eval(a) for a in call.args]
+        lead = None
+        sliced: List[FactLike] = []
+        for f in batched:
+            if isinstance(f, Fact) and f.shape:
+                lead = lead if lead is not None else f.shape[0]
+                sliced.append(Fact(tuple(f.shape[1:]), f.dtype))
+            else:
+                sliced.append(UNKNOWN)
+        out = self._interp_local(fn, sliced)
+
+        def add_lead(f: FactLike) -> FactLike:
+            if isinstance(f, tuple):
+                return tuple(add_lead(e) for e in f)
+            if isinstance(f, Fact) and f.shape is not None:
+                return Fact((lead,) + tuple(f.shape), f.dtype)
+            return UNKNOWN
+
+        return add_lead(out)
+
+    def _subscript(self, node: ast.Subscript) -> FactLike:
+        base = self.eval(node.value)
+        sl = node.slice
+        if isinstance(base, tuple):
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, int):
+                if -len(base) <= sl.value < len(base):
+                    return base[sl.value]
+            if isinstance(sl, ast.Slice):
+                lo = sl.lower.value if isinstance(sl.lower, ast.Constant) \
+                    else None
+                hi = sl.upper.value if isinstance(sl.upper, ast.Constant) \
+                    else None
+                return base[lo:hi]
+            return UNKNOWN
+        if not isinstance(base, Fact) or base.shape is None:
+            return UNKNOWN
+        # x[:, idx] — the diag gather
+        if isinstance(sl, ast.Tuple) and len(sl.elts) == 2:
+            first, second = sl.elts
+            if (isinstance(first, ast.Slice) and first.lower is None
+                    and first.upper is None):
+                idx = self.eval(second)
+                if isinstance(idx, Fact) and idx.shape is not None:
+                    return Fact((base.shape[0],) + tuple(idx.shape),
+                                base.dtype)
+            return UNKNOWN
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, int):
+            return Fact(tuple(base.shape[1:]), base.dtype)
+        idx = self.eval(sl)
+        if isinstance(idx, Fact) and idx.shape is not None:
+            return Fact(tuple(idx.shape) + tuple(base.shape[1:]),
+                        base.dtype)
+        return UNKNOWN
+
+
+def interpret_function(fn: ast.FunctionDef,
+                       inputs: Dict[str, Fact]) -> Interpreter:
+    """Seed the interpreter with ``inputs`` (missing params stay UNKNOWN)
+    and run the body; returns the interpreter with env/events/returns."""
+    interp = Interpreter(dict(inputs))
+    interp.run(fn)
+    return interp
+
+
+def dims_conform(actual, declared) -> bool:
+    """Declared dim vs interpreted dim: ints must match, symbols must
+    match by name, None (unknown) conforms to anything."""
+    if actual is None or declared is None:
+        return True
+    return actual == declared
+
+
+def fact_conforms(actual: FactLike, declared) -> Optional[str]:
+    """None when ``actual`` (a Fact) proves or is compatible with the
+    declared Role; else a human-readable mismatch description."""
+    if not isinstance(actual, Fact):
+        return None  # tuple-vs-role confusion: stay silent
+    if actual.shape is not None:
+        if len(actual.shape) != len(declared.shape):
+            return (f"rank {len(actual.shape)} != declared "
+                    f"{len(declared.shape)} for `{declared.name}`")
+        for a, d in zip(actual.shape, declared.shape):
+            if not dims_conform(a, d):
+                return (f"dim {a!r} != declared {d!r} for "
+                        f"`{declared.name}`")
+    if actual.dtype is not None and actual.dtype != declared.dtype:
+        return (f"dtype {actual.dtype} != declared {declared.dtype} "
+                f"for `{declared.name}`")
+    return None

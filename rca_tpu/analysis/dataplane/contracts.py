@@ -1,0 +1,296 @@
+"""graftspec contract tables: the declarative side of the dataplane
+analyzer (ANALYSIS.md §graftspec).
+
+Three tables, one discipline each:
+
+- :data:`JIT_SIGNATURES` — the symbolic (shape, dtype) contract of every
+  donated/ranked jit executable: what goes in, what must come out.  The
+  abstract interpreter (:mod:`rca_tpu.analysis.dataplane.absint`) walks
+  each executable's body with the declared input facts and proves the
+  returned expressions match the declared outputs — a dtype or rank
+  drift inside the traced body is a ``shape-contract`` finding, not a
+  runtime recompile.
+- :data:`DTYPE_RULES` — where low-precision dtypes are legal
+  (``engine/quantized.py`` and nowhere else) and where float64 staging
+  is forbidden (the device staging modules: a float64 buffer doubles
+  the upload and silently de-optimizes every downstream op).
+- :data:`FETCH_BUDGETS` — the quantitative generalization of the
+  resident-fetch allowlist: every audited fetch surface declares the
+  named result roles it may move (symbolic shapes + dtypes) and a
+  per-``device_get``-call byte budget as an expression over the shape
+  symbols.  :func:`budget_violations` proves, over the whole symbol
+  grid, that the declared roles always fit the declared budget; specsan
+  (:mod:`rca_tpu.analysis.dataplane.specsan`) proves the OBSERVED
+  fetches unify with the declared roles at runtime.
+
+Shape expressions are tuples of ints (exact dims) and symbol names:
+``k`` top-k width, ``n_pad`` padded service count (pow2 by contract),
+``B`` padded batch lanes, ``C`` feature channels, ``E`` padded edge
+count, ``m`` counterfactual rows, ``P`` blame-path hops.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, NamedTuple, Optional, Tuple, Union
+
+Dim = Union[int, str]
+
+#: numpy/JAX itemsizes for the dtypes the contracts speak
+ITEMSIZE = {
+    "float32": 4, "int32": 4, "float64": 8, "int64": 8,
+    "bfloat16": 2, "float16": 2, "int8": 1, "uint8": 1, "bool": 1,
+}
+
+#: dtypes legal only inside the quantized kernel module
+LOW_PRECISION_DTYPES = frozenset({
+    "bfloat16", "float16", "int8",
+    "float8_e4m3fn", "float8_e5m2", "float8_e4m3b11_fnuz",
+})
+
+#: sample values per symbol for the static budget-domination proof;
+#: every combination is checked, so a budget expression that ever under-
+#: declares its roles fails loudly at lint time
+SYMBOL_GRID: Dict[str, Tuple[int, ...]] = {
+    "k": (1, 5, 64, 256),
+    "n_pad": (8, 256, 4096),
+    "B": (1, 8, 64),
+    "C": (1, 8, 32),
+    "E": (1, 64, 4096),
+    "m": (1, 8, 64),
+    "P": (1, 4, 16),
+}
+
+
+class Role(NamedTuple):
+    """One named result a fetch surface may move host-ward."""
+
+    name: str
+    shape: Tuple[Dim, ...]
+    dtype: str
+
+
+class Fact(NamedTuple):
+    """An abstract (shape, dtype) value: ``None`` means unknown — the
+    interpreter only ever *proves* with known facts, never guesses."""
+
+    shape: Optional[Tuple[Optional[Dim], ...]]
+    dtype: Optional[str]
+
+
+UNKNOWN = Fact(None, None)
+
+
+class FetchBudget(NamedTuple):
+    roles: Tuple[Role, ...]
+    #: per-device_get-call byte budget, an expression over SYMBOL_GRID
+    #: symbols (evaluated with no builtins)
+    budget: str
+    #: the one documented deferred bulk seam (full_diagnostics) — budget
+    #: still holds, but it is O(n_pad) by design, off the latency path
+    bulk: bool = False
+
+
+def _r(name: str, shape: Tuple[Dim, ...], dtype: str = "float32") -> Role:
+    return Role(name, shape, dtype)
+
+
+_TOPK_ROLES = (
+    _r("diag", (4, "k")), _r("vals", ("k",)),
+    _r("idx", ("k",), "int32"), _r("n_bad", (), "int32"),
+)
+_BATCH_ROLES = (
+    _r("diag", ("B", 4, "k")), _r("vals", ("B", "k")),
+    _r("idx", ("B", "k"), "int32"), _r("n_bad", (), "int32"),
+)
+
+#: (repo-relative path, function) -> FetchBudget.  MUST cover every
+#: allowlisted function in residentfetch.FETCH_SURFACES (asserted by
+#: coverage() and tests/test_dataplane.py) — an audited surface without
+#: a byte budget is an unquantified contract.
+FETCH_BUDGETS: Dict[Tuple[str, str], FetchBudget] = {
+    # one-shot + resident analyze path: the [4,k] diagnostic gather, the
+    # top-k pair, and the sanitize count — O(k) by construction
+    ("rca_tpu/engine/runner.py", "timed_fetch"): FetchBudget(
+        _TOPK_ROLES, "24*k + 8"),
+    ("rca_tpu/engine/runner.py", "analyze_batch"): FetchBudget(
+        _BATCH_ROLES, "24*B*k + 8"),
+    # THE deferred bulk seam: the parked [4, n_pad] stack, fetched
+    # lazily on first diagnostics use — budgeted, but bulk by design
+    ("rca_tpu/engine/runner.py", "full_diagnostics"): FetchBudget(
+        (_r("stacked", (4, "n_pad")),), "16*n_pad", bulk=True),
+    ("rca_tpu/engine/resident.py", "_fetch_topk"): FetchBudget(
+        _TOPK_ROLES, "24*k + 8"),
+    # causelens: [5,k] diag + [m,k] counterfactual deltas + five [k,P]
+    # path arrays + [k,C] saliency + the top-m pair — top-k/m-sized
+    ("rca_tpu/engine/attribution.py", "compute_attribution"): FetchBudget(
+        (
+            _r("diag", (5, "k")), _r("deltas", ("m", "k")),
+            _r("path_edge", ("k", "P"), "int32"),
+            _r("path_term", ("k", "P")),
+            _r("path_dst", ("k", "P"), "int32"),
+            _r("path_hard", ("k", "P")), _r("path_up", ("k", "P")),
+            _r("sal_cand", ("k", "C")), _r("sal_vals", ("m",)),
+            _r("sal_idx", ("m",), "int32"),
+        ),
+        "4*(5*k + m*k + 5*k*P + k*C + 2*m) + 64"),
+    ("rca_tpu/engine/sharded_runner.py", "analyze_batch"): FetchBudget(
+        _BATCH_ROLES, "24*B*k + 8"),
+    # streaming tick + serve paths: top-k pair + sanitize count only
+    ("rca_tpu/engine/streaming.py", "fetch"): FetchBudget(
+        (_r("vals", ("k",)), _r("idx", ("k",), "int32"),
+         _r("n_bad", (), "int32")),
+        "8*k + 8"),
+    ("rca_tpu/parallel/streaming.py", "fetch"): FetchBudget(
+        (_r("vals", ("k",)), _r("idx", ("k",), "int32"),
+         _r("n_bad", (), "int32")),
+        "8*k + 8"),
+    ("rca_tpu/parallel/sharded.py", "_fetch_topk"): FetchBudget(
+        (_r("diag", (4, "k")), _r("vals", ("k",)),
+         _r("idx", ("k",), "int32")),
+        "24*k + 8"),
+    ("rca_tpu/serve/dispatcher.py", "fetch"): FetchBudget(
+        _BATCH_ROLES, "24*B*k + 8"),
+}
+
+#: the device staging modules: pow2 padding, explicit-dtype staging, and
+#: dummy-row COO fill are enforced here.  The sharded/parallel layouts
+#: pad to data-parallel multiples and per-shard maxima by design, so
+#: they are deliberately NOT in this scope (their shape stability is
+#: pinned per graph, not per bucket).
+DATAPLANE_MODULES = frozenset({
+    "rca_tpu/engine/runner.py",
+    "rca_tpu/engine/resident.py",
+    "rca_tpu/engine/streaming.py",
+    "rca_tpu/serve/dispatcher.py",
+    "rca_tpu/engine/ell.py",
+})
+
+DTYPE_RULES = {
+    # bf16/int8/f8 live ONLY in the quantized kernel module — anywhere
+    # else an implicit f32<->low-precision promotion silently changes
+    # ranking arithmetic (SCORE_EPS calibration is per-dtype)
+    "low_precision_ok": frozenset({"rca_tpu/engine/quantized.py"}),
+    # float64 staging doubles upload bytes and de-optimizes every
+    # downstream op on TPU; forbidden in the staging modules
+    "no_float64_staging": DATAPLANE_MODULES,
+}
+
+#: attribute-spelled callables that donate their argument 0 — the jit
+#: wrapper is built at runtime (jax.jit(fn, donate_argnums=(0,))), so
+#: module-local decorator extraction cannot see it; the donation-guard
+#: rule treats a call through these exactly like a decorated donor
+DONATED_ATTR_CALLABLES: Dict[Tuple[str, str], Tuple[int, ...]] = {
+    ("rca_tpu/parallel/streaming.py", "self._fn"): (0,),
+}
+
+#: symbolic signatures of the ranked jit executables: input facts the
+#: interpreter seeds the body with, and the output facts the returned
+#: expressions must prove equal to.  Order matters — outputs match the
+#: returned tuple positionally.
+JIT_SIGNATURES: Dict[Tuple[str, str], Dict[str, object]] = {
+    ("rca_tpu/engine/runner.py", "_propagate_ranked"): {
+        "inputs": {
+            "features": Fact(("n_pad", "C"), "float32"),
+            "edges": Fact((2, "E"), "int32"),
+            "anomaly_w": Fact(("C",), "float32"),
+            "hard_w": Fact(("C",), "float32"),
+        },
+        "outputs": (
+            _r("stacked", (4, "n_pad")), _r("diag", (4, "k")),
+            _r("vals", ("k",)), _r("idx", ("k",), "int32"),
+            _r("n_bad", (), "int32"),
+        ),
+    },
+    ("rca_tpu/engine/resident.py", "_resident_delta_ranked"): {
+        "inputs": {
+            "features": Fact(("n_pad", "C"), "float32"),
+            "idx": Fact(("u",), "int32"),
+            "rows": Fact(("u", "C"), "float32"),
+            "edges": Fact((2, "E"), "int32"),
+            "anomaly_w": Fact(("C",), "float32"),
+            "hard_w": Fact(("C",), "float32"),
+        },
+        "outputs": (
+            _r("features", ("n_pad", "C")), _r("stacked", (4, "n_pad")),
+            _r("diag", (4, "k")), _r("vals", ("k",)),
+            _r("idx", ("k",), "int32"), _r("n_bad", (), "int32"),
+        ),
+    },
+    ("rca_tpu/engine/streaming.py", "_flush_propagate_ranked"): {
+        "inputs": {
+            "features": Fact(("n_pad", "C"), "float32"),
+            "idx": Fact(("u",), "int32"),
+            "rows": Fact(("u", "C"), "float32"),
+            "edges": Fact((2, "E"), "int32"),
+            "anomaly_w": Fact(("C",), "float32"),
+            "hard_w": Fact(("C",), "float32"),
+        },
+        "outputs": (
+            _r("features", ("n_pad", "C")), _r("vals", ("k",)),
+            _r("idx", ("k",), "int32"), _r("n_bad", (), "int32"),
+        ),
+    },
+}
+
+
+def role_bytes(role: Role, binding: Dict[str, int]) -> int:
+    n = ITEMSIZE[role.dtype]
+    for d in role.shape:
+        n *= d if isinstance(d, int) else binding[d]
+    return n
+
+
+def eval_budget(expr: str, binding: Dict[str, int]) -> int:
+    return int(eval(expr, {"__builtins__": {}}, dict(binding)))
+
+
+def _symbols(budget: FetchBudget) -> List[str]:
+    syms = {d for r in budget.roles for d in r.shape if isinstance(d, str)}
+    syms |= {s for s in SYMBOL_GRID if s in budget.budget}
+    return sorted(syms)
+
+
+def budget_violations() -> List[dict]:
+    """The static domination proof: for every surface and every grid
+    binding, the declared roles' total bytes must fit the declared
+    budget.  Non-empty return = the contract table itself is unsound."""
+    out: List[dict] = []
+    for (path, func), budget in sorted(FETCH_BUDGETS.items()):
+        syms = _symbols(budget)
+        grids = [SYMBOL_GRID[s] for s in syms]
+        for values in itertools.product(*grids):
+            binding = dict(zip(syms, values))
+            total = sum(role_bytes(r, binding) for r in budget.roles)
+            cap = eval_budget(budget.budget, binding)
+            if total > cap:
+                out.append({
+                    "surface": f"{path}::{func}", "binding": binding,
+                    "roles_bytes": total, "budget_bytes": cap,
+                })
+                break  # one witness per surface is enough
+    return out
+
+
+def coverage() -> List[str]:
+    """Allowlisted fetch functions missing a FETCH_BUDGETS row (must be
+    empty: an audited surface without a byte budget is unquantified)."""
+    from rca_tpu.analysis.rules.residentfetch import FETCH_SURFACES
+
+    missing = []
+    for path, funcs in sorted(FETCH_SURFACES.items()):
+        for func in sorted(funcs):
+            if (path, func) not in FETCH_BUDGETS:
+                missing.append(f"{path}::{func}")
+    return missing
+
+
+def role_name(leaf_name: str) -> str:
+    """Normalize a fetched expression's terminal name to its role name:
+    ``self._stacked_dev`` -> ``stacked``, ``handle.vals`` -> ``vals``,
+    ``topi`` -> ``idx``."""
+    name = leaf_name.lstrip("_")
+    for suffix in ("_dev", "_h", "_b"):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+    return {"topi": "idx"}.get(name, name)

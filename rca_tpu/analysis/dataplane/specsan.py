@@ -1,0 +1,348 @@
+"""specsan: the runtime half of graftspec (``rca lint --specsan``).
+
+The contract tables are only trustworthy if real executions agree with
+them — the same discipline rsan applies to the static lock model
+(:mod:`rca_tpu.analysis.concurrency.crosscheck`).  This module runs real
+engine + serve work with ``jax.device_get`` instrumented and diffs every
+observed host-ward transfer against :data:`~rca_tpu.analysis.dataplane.
+contracts.FETCH_BUDGETS`:
+
+- **role unification**: the leaves of each fetched pytree must unify
+  with the surface's declared roles — same dtype, literal dims equal,
+  symbolic dims bound consistently within the call.  A leaf no declared
+  role can absorb is an undeclared transfer (``unmatched_roles``);
+- **byte budget**: the call's total bytes must fit the surface's budget
+  expression evaluated at the unified symbol binding (symbols the call
+  does not bind fall back to the surface's most recent binding, else
+  the grid maximum — sound because the static domination proof already
+  covers the whole grid) (``over_budget``);
+- **audit scope**: a ``device_get`` reached from an audited hot-path
+  module but OUTSIDE its allowlisted functions is a fetch the static
+  allowlist never blessed (``unaudited``) — the runtime twin of the
+  ``resident-fetch`` rule.
+
+Workload: a seeded resident session (one-shot + delta analyze, deferred
+bulk diagnostics, causelens attribution, the batched lane, a streaming
+tick) plus the serve selftest (the dispatcher's batched fetch under
+concurrent submitters) — every budgeted surface the CPU backend can
+reach.  ``capture()`` is also reusable standalone, e.g. around a
+flight-recorder replay in tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from rca_tpu.analysis.core import repo_root
+from rca_tpu.analysis.dataplane.contracts import (
+    FETCH_BUDGETS,
+    ITEMSIZE,
+    SYMBOL_GRID,
+    FetchBudget,
+    Role,
+)
+
+_SELF = os.path.join("analysis", "dataplane", "specsan.py")
+
+
+def _leaf_meta(leaf: Any) -> Tuple[Tuple[int, ...], str, int]:
+    """(shape, dtype, nbytes) of one fetched pytree leaf, pre-transfer."""
+    shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+    dtype = str(getattr(leaf, "dtype", "")) or type(leaf).__name__
+    n = ITEMSIZE.get(dtype, getattr(getattr(leaf, "dtype", None),
+                                    "itemsize", 0) or 0)
+    for d in shape:
+        n *= d
+    return shape, dtype, n
+
+
+def unify_roles(
+    leaves: Sequence[Tuple[Tuple[int, ...], str]],
+    roles: Sequence[Role],
+) -> Optional[Dict[str, int]]:
+    """Assign each observed leaf to a DISTINCT declared role with one
+    consistent symbol binding, or None.  Backtracking: the role lists
+    are tiny (<= 10), ambiguity only arises when two symbols share a
+    value — any consistent assignment proves conformance."""
+
+    def match(leaf, role: Role, binding: Dict[str, int]):
+        shape, dtype = leaf
+        if dtype != role.dtype or len(shape) != len(role.shape):
+            return None
+        new = dict(binding)
+        for actual, d in zip(shape, role.shape):
+            if isinstance(d, int):
+                if actual != d:
+                    return None
+            elif new.setdefault(d, actual) != actual:
+                return None
+        return new
+
+    used = [False] * len(roles)
+
+    def solve(i: int, binding: Dict[str, int]):
+        if i == len(leaves):
+            return binding
+        for j, role in enumerate(roles):
+            if used[j]:
+                continue
+            new = match(leaves[i], role, binding)
+            if new is not None:
+                used[j] = True
+                out = solve(i + 1, new)
+                if out is not None:
+                    return out
+                used[j] = False
+        return None
+
+    return solve(0, {})
+
+
+class SpecsanRecorder:
+    """Every intercepted ``device_get``, judged against the contracts."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.events: List[Dict[str, Any]] = []
+        self.violations: List[Dict[str, Any]] = []
+        #: surface -> most recent symbol binding (budget fallback)
+        self.bindings: Dict[str, Dict[str, int]] = {}
+        self._audited_files = {path for path, _ in FETCH_BUDGETS}
+
+    def _surface_for_frame(self) -> Tuple[Optional[str], Optional[str]]:
+        """(relpath, func) of the nearest rca_tpu frame below the patched
+        call, skipping this module's own frames."""
+        frame = sys._getframe(2)
+        while frame is not None:
+            filename = frame.f_code.co_filename
+            if not filename.endswith(_SELF):
+                try:
+                    rel = os.path.relpath(filename, self.root)
+                except ValueError:  # pragma: no cover - windows drives
+                    rel = filename
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith("rca_tpu/"):
+                    return rel, frame.f_code.co_name
+            frame = frame.f_back
+        return None, None
+
+    def record(self, tree: Any) -> None:
+        import jax
+
+        rel, func = self._surface_for_frame()
+        if rel is None:
+            return  # not our code (test harness, tooling)
+        # host-native leaves (a Python int already fetched upstream, e.g.
+        # n_bad on the replay path) pass through device_get untouched —
+        # they are not transfers, so they are not judged against roles
+        leaves = [_leaf_meta(x) for x in jax.tree_util.tree_leaves(tree)
+                  if hasattr(x, "dtype")]
+        nbytes = sum(n for _, _, n in leaves)
+        event: Dict[str, Any] = {
+            "surface": f"{rel}::{func}",
+            "shapes": [list(s) for s, _, _ in leaves],
+            "dtypes": [d for _, d, _ in leaves],
+            "nbytes": nbytes,
+        }
+        budget = FETCH_BUDGETS.get((rel, func))
+        if budget is None:
+            if rel in self._audited_files:
+                event["verdict"] = "unaudited"
+                self.violations.append({
+                    "kind": "unaudited", **event,
+                })
+            else:
+                event["verdict"] = "unscoped"
+            self.events.append(event)
+            return
+        self._judge(event, budget, leaves, nbytes)
+        self.events.append(event)
+
+    def _judge(self, event: Dict[str, Any], budget: FetchBudget,
+               leaves, nbytes: int) -> None:
+        from rca_tpu.analysis.dataplane.contracts import eval_budget
+
+        surface = event["surface"]
+        binding = unify_roles([(s, d) for s, d, _ in leaves], budget.roles)
+        if binding is None:
+            event["verdict"] = "unmatched_roles"
+            self.violations.append({
+                "kind": "unmatched_roles",
+                "declared": [
+                    f"{r.name}{list(r.shape)}:{r.dtype}"
+                    for r in budget.roles
+                ],
+                **event,
+            })
+            return
+        # symbols this call left unbound: the surface's last observed
+        # value, else the grid max (the static proof covers the grid)
+        merged = {s: max(v) for s, v in SYMBOL_GRID.items()}
+        merged.update(self.bindings.get(surface, {}))
+        merged.update(binding)
+        self.bindings[surface] = merged
+        cap = eval_budget(budget.budget, merged)
+        event["binding"] = {
+            k: v for k, v in binding.items() if k in SYMBOL_GRID
+        }
+        event["budget_bytes"] = cap
+        if nbytes > cap:
+            event["verdict"] = "over_budget"
+            self.violations.append({"kind": "over_budget", **event})
+        else:
+            event["verdict"] = "ok"
+
+
+@contextlib.contextmanager
+def capture(root: Optional[str] = None) -> Iterator[SpecsanRecorder]:
+    """Patch ``jax.device_get`` with the recording wrapper for the
+    duration of the block.  The wrapper records metadata from the
+    pre-transfer leaves and delegates — observed values are untouched,
+    so captured workloads stay bit-identical."""
+    import jax
+
+    rec = SpecsanRecorder(root or repo_root())
+    original = jax.device_get
+
+    def wrapper(tree, *args, **kwargs):
+        rec.record(tree)
+        return original(tree, *args, **kwargs)
+
+    jax.device_get = wrapper
+    try:
+        yield rec
+    finally:
+        jax.device_get = original
+
+
+def _session_leg(rec: SpecsanRecorder, seed: int) -> Dict[str, Any]:
+    """Seeded resident-engine pass over every budgeted engine surface."""
+    import numpy as np
+
+    from rca_tpu.cluster.generator import synthetic_cascade_arrays
+    from rca_tpu.engine.runner import GraphEngine
+    from rca_tpu.engine.streaming import make_streaming_session
+
+    arrays = synthetic_cascade_arrays(20, seed=seed)
+    names = arrays.names or [f"svc-{i}" for i in range(arrays.n)]
+    engine = GraphEngine(resident=True)
+    # one-shot timed path (timed_fetch), then a delta re-analysis of the
+    # same graph so the resident session's _fetch_topk fires too
+    first = engine.analyze_case(arrays, k=5, timed=True)
+    arrays.features[0, 0] += 0.25
+    second = engine.analyze_arrays(
+        arrays.features, arrays.dep_src, arrays.dep_dst, names, k=5
+    )
+    first.full_diagnostics()  # the deferred bulk seam
+    attribution = second.attribution()
+    batch = engine.analyze_batch(
+        np.stack([arrays.features] * 3),
+        arrays.dep_src, arrays.dep_dst, names, k=5,
+    )
+    session = make_streaming_session(
+        names, arrays.dep_src, arrays.dep_dst,
+        num_features=arrays.features.shape[1], engine=engine, k=5,
+    )
+    session.update_rows(
+        np.arange(3, dtype=np.int32),
+        np.asarray(arrays.features[:3], np.float32),
+    )
+    tick = session.tick()
+    return {
+        "services": int(arrays.n),
+        "one_shot_top1": (first.ranked[0].get("component")
+                          if first.ranked else None),
+        "attribution_ok": attribution is not None,
+        "batch_lanes": len(batch),
+        "tick_latency_ms": tick.get("latency_ms"),
+    }
+
+
+def _serve_leg(seed: int, n_requests: int) -> Dict[str, Any]:
+    """The dispatcher's batched fetch under concurrent submitters."""
+    from rca_tpu.serve.client import serve_selftest
+
+    out = serve_selftest(
+        n_requests=n_requests, seed=seed, submitters=2,
+    )
+    return {
+        "requests": out.get("requests", n_requests),
+        "ok": bool(out.get("ok", False)),
+    }
+
+
+def run_specsan(
+    root: Optional[str] = None,
+    seed: int = 0,
+    n_requests: int = 8,
+) -> Dict[str, Any]:
+    """Drive both workload legs under capture and report the diff
+    against the static contract model (shape mirrors
+    :func:`~rca_tpu.analysis.concurrency.crosscheck.run_rsan_crosscheck`:
+    a dict with ``ok`` plus the evidence)."""
+    t0 = time.perf_counter()
+    root = root or repo_root()
+    with capture(root) as rec:
+        session = _session_leg(rec, seed)
+        serve = _serve_leg(seed, n_requests)
+
+    per_surface: Dict[str, Dict[str, Any]] = {}
+    for e in rec.events:
+        s = per_surface.setdefault(e["surface"], {
+            "calls": 0, "max_nbytes": 0, "verdicts": {},
+        })
+        s["calls"] += 1
+        s["max_nbytes"] = max(s["max_nbytes"], e["nbytes"])
+        v = e.get("verdict", "ok")
+        s["verdicts"][v] = s["verdicts"].get(v, 0) + 1
+        if "budget_bytes" in e:
+            s["budget_bytes"] = e["budget_bytes"]
+
+    budgeted = {
+        f"{p}::{f}" for p, f in FETCH_BUDGETS
+    }
+    confirmed = sorted(s for s in per_surface if s in budgeted)
+    ok = (
+        not rec.violations
+        and serve["ok"]
+        and len(confirmed) >= 2  # both legs actually fetched something
+    )
+    return {
+        "ok": bool(ok),
+        "fetches": len(rec.events),
+        "surfaces": per_surface,
+        "surfaces_confirmed": confirmed,
+        "surfaces_unexercised": sorted(budgeted - set(confirmed)),
+        "violations": rec.violations,
+        "bindings": rec.bindings,
+        "session": session,
+        "serve": serve,
+        "wall_ms": round((time.perf_counter() - t0) * 1e3, 1),
+    }
+
+
+def confirm_findings(
+    findings: List[Dict[str, Any]], report: Dict[str, Any],
+) -> int:
+    """Stamp ``dynamically_confirmed: true`` onto static findings whose
+    file a specsan violation also implicates (the static rules and the
+    runtime check agreeing on a file is the strongest signal the lint
+    can emit).  Returns the number of findings stamped."""
+    implicated = {
+        v["surface"].split("::", 1)[0]
+        for v in report.get("violations", ())
+        if "surface" in v
+    }
+    n = 0
+    for f in findings:
+        if f.get("rule") in (
+            "shape-contract", "dtype-discipline", "donation-guard",
+            "resident-fetch",
+        ) and f.get("path") in implicated:
+            f["dynamically_confirmed"] = True
+            n += 1
+    return n

@@ -6,6 +6,8 @@ class — see ANALYSIS.md for the authoring contract.
 """
 
 from rca_tpu.analysis.rules import dictscan       # noqa: F401
+from rca_tpu.analysis.rules import donationguard  # noqa: F401
+from rca_tpu.analysis.rules import dtypediscipline  # noqa: F401
 from rca_tpu.analysis.rules import env            # noqa: F401
 from rca_tpu.analysis.rules import faults         # noqa: F401
 from rca_tpu.analysis.rules import gravelock      # noqa: F401
@@ -15,6 +17,7 @@ from rca_tpu.analysis.rules import nondet         # noqa: F401
 from rca_tpu.analysis.rules import residentfetch  # noqa: F401
 from rca_tpu.analysis.rules import retrace        # noqa: F401
 from rca_tpu.analysis.rules import rng            # noqa: F401
+from rca_tpu.analysis.rules import shapecontract  # noqa: F401
 from rca_tpu.analysis.rules import spans          # noqa: F401
 from rca_tpu.analysis.rules import threads        # noqa: F401
 from rca_tpu.analysis.rules import ticksync       # noqa: F401

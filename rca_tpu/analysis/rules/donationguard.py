@@ -1,0 +1,260 @@
+"""Rule ``donation-guard``: a buffer passed through a ``donate_argnums``
+position is DEAD — reading it afterwards is a finding (ISSUE 19 —
+graftspec; ANALYSIS.md §graftspec).
+
+The resident sessions' whole update path rides on donation: the delta
+executables take the resident feature buffer at argument 0 with
+``donate_argnums=(0,)`` so XLA scatters in place.  The calling
+convention that makes this safe is *rebind-in-the-same-statement*::
+
+    self._features, vals, idx, n_bad = _flush_propagate_ranked(
+        self._features, ...)
+
+Anything else leaves a dangling reference to a deleted buffer: the read
+crashes on real hardware (`DELETED` array) but often *works on CPU*
+where donation is a no-op — the classic lands-in-review,
+explodes-on-TPU bug this rule exists to catch before the TPU round.
+
+Detection: module-local jit functions declaring ``donate_argnums`` (the
+decorator and ``jax.jit(fn, donate_argnums=...)`` call forms), plus the
+``DONATED_ATTR_CALLABLES`` contract table for runtime-built jit
+wrappers bound to attributes (``self._fn``).  At every call site, the
+expression at a donated position must be rebound by the same statement;
+otherwise any later read of that expression in the function (before a
+rebind) is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+from rca_tpu.analysis.dataplane.contracts import DONATED_ATTR_CALLABLES
+
+
+def _donate_argnums(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            out = []
+            node = kw.value
+            elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+                else [node]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+    return ()
+
+
+def _is_jit_callee(node: ast.expr) -> bool:
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def donated_functions(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """Function name -> donated positions, for both spellings: the
+    ``@partial(jax.jit, donate_argnums=...)`` decorator and a module-
+    level ``jax.jit(fn, donate_argnums=...)`` wrap of a named function."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    f = dec.func
+                    is_partial = (
+                        (isinstance(f, ast.Name) and f.id == "partial")
+                        or (isinstance(f, ast.Attribute)
+                            and f.attr == "partial")
+                    )
+                    wraps_jit = (
+                        (is_partial and dec.args
+                         and _is_jit_callee(dec.args[0]))
+                        or _is_jit_callee(f)
+                    )
+                    if wraps_jit:
+                        nums = _donate_argnums(dec)
+                        if nums:
+                            out[node.name] = nums
+        elif isinstance(node, ast.Call) and _is_jit_callee(node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                nums = _donate_argnums(node)
+                if nums:
+                    out[node.args[0].id] = nums
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and _is_jit_callee(node.value.func):
+            # bound wrap: step = jax.jit(raw, donate_argnums=(0,)) —
+            # call sites go through the BOUND name, so track that too
+            nums = _donate_argnums(node.value)
+            if nums:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = nums
+    return out
+
+
+def _stmts_in_order(body: List[ast.stmt]) -> Iterator[ast.stmt]:
+    """Statements in source order, descending into compound blocks but
+    NOT into nested function/class definitions (their frames are fresh)."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            inner = getattr(stmt, field, None)
+            if inner:
+                yield from _stmts_in_order(inner)
+        for handler in getattr(stmt, "handlers", []) or []:
+            yield from _stmts_in_order(handler.body)
+
+
+def _expr_text(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return ""
+
+
+def _assign_target_texts(stmt: ast.stmt) -> Set[str]:
+    texts: Set[str] = set()
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            texts |= {_expr_text(e) for e in t.elts}
+        else:
+            texts.add(_expr_text(t))
+    return texts
+
+
+def _own_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions evaluated by THIS statement alone — compound
+    statements contribute only their header (test / iter / context
+    managers), because their body statements are yielded separately by
+    :func:`_stmts_in_order` (walking the whole subtree here would see
+    every inner statement twice)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Try)):
+        return []
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value]
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return [stmt.value] if stmt.value is not None else []
+    return [stmt]  # a simple statement: walk it whole
+
+
+def _reads_in(stmt: ast.stmt, text: str) -> int:
+    """First lineno where ``text`` is read in the statement's own
+    expressions, excluding assignment-target occurrences; 0 if none."""
+    for root in _own_exprs(stmt):
+        for n in ast.walk(root):
+            if isinstance(n, (ast.Name, ast.Attribute)) \
+                    and _expr_text(n) == text:
+                return getattr(n, "lineno", getattr(stmt, "lineno", 0))
+    return 0
+
+
+MESSAGE = (
+    "`{buf}` was donated to `{callee}` at line {line} and never rebound "
+    "— this read touches a DELETED device buffer (works on CPU where "
+    "donation is a no-op, crashes on TPU); rebind in the donating "
+    "statement: `{buf}, ... = {callee}({buf}, ...)`"
+)
+
+
+@register
+class DonationGuardRule(Rule):
+    name = "donation-guard"
+    summary = ("no read of a buffer after it passed a donate_argnums "
+               "position without a same-statement rebind")
+    why = ("donation is what makes the resident delta path O(changed "
+           "rows); a read of the donated buffer is a use-after-free that "
+           "CPU runs hide (donation is a no-op there) and TPU turns into "
+           "a DELETED-array crash")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("rca_tpu/") or relpath.endswith(".py")
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        # fast path: no donation spelled anywhere and no contract-table
+        # entry for this file — nothing to track
+        if "donate_argnums" not in ctx.source and not any(
+            path == ctx.relpath for path, _ in DONATED_ATTR_CALLABLES
+        ):
+            return []
+        donated = donated_functions(ctx.tree)
+        donated_attrs = {
+            attr: nums for (path, attr), nums
+            in DONATED_ATTR_CALLABLES.items() if path == ctx.relpath
+        }
+        if not donated and not donated_attrs:
+            return []
+        hits: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(ctx, node, donated, donated_attrs, hits)
+        return hits
+
+    def _donated_positions(self, call: ast.Call,
+                           donated: Dict[str, Tuple[int, ...]],
+                           donated_attrs: Dict[str, Tuple[int, ...]]):
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in donated:
+            return f.id, donated[f.id]
+        text = _expr_text(f)
+        if text in donated_attrs:
+            return text, donated_attrs[text]
+        return None, ()
+
+    def _scan_function(self, ctx: FileContext, fn, donated, donated_attrs,
+                       hits: List[Finding]) -> None:
+        stmts = list(_stmts_in_order(fn.body))
+        #: expr text -> (donation lineno, callee) for currently-dead bufs
+        dead: Dict[str, Tuple[int, str]] = {}
+        for stmt in stmts:
+            # reads of dead buffers come first: the donating statement's
+            # own call arguments legitimately read the buffer
+            for text, (line, callee) in list(dead.items()):
+                read_line = _reads_in(stmt, text)
+                if read_line:
+                    hits.append(ctx.finding(
+                        self, read_line,
+                        MESSAGE.format(buf=text, callee=callee, line=line),
+                        func=fn.name,
+                    ))
+                    del dead[text]  # one report per donation
+            rebinds = _assign_target_texts(stmt)
+            for text in list(dead):
+                if text in rebinds:
+                    del dead[text]
+            for call in [
+                n for root in _own_exprs(stmt)
+                for n in ast.walk(root) if isinstance(n, ast.Call)
+            ]:
+                callee, nums = self._donated_positions(
+                    call, donated, donated_attrs)
+                if not callee:
+                    continue
+                for pos in nums:
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if not isinstance(arg, (ast.Name, ast.Attribute)):
+                        continue  # a temporary: nothing outlives the call
+                    text = _expr_text(arg)
+                    if text in rebinds:
+                        continue  # the sanctioned same-statement rebind
+                    dead[text] = (call.lineno, callee)

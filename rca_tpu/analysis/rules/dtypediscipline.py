@@ -1,0 +1,153 @@
+"""Rule ``dtype-discipline``: low-precision arithmetic lives ONLY in the
+quantized kernel module (ISSUE 19 — graftspec; ANALYSIS.md §graftspec).
+
+The quantized kernel (bf16 evidence + per-row int8 messages) is legal
+precisely because ``engine/quantized.py`` owns the scale bookkeeping and
+the rank-parity gate that certifies it.  A ``bfloat16``/``int8`` cast
+anywhere else — or an implicit f32↔low-precision promotion inside a jit
+body — changes ranking arithmetic with no test failing until a tie
+breaks differently on hardware (SCORE_EPS is calibrated per dtype).
+
+Three checks, driven by ``DTYPE_RULES``:
+
+1. an explicit low-precision cast (``.astype(jnp.bfloat16)``, a typed
+   constructor, ``jnp.int8(x)``) outside the allowlisted modules;
+2. an implicit mixed-precision promotion the abstract interpreter can
+   prove inside a jit-reachable function (a binop whose operands' dtype
+   facts straddle the low-precision boundary);
+3. float64 staging in the dataplane modules (``np.zeros(..., float64)``
+   or ``astype(float64)``) — doubles upload bytes, de-optimizes TPU ops.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from rca_tpu.analysis.core import FileContext, Finding, Rule, register
+from rca_tpu.analysis.dataplane import absint, contracts
+from rca_tpu.analysis.rules.jitscan import jit_functions
+
+_CONSTRUCTORS = frozenset({
+    "zeros", "ones", "full", "empty", "asarray", "array", "arange",
+    "zeros_like", "ones_like", "full_like", "astype", "view",
+})
+
+#: float low-precision is kernel arithmetic wherever it appears; int8 is
+#: flagged only in DEVICE contexts (a jnp call, or a dataplane staging
+#: module) — host-side int8 metadata tags (graph node/edge types) are a
+#: legitimate compact encoding, not ranking arithmetic
+_FLOAT_LOW = frozenset({
+    "bfloat16", "float16",
+    "float8_e4m3fn", "float8_e5m2", "float8_e4m3b11_fnuz",
+})
+
+
+def _is_device_call(node: ast.Call) -> bool:
+    """Does this call spell a jnp/jax root anywhere in its callee?"""
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        f = f.value
+        if isinstance(f, ast.Name) and f.id in ("jnp", "jax", "lax"):
+            return True
+    return False
+
+
+def _dtype_root_is_jnp(node: ast.expr) -> bool:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in ("jnp", "jax", "lax")
+
+
+def _cast_dtype(node: ast.Call):
+    """(dtype, dtype_node) this call casts/constructs to, else ('', None)."""
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    # direct constructor: jnp.bfloat16(x) / np.int8(x)
+    direct = absint.dtype_of_node(f)
+    if direct is not None and node.args:
+        return direct, f
+    if name not in _CONSTRUCTORS:
+        return "", None
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            d = absint.dtype_of_node(kw.value)
+            if d:
+                return d, kw.value
+    for a in node.args:
+        d = absint.dtype_of_node(a)
+        if d is not None:
+            return d, a
+    return "", None
+
+
+@register
+class DtypeDisciplineRule(Rule):
+    name = "dtype-discipline"
+    summary = ("bf16/int8 casts only in engine/quantized.py; no implicit "
+               "mixed-precision promotion; no float64 staging")
+    why = ("the quantized kernel is legal because quantized.py owns the "
+           "scale bookkeeping and the rank-parity gate; a low-precision "
+           "cast or implicit promotion anywhere else shifts ranking "
+           "arithmetic with no test failing until a tie breaks "
+           "differently on hardware")
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("rca_tpu/")
+                and relpath not in contracts.DTYPE_RULES["low_precision_ok"])
+
+    _TRIGGERS = ("bfloat16", "float16", "float8", "int8", "float64")
+
+    def scan(self, ctx: FileContext) -> List[Finding]:
+        # fast path: every finding this rule can emit requires one of
+        # the trigger dtype names to be SPELLED in the file (facts in
+        # the interpreter originate from dtype references), so a file
+        # without them cannot fire
+        if not any(t in ctx.source for t in self._TRIGGERS):
+            return []
+        hits: List[Finding] = []
+        f64_scope = ctx.relpath in contracts.DTYPE_RULES[
+            "no_float64_staging"]
+
+        def walk(node: ast.AST, func: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = node.name
+            if isinstance(node, ast.Call):
+                dt, dt_node = _cast_dtype(node)
+                device = (ctx.relpath in contracts.DATAPLANE_MODULES
+                          or _is_device_call(node)
+                          or (dt_node is not None
+                              and _dtype_root_is_jnp(dt_node)))
+                if dt in _FLOAT_LOW or (dt == "int8" and device):
+                    hits.append(ctx.finding(
+                        self, node.lineno,
+                        f"low-precision cast to {dt} outside "
+                        "engine/quantized.py — quantization lives behind "
+                        "the rank-parity-gated kernel, not inline "
+                        "(SCORE_EPS is calibrated per dtype)", func=func,
+                    ))
+                elif dt == "float64" and f64_scope:
+                    hits.append(ctx.finding(
+                        self, node.lineno,
+                        "float64 staging in a dataplane module — doubles "
+                        "host->device upload bytes and de-optimizes every "
+                        "downstream TPU op; stage float32", func=func,
+                    ))
+            for child in ast.iter_child_nodes(node):
+                walk(child, func)
+
+        walk(ctx.tree, "<module>")
+
+        # implicit promotions the interpreter can prove inside jit bodies
+        for jf in jit_functions(ctx):
+            interp = absint.interpret_function(jf.node, {})
+            for lineno, a, b in interp.events.promotions:
+                hits.append(ctx.finding(
+                    self, lineno,
+                    f"implicit {a}<->{b} promotion inside a jit body — "
+                    "mixed-precision arithmetic outside the quantized "
+                    "kernel changes ranking results silently",
+                    func=jf.node.name,
+                ))
+        return hits

@@ -78,7 +78,10 @@ def build_up_ell(n_pad: int, dep_src, dep_dst):
     mask = np.zeros((n_pad, UP_WIDTH_CAP), np.float32)
     idx[:, : seg.idx.shape[1]] = seg.idx
     mask[:, : seg.mask.shape[1]] = seg.mask
-    o_pad = max(8, len(seg.ovf_seg))  # build_ell_segments pads to pow2
+    # explicit pow2 round-up: build_ell_segments pads to pow2 today, but
+    # this table's shape stability must not hang on that producer — a
+    # drifted overflow length here is a per-graph recompile, not an error
+    o_pad = max(8, 1 << max(0, (len(seg.ovf_seg) - 1).bit_length()))
     ovf_seg = np.full(o_pad, dummy, np.int32)
     ovf_other = np.full(o_pad, dummy, np.int32)
     ovf_seg[: len(seg.ovf_seg)] = seg.ovf_seg

@@ -581,13 +581,15 @@ def test_baseline_is_empty():
     assert load_baseline(default_baseline_path(ROOT)) == []
 
 
-def test_all_fifteen_rules_registered():
+def test_all_eighteen_rules_registered():
     assert set(all_rules()) == {
         "tick-sync", "swallowed-faults", "tracer-leak", "retrace-hazard",
         "rng-key-reuse", "lock-discipline", "env-discipline",
         "nondet-discipline", "resident-fetch", "race-guard",
         "lock-order", "thread-discipline", "no-dict-scan",
         "span-discipline", "kernel-dispatch",
+        # graftspec (ISSUE 19)
+        "shape-contract", "dtype-discipline", "donation-guard",
     }
     for rule in all_rules().values():
         assert rule.summary and rule.why

@@ -1,0 +1,474 @@
+"""graftspec tests (ISSUE 19): the contract tables are sound and
+covering, each new rule fires on its fixture and stays silent on the
+clean twin, the repo itself sweeps clean, specsan agrees with the static
+model on real recorded workloads, and the satellite mechanics (atomic
+index publish, shared parse cache) behave."""
+
+import json
+import os
+
+import pytest
+
+from rca_tpu.analysis.core import (
+    index_path,
+    load_index,
+    parse_cache_stats,
+    parse_file,
+    run_lint,
+    update_index,
+)
+from rca_tpu.analysis.dataplane import absint, contracts
+from rca_tpu.analysis.dataplane.specsan import (
+    SpecsanRecorder,
+    capture,
+    confirm_findings,
+    unify_roles,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(ROOT, "tests", "corpus")
+
+
+def _fake_repo(tmp_path, *entries):
+    for rel, src in entries:
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(src)
+    return str(tmp_path)
+
+
+def _lint(root, rules):
+    return run_lint(root=root, rules=rules, use_baseline=False)
+
+
+# -- contract tables ---------------------------------------------------------
+
+def test_budget_domination_proof_holds():
+    """Every FETCH_BUDGETS row must fit its declared byte budget at
+    EVERY symbol-grid binding — the table itself is checked, not
+    trusted."""
+    assert contracts.budget_violations() == []
+
+
+def test_every_allowlisted_fetch_surface_has_a_budget():
+    """Acceptance criterion: residentfetch.FETCH_SURFACES and
+    FETCH_BUDGETS must agree — an audited surface without a quantified
+    budget is an unquantified contract."""
+    assert contracts.coverage() == []
+
+
+def test_budget_violation_detected():
+    """A deliberately under-declared budget is caught by the proof."""
+    bad = contracts.FetchBudget(
+        (contracts.Role("vals", ("k",), "float32"),), "2*k",
+    )
+    key = ("rca_tpu/engine/fake.py", "fake_fetch")
+    contracts.FETCH_BUDGETS[key] = bad
+    try:
+        out = contracts.budget_violations()
+    finally:
+        del contracts.FETCH_BUDGETS[key]
+    assert any(v["surface"].endswith("fake_fetch") for v in out)
+
+
+def test_role_name_normalization():
+    assert contracts.role_name("_stacked_dev") == "stacked"
+    assert contracts.role_name("vals_h") == "vals"
+    assert contracts.role_name("topi") == "idx"
+    assert contracts.role_name("n_bad") == "n_bad"
+
+
+# -- shape-contract ----------------------------------------------------------
+
+def test_shape_contract_pad_and_staging_fixtures(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/engine/ell.py", """\
+import numpy as np
+
+def build(n, m, seg):
+    n_pad = n + 1                                        # not provable
+    e_pad = bucket_for(m)                                # provable
+    o_pad = max(8, len(seg))                             # the r19 bug
+    q_pad = max(8, 1 << max(0, (m - 1).bit_length()))    # the r19 fix
+    bad_fill = np.full(8, 0, np.int32)                   # literal row id
+    no_dtype = np.zeros((8, 4))                          # host float64
+    ok_fill = np.full(8, n_pad - 1, np.int32)
+    ok_buf = np.zeros((8, 4), np.float32)
+    return n_pad, e_pad, o_pad, q_pad, bad_fill, no_dtype, ok_fill, ok_buf
+"""))
+    result = _lint(root, ["shape-contract"])
+    lines = sorted(f.line for f in result.findings)
+    assert lines == [4, 6, 8, 9], [
+        (f.line, f.message) for f in result.findings
+    ]
+
+
+def test_shape_contract_jit_signature_conformance(tmp_path):
+    """A conforming _propagate_ranked proves its declared signature; a
+    twin returning (idx, vals) swapped breaks the dtype contract."""
+    good = """\
+import jax.numpy as jnp
+from jax import lax
+
+def _propagate_ranked(features, edges, anomaly_w, hard_w, k):
+    anomaly, upstream, impact, score, resid = propagate_auto(
+        features, edges, anomaly_w, hard_w)
+    features, n_bad = finite_mask_rows(features)
+    stacked = jnp.stack((anomaly, upstream, impact, score))
+    vals, idx = lax.top_k(score, k)
+    diag = stacked[:, idx]
+    return stacked, diag, vals, idx, n_bad
+"""
+    root = _fake_repo(tmp_path, ("rca_tpu/engine/runner.py", good))
+    assert _lint(root, ["shape-contract"]).findings == []
+
+    bad = good.replace(
+        "return stacked, diag, vals, idx, n_bad",
+        "return stacked, diag, idx, vals, n_bad",
+    )
+    root2 = _fake_repo(tmp_path / "swapped", ("rca_tpu/engine/runner.py",
+                                              bad))
+    msgs = [f.message for f in _lint(root2, ["shape-contract"]).findings]
+    assert any("jit signature contract" in m for m in msgs), msgs
+
+
+def test_shape_contract_arity_break(tmp_path):
+    src = """\
+def _propagate_ranked(features, edges, anomaly_w, hard_w, k):
+    features, n_bad = finite_mask_rows(features)
+    return features, n_bad
+"""
+    root = _fake_repo(tmp_path, ("rca_tpu/engine/runner.py", src))
+    msgs = [f.message for f in _lint(root, ["shape-contract"]).findings]
+    assert any("returns 2 values" in m for m in msgs), msgs
+
+
+def test_shape_contract_undeclared_fetch_role(tmp_path):
+    """A device_get moving a leaf no FETCH_BUDGETS role declares is an
+    undeclared transfer; declared roles (any order/subset) are fine."""
+    src = """\
+import jax
+
+def timed_fetch(run):
+    vals, idx = jax.device_get((vals_dev, topi))
+    everything = jax.device_get((vals_dev, stacked_full))
+    return vals, idx, everything
+"""
+    root = _fake_repo(tmp_path, ("rca_tpu/engine/runner.py", src))
+    hits = _lint(root, ["shape-contract"]).findings
+    assert len(hits) == 1 and "stacked_full" in hits[0].message, [
+        (f.line, f.message) for f in hits
+    ]
+
+
+# -- dtype-discipline --------------------------------------------------------
+
+def test_dtype_low_precision_cast_fires_outside_quantized(tmp_path):
+    root = _fake_repo(
+        tmp_path,
+        ("rca_tpu/engine/foo.py",
+         "import jax.numpy as jnp\n\ndef f(x):\n"
+         "    return x.astype(jnp.bfloat16)\n"),
+        ("rca_tpu/engine/quantized.py",
+         "import jax.numpy as jnp\n\ndef q(x):\n"
+         "    return x.astype(jnp.bfloat16)\n"),
+    )
+    hits = _lint(root, ["dtype-discipline"]).findings
+    assert [f.path for f in hits] == ["rca_tpu/engine/foo.py"]
+
+
+def test_dtype_int8_device_vs_host_metadata(tmp_path):
+    """jnp-rooted int8 is kernel arithmetic (fires); np-rooted int8 in a
+    host module is a compact metadata tag (legal — graph/build.py)."""
+    root = _fake_repo(tmp_path, ("rca_tpu/graph/meta.py", """\
+import numpy as np
+import jax.numpy as jnp
+
+def tag(x):
+    host = np.asarray(x, dtype=np.int8)
+    dev = jnp.asarray(x, dtype=jnp.int8)
+    return host, dev
+"""))
+    hits = _lint(root, ["dtype-discipline"]).findings
+    assert len(hits) == 1 and hits[0].line == 6, [
+        (f.line, f.message) for f in hits
+    ]
+
+
+def test_dtype_float64_staging_in_dataplane(tmp_path):
+    root = _fake_repo(
+        tmp_path,
+        ("rca_tpu/engine/streaming.py",
+         "import numpy as np\nbuf = np.zeros((4, 4), np.float64)\n"),
+        ("rca_tpu/tools_helper.py",
+         "import numpy as np\nacc = np.zeros((4, 4), np.float64)\n"),
+    )
+    hits = _lint(root, ["dtype-discipline"]).findings
+    assert [f.path for f in hits] == ["rca_tpu/engine/streaming.py"]
+    assert "float64 staging" in hits[0].message
+
+
+def test_dtype_implicit_promotion_in_jit_body(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/engine/foo.py", """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def mix(n):
+    a = jnp.zeros((4,), jnp.bfloat16)
+    b = jnp.ones((4,), jnp.float32)
+    return a * b
+"""))
+    msgs = [f.message for f in _lint(root, ["dtype-discipline"]).findings]
+    assert any("implicit" in m and "promotion" in m for m in msgs), msgs
+
+
+# -- donation-guard ----------------------------------------------------------
+
+_DONATE_HEADER = """\
+from functools import partial
+import jax
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(buf, x):
+    return buf + x
+"""
+
+
+def test_donation_read_after_donate_fires(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/engine/sess.py",
+                                 _DONATE_HEADER + """\
+
+class Sess:
+    def tick(self, x):
+        out = step(self._buf, x)
+        return self._buf * 2
+"""))
+    hits = _lint(root, ["donation-guard"]).findings
+    assert len(hits) == 1 and hits[0].line == 11, [
+        (f.line, f.message) for f in hits
+    ]
+    assert "DELETED" in hits[0].message
+
+
+def test_donation_same_statement_rebind_is_clean(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/engine/sess.py",
+                                 _DONATE_HEADER + """\
+
+class Sess:
+    def tick(self, x):
+        self._buf = step(self._buf, x)
+        return self._buf * 2
+
+    def tick_tuple(self, x):
+        with self._mesh:
+            self._buf, aux = unpack(step(self._buf, x))
+        return self._buf * 2, aux
+"""))
+    assert _lint(root, ["donation-guard"]).findings == []
+
+
+def test_donation_bound_jit_wrap_form(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/engine/sess.py", """\
+import jax
+
+def raw(buf, x):
+    return buf + x
+
+step = jax.jit(raw, donate_argnums=(0,))
+
+def run(buf, x):
+    out = step(buf, x)
+    return buf
+"""))
+    hits = _lint(root, ["donation-guard"]).findings
+    assert len(hits) == 1 and hits[0].line == 10
+
+
+def test_donation_attr_callable_contract_table(tmp_path):
+    """DONATED_ATTR_CALLABLES covers runtime-built jit wrappers bound to
+    attributes — calls through self._fn in parallel/streaming.py donate
+    argument 0 even though no decorator is visible."""
+    root = _fake_repo(tmp_path, ("rca_tpu/parallel/streaming.py", """\
+class ShardedStreamingSession:
+    def flush(self, idx, rows):
+        out = self._fn(self._features, idx, rows)
+        return self._features
+"""))
+    hits = _lint(root, ["donation-guard"]).findings
+    assert len(hits) == 1 and hits[0].line == 4
+
+
+def test_donation_repo_sites_are_clean():
+    """The four real donation sites all rebind in-statement."""
+    result = run_lint(root=ROOT, rules=["donation-guard"],
+                      use_baseline=False)
+    assert result.findings == []
+
+
+# -- the repo itself sweeps clean --------------------------------------------
+
+def test_repo_sweeps_clean_on_all_graftspec_rules():
+    """Acceptance criterion: the full repo passes shape-contract,
+    dtype-discipline, and donation-guard with an EMPTY baseline."""
+    result = run_lint(
+        root=ROOT,
+        rules=["shape-contract", "dtype-discipline", "donation-guard"],
+        use_baseline=False,
+    )
+    assert result.findings == [], [
+        (f.path, f.line, f.rule, f.message) for f in result.findings
+    ]
+
+
+# -- absint ------------------------------------------------------------------
+
+def test_absint_unknown_is_honest():
+    """Unmodeled constructs evaluate to UNKNOWN and conform to any
+    declared role — a gap in the op table costs coverage, never a false
+    positive."""
+    import ast as ast_mod
+
+    fn = ast_mod.parse("def f(x):\n    return mystery(x)\n").body[0]
+    interp = absint.interpret_function(fn, {})
+    assert interp.returns == [contracts.UNKNOWN]
+    role = contracts.Role("vals", ("k",), "float32")
+    assert absint.fact_conforms(contracts.UNKNOWN, role) is None
+
+
+def test_absint_promote_and_broadcast():
+    assert absint.promote("bfloat16", "float32") == "float32"
+    assert absint.promote(None, "int32") == "int32"
+    assert absint.broadcast((4, "k"), ("k",)) == (4, "k")
+    assert absint.broadcast((1, "k"), (8, 1)) == (8, "k")
+
+
+# -- specsan -----------------------------------------------------------------
+
+_TOPK = (
+    contracts.Role("vals", ("k",), "float32"),
+    contracts.Role("idx", ("k",), "int32"),
+    contracts.Role("n_bad", (), "int32"),
+)
+
+
+def test_unify_roles_binds_symbols_consistently():
+    leaves = [((5,), "float32"), ((5,), "int32"), ((), "int32")]
+    binding = unify_roles(leaves, _TOPK)
+    assert binding == {"k": 5}
+
+
+def test_unify_roles_rejects_inconsistent_dims_and_dtypes():
+    assert unify_roles([((5,), "float32"), ((6,), "int32")], _TOPK) is None
+    assert unify_roles([((5,), "float64")], _TOPK) is None
+
+
+def test_recorder_judges_over_budget():
+    rec = SpecsanRecorder(ROOT)
+    budget = contracts.FetchBudget(_TOPK, "8*k + 8")
+    event = {"surface": "rca_tpu/engine/streaming.py::fetch",
+             "shapes": [[1024]], "dtypes": ["float32"], "nbytes": 4096}
+    rec._judge(event, budget, [((1024,), "float32", 4096)], 4096)
+    assert event["verdict"] == "ok"  # 4096 <= 8*1024 + 8
+
+    event2 = {"surface": "rca_tpu/engine/streaming.py::fetch",
+              "shapes": [[5], [5], [5]],
+              "dtypes": ["float32", "float32", "float32"], "nbytes": 60}
+    rec._judge(event2, budget,
+               [((5,), "float32", 20)] * 3, 60)
+    assert event2["verdict"] == "unmatched_roles"
+    assert any(v["kind"] == "unmatched_roles" for v in rec.violations)
+
+
+def test_confirm_findings_stamps_implicated_paths():
+    findings = [
+        {"rule": "shape-contract", "path": "rca_tpu/engine/runner.py"},
+        {"rule": "shape-contract", "path": "rca_tpu/engine/other.py"},
+        {"rule": "rng-key-reuse", "path": "rca_tpu/engine/runner.py"},
+    ]
+    report = {"violations": [
+        {"kind": "over_budget",
+         "surface": "rca_tpu/engine/runner.py::timed_fetch"},
+    ]}
+    assert confirm_findings(findings, report) == 1
+    assert findings[0].get("dynamically_confirmed") is True
+    assert "dynamically_confirmed" not in findings[1]
+    assert "dynamically_confirmed" not in findings[2]
+
+
+@pytest.mark.parametrize("fixture", [
+    "chaos-20svc-seed11.rcz",
+    "columnar-20svc-seed21.rcz",
+])
+def test_specsan_replay_property(fixture):
+    """The specsan <-> static property on REAL recorded workloads: every
+    device fetch a corpus replay performs must unify with the declared
+    contract roles and fit the declared budgets — zero violations, and
+    the replay must actually exercise at least one budgeted surface."""
+    from rca_tpu.replay import replay
+
+    path = os.path.join(CORPUS, fixture)
+    with capture(ROOT) as rec:
+        report = replay(path)
+    assert report.get("ok", True) in (True, None) or report
+    assert rec.violations == [], rec.violations
+    budgeted = {f"{p}::{f}" for p, f in contracts.FETCH_BUDGETS}
+    exercised = {e["surface"] for e in rec.events} & budgeted
+    assert exercised, "replay exercised no budgeted fetch surface"
+    assert all(
+        e["verdict"] == "ok"
+        for e in rec.events if e["surface"] in budgeted
+    )
+
+
+# -- satellites: atomic index + parse cache ----------------------------------
+
+def test_update_index_atomic_crash_mid_write(tmp_path, monkeypatch):
+    root = str(tmp_path)
+    target = tmp_path / "a.py"
+    target.write_text("x = 1\n")
+    update_index(root, ["a.py"])
+    before = load_index(root)
+    assert "a.py" in before
+
+    target.write_text("x = 2\n")
+    real_dump = json.dump
+
+    def exploding_dump(obj, fh, **kw):
+        fh.write('{"version": 1, "files": {"a.py": "TORN')
+        raise OSError("disk full mid-write")
+
+    monkeypatch.setattr(json, "dump", exploding_dump)
+    update_index(root, ["a.py"])  # must not raise, must not publish
+    monkeypatch.setattr(json, "dump", real_dump)
+
+    assert load_index(root) == before  # old index intact, not torn
+    leftovers = [
+        n for n in os.listdir(os.path.dirname(index_path(root)))
+        if ".tmp." in n
+    ]
+    assert leftovers == []  # the partial temp file was cleaned up
+
+
+def test_parse_cache_hits_and_invalidation(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text("a = 1\n")
+    s0 = parse_cache_stats()
+    src1, tree1 = parse_file(str(f))
+    src2, tree2 = parse_file(str(f))
+    s1 = parse_cache_stats()
+    assert tree1 is tree2  # the SAME tree object: one parse
+    assert s1["hits"] - s0["hits"] == 1
+    assert s1["misses"] - s0["misses"] == 1
+
+    f.write_text("a = 2\n")
+    os.utime(str(f), ns=(1, 1))  # force a distinct (mtime, size) key
+    src3, _ = parse_file(str(f))
+    assert src3 == "a = 2\n"  # edit invalidates
+
+
+def test_lint_result_reports_parse_cache(tmp_path):
+    root = _fake_repo(tmp_path, ("rca_tpu/m.py", "x = 1\n"))
+    result = run_lint(root=root, rules=["shape-contract"],
+                      use_baseline=False)
+    assert set(result.parse_cache) == {"hits", "misses"}
+    assert "parse_cache" in result.to_dict()

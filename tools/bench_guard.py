@@ -87,6 +87,11 @@ TIGHT_METRICS = {
     "attribution_explain_off_p50": (
         ("attribution", "explain_off_request_ms_p50"), 0.05,
     ),
+    # graftspec (ISSUE 19): the lint gates every PR, so its wall time is
+    # a latency budget like any other — new rules may cost at most 2x
+    # the previous round's figure (threshold is fractional CHANGE, so
+    # 1.00 = +100% = 2x), or the gate starts getting skipped
+    "graftlint_wall_ms": (("graftlint", "wall_ms"), 1.00),
 }
 
 DEFAULT_THRESHOLD = 0.15
